@@ -1,0 +1,339 @@
+"""``deepspeed.comm``-shaped collective facade over XLA collectives.
+
+Reference surface: ``deepspeed/comm/comm.py`` (all_reduce :444,
+all_gather_into_tensor :290, reduce_scatter_tensor :273, all_to_all_single
+:324, send/recv :343-361, init_distributed :526). The torch backend dispatched
+to NCCL; here there is exactly one backend — XLA — and two calling modes:
+
+* **Traced** (inside ``jit``/``shard_map``): ``group`` is a mesh-axis name (or
+  tuple of names) and the ops lower to ``lax.psum`` / ``lax.all_gather`` /
+  ``lax.psum_scatter`` / ``lax.all_to_all`` / ``lax.ppermute`` riding ICI/DCN.
+  This is the hot path: ZeRO's grad reduce-scatter and param all-gather are
+  emitted by XLA from sharding specs, and explicit calls appear only inside
+  ``shard_map`` code (pipeline p2p, MoE dispatch, ring attention).
+* **Eager** (outside ``jit``): helpers that wrap a one-off ``shard_map`` over
+  the active mesh. Used by the comm benchmark suite and init-time work.
+
+Process groups become mesh axes; ``init_distributed`` becomes
+``jax.distributed.initialize`` (multi-host) + mesh construction.
+"""
+
+import os
+import time
+from enum import Enum
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils import comms_logging
+from deepspeed_tpu.utils.comms_logging import CommsLogger
+from deepspeed_tpu.utils.logging import logger
+
+comms_logger = CommsLogger()
+
+# Active global mesh (the "process group world").
+_WORLD_MESH = None
+_INITIALIZED = False
+
+DEFAULT_AXIS = "data"
+
+
+class ReduceOp(Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    BAND = 4
+    BOR = 5
+    BXOR = 6
+    AVG = 7
+    UNUSED = 8
+
+
+def is_initialized():
+    return _INITIALIZED
+
+
+def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
+                     distributed_port=29500, verbose=True, timeout=None,
+                     init_method=None, dist_init_required=None, config=None,
+                     rank=-1, world_size=-1, mesh=None):
+    """Initialize multi-host JAX (if env says we're multi-process) and install
+    the world mesh. Safe to call repeatedly.
+
+    Reference: ``comm/comm.py:526`` — env discovery + torch process group
+    init. Here multi-host rendezvous is ``jax.distributed.initialize``,
+    driven by the standard env vars the launcher sets
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) or by JAX's own
+    cluster auto-detection on TPU pods.
+    """
+    global _INITIALIZED, _WORLD_MESH
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("NUM_PROCESSES", "1"))
+    if coord and nproc > 1 and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=int(os.environ.get("PROCESS_ID", "0")))
+        except Exception as e:  # already initialized or single-host
+            logger.warning(f"jax.distributed.initialize skipped: {e}")
+    if mesh is not None:
+        _WORLD_MESH = mesh
+    elif _WORLD_MESH is None:
+        from deepspeed_tpu.parallel.topology import make_mesh
+        _WORLD_MESH = make_mesh()
+    _INITIALIZED = True
+    return _WORLD_MESH
+
+
+def set_mesh(mesh):
+    global _WORLD_MESH, _INITIALIZED
+    _WORLD_MESH = mesh
+    _INITIALIZED = True
+
+
+def get_mesh():
+    return _WORLD_MESH
+
+
+def destroy_process_group(group=None):
+    global _INITIALIZED, _WORLD_MESH
+    _WORLD_MESH = None
+    _INITIALIZED = False
+
+
+def _axes(group):
+    """Normalize a group spec to a tuple of mesh axis names."""
+    if group is None:
+        return (DEFAULT_AXIS,)
+    if isinstance(group, str):
+        return (group,)
+    return tuple(group)
+
+
+def get_world_size(group=None):
+    """Size of the group (product of its mesh axis sizes); with no mesh, the
+    total device count."""
+    if _WORLD_MESH is None:
+        return jax.device_count()
+    if group is None:
+        return _WORLD_MESH.size
+    return int(np.prod([_WORLD_MESH.shape[a] for a in _axes(group)]))
+
+
+def get_rank(group=None):
+    """Process index (single-controller JAX: one process drives many chips).
+    Inside shard_map, use ``axis_index`` instead."""
+    return jax.process_index()
+
+def get_local_rank():
+    return jax.process_index()
+
+
+def axis_index(group=None):
+    """Traced: linear index of this shard within the group axes."""
+    axes = _axes(group)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def axis_size(group=None):
+    axes = _axes(group)
+    s = 1
+    for a in axes:
+        s = s * lax.axis_size(a)
+    return s
+
+
+# --------------------------------------------------------------------------
+# Traced collectives (call inside jit/shard_map with mesh axis names)
+# --------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False):
+    axes = _axes(group)
+    if op == ReduceOp.SUM:
+        return lax.psum(tensor, axes)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, axes)
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axes)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axes)
+    if op in (ReduceOp.PRODUCT, ReduceOp.BAND, ReduceOp.BOR, ReduceOp.BXOR):
+        # No native XLA reduction; gather along the group and fold.
+        g = lax.all_gather(tensor, axes[0] if len(axes) == 1 else axes)
+        fold = {ReduceOp.PRODUCT: jnp.prod,
+                ReduceOp.BAND: lambda a, axis: jnp.bitwise_and.reduce(a, axis=axis),
+                ReduceOp.BOR: lambda a, axis: jnp.bitwise_or.reduce(a, axis=axis),
+                ReduceOp.BXOR: lambda a, axis: jnp.bitwise_xor.reduce(a, axis=axis)}[op]
+        return fold(g, axis=0)
+    raise NotImplementedError(f"ReduceOp {op} not supported on XLA backend")
+
+
+def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None):
+    return all_reduce(tensor, op, group)
+
+
+def all_gather(tensor, group=None, axis=0, tiled=True):
+    """Gather shards along `axis` (reference all_gather_into_tensor)."""
+    axes = _axes(group)
+    name = axes if len(axes) > 1 else axes[0]
+    return lax.all_gather(tensor, name, axis=axis, tiled=tiled)
+
+
+all_gather_into_tensor = all_gather
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, scatter_dim=0):
+    """Reduce + scatter along scatter_dim (reference reduce_scatter_tensor)."""
+    axes = _axes(group)
+    name = axes if len(axes) > 1 else axes[0]
+    if op == ReduceOp.AVG:
+        return lax.psum_scatter(tensor, name, scatter_dimension=scatter_dim,
+                                tiled=True) / axis_size(group)
+    assert op == ReduceOp.SUM, f"reduce_scatter supports SUM/AVG, got {op}"
+    return lax.psum_scatter(tensor, name, scatter_dimension=scatter_dim, tiled=True)
+
+
+reduce_scatter_tensor = reduce_scatter
+
+
+def all_to_all_single(tensor, group=None, split_axis=0, concat_axis=0):
+    """Exchange equal splits along split_axis (reference all_to_all_single
+    :324; the MoE dispatch primitive, ``moe/sharded_moe.py:90``)."""
+    axes = _axes(group)
+    name = axes if len(axes) > 1 else axes[0]
+    return lax.all_to_all(tensor, name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+all_to_all = all_to_all_single
+
+
+def broadcast(tensor, src=0, group=None):
+    """Every member gets the value held by group-index `src`."""
+    axes = _axes(group)
+    # select src's value: mask + psum
+    idx = axis_index(group)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axes)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None):
+    """All members compute the reduction; non-dst results are valid too
+    (XLA has no rooted reduce; this is the SPMD equivalent)."""
+    return all_reduce(tensor, op, group)
+
+
+def ppermute(tensor, perm, group=None):
+    """Point-to-point ring permute (pipeline p2p send/recv both at once)."""
+    axes = _axes(group)
+    name = axes[0] if len(axes) == 1 else axes
+    return lax.ppermute(tensor, name, perm)
+
+
+def send_recv_next(tensor, group=None):
+    """Send to (i+1) % n, receive from (i-1) % n."""
+    n = axis_size(group)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return ppermute(tensor, perm, group)
+
+
+def send_recv_prev(tensor, group=None):
+    n = axis_size(group)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return ppermute(tensor, perm, group)
+
+
+def barrier(group=None):
+    """Traced: data-dependence barrier via a tiny psum."""
+    return lax.psum(jnp.ones((), jnp.int32), _axes(group))
+
+
+# --------------------------------------------------------------------------
+# Eager helpers (outside jit; wrap a one-off shard_map over the world mesh)
+# --------------------------------------------------------------------------
+
+def _require_mesh():
+    if _WORLD_MESH is None:
+        raise RuntimeError("deepspeed_tpu.comm not initialized: call "
+                           "init_distributed() or set_mesh(mesh) first")
+    return _WORLD_MESH
+
+
+_EAGER_CACHE = {}
+
+
+def eager_collective(fn, tensor, group=None, in_spec=None, out_spec=None,
+                     op_name="collective", warmup=False):
+    """Run `fn(shard)` (a traced collective) over the world mesh, eagerly.
+
+    `tensor` is a host/global array whose dim 0 is split across the group
+    axes. Timing feeds the comms logger, mirroring the reference's
+    ``timed_op`` decorator (``comm/comm.py:104``). The jitted wrapper is
+    cached on (fn, mesh, specs) so repeated benchmark calls with the same
+    `fn` object hit the compile cache and the timed interval excludes
+    compilation; pass ``warmup=True`` to additionally run once untimed
+    before the timed run (first call with a fresh lambda).
+    """
+    mesh = _require_mesh()
+    axes = _axes(group)
+    in_spec = in_spec if in_spec is not None else P(axes)
+    out_spec = out_spec if out_spec is not None else in_spec
+    key = (fn, mesh, in_spec, out_spec)
+    shard_fn = _EAGER_CACHE.get(key)
+    if shard_fn is None:
+        shard_fn = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                                         out_specs=out_spec, check_vma=False))
+        _EAGER_CACHE[key] = shard_fn
+    if warmup:
+        jax.block_until_ready(shard_fn(tensor))
+    t0 = time.time()
+    out = shard_fn(tensor)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    if comms_logger.enabled:
+        size = tensor.size * tensor.dtype.itemsize
+        comms_logger.append(op_name, op_name, dt, size, n=get_world_size(group))
+    return out
+
+
+def barrier_eager():
+    mesh = _require_mesh()
+    one = jnp.ones((), jnp.int32)
+    key = ("barrier", mesh)
+    f = _EAGER_CACHE.get(key)
+    if f is None:
+        f = jax.jit(jax.shard_map(lambda x: lax.psum(x, mesh.axis_names),
+                                  mesh=mesh, in_specs=P(), out_specs=P(),
+                                  check_vma=False))
+        _EAGER_CACHE[key] = f
+    jax.block_until_ready(f(one))
+
+
+def log_summary(show_straggler=False, print_log=True):
+    return comms_logger.log_all(print_log=print_log, show_straggler=show_straggler)
+
+
+def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None,
+              verbose=None, debug=None):
+    if deepspeed_config is not None:
+        comms_logger.configure(deepspeed_config.comms_logger)
+    if enabled is not None:
+        comms_logger.enabled = enabled
+    if prof_all is not None:
+        comms_logger.prof_all = prof_all
+    if prof_ops is not None:
+        comms_logger.prof_ops = prof_ops
+    if verbose is not None:
+        comms_logger.verbose = verbose
+    if debug is not None:
+        comms_logger.debug = debug

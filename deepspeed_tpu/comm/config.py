@@ -1,0 +1,17 @@
+"""Comms logger config (reference: ``deepspeed/comm/config.py``)."""
+
+from typing import List
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class CommsConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = []
+    verbose: bool = False
+    debug: bool = False
+
+
+class CommsLoggerConfig(CommsConfig):
+    pass

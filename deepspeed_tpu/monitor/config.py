@@ -1,0 +1,47 @@
+"""Monitor config (reference: ``deepspeed/monitor/config.py:63``)."""
+
+from typing import Optional
+
+from pydantic import model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+def get_monitor_config(param_dict):
+    monitor_dict = {
+        key: param_dict.get(key) or {}
+        for key in ("tensorboard", "wandb", "csv_monitor")
+    }
+    return DeepSpeedMonitorConfig(**monitor_dict)
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = {}
+    wandb: WandbConfig = {}
+    csv_monitor: CSVConfig = {}
+
+    @model_validator(mode="after")
+    def _any_enabled(self):
+        object.__setattr__(
+            self, "enabled",
+            self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled)
+        return self

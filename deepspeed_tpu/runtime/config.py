@@ -1,0 +1,264 @@
+"""Top-level config: JSON file/dict -> typed config tree.
+
+TPU-native rework of the reference's ``deepspeed/runtime/config.py`` (978 LoC):
+the user-facing JSON keys are preserved (fp16/bf16/zero_optimization/optimizer/
+scheduler/batch keys, reference `runtime/constants.py`), the batch-size
+invariant ``train_batch_size = micro_batch * grad_accum * dp_world_size``
+(reference ``config.py:853-915``) is enforced identically, and a TPU-only
+``mesh`` section selects the device-mesh axis sizes (data/model/pipe/expert/
+sequence) that replace the reference's process-group plumbing.
+"""
+
+import json
+import os
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.comm.config import CommsLoggerConfig
+from deepspeed_tpu.monitor.config import get_monitor_config
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel,
+                                                dict_raise_error_on_duplicate_keys)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class Fp16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.loss_scale == 0
+
+    @property
+    def initial_dynamic_scale(self):
+        return 2 ** self.initial_scale_power if self.dynamic_loss_scale else self.loss_scale
+
+
+class Bf16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-only: sizes of the named mesh axes. -1 on at most one axis means
+    "all remaining devices"; unspecified axes default to 1."""
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+    sequence: int = 1
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-only: jax.checkpoint policy name ("nothing_saveable",
+    # "dots_saveable", "dots_with_no_batch_dims_saveable", ...)
+    remat_policy: Optional[str] = None
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False  # TPU-only: orbax-style async save
+
+
+class AioConfig(DeepSpeedConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class PldConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    theta: float = 1.0
+    gamma: float = 0.001
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: dict = {}
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: dict = {}
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfig:
+    """Parse + validate a config dict/file, resolve the batch invariant.
+
+    ``dp_world_size`` is the *data-parallel* degree = mesh data axis size
+    (reference resolved it from torch.distributed world size / mp / pp).
+    """
+
+    def __init__(self, config, dp_world_size=1, mesh=None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"Config file {config} not found")
+            with open(config) as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif config is None:
+            self._param_dict = {}
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict, got {type(config)}")
+
+        pd = self._param_dict
+        self.dp_world_size = dp_world_size
+
+        # --- batch sizes (resolved below) ---
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS)
+
+        # --- subsections ---
+        self.optimizer = OptimizerConfig(**(pd.get(C.OPTIMIZER) or {}))
+        self.scheduler = SchedulerConfig(**(pd.get(C.SCHEDULER) or {}))
+        self.fp16 = Fp16Config(**(pd.get(C.FP16) or {}))
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD)) or {}
+        self.bf16 = Bf16Config(**bf16_dict)
+        self.data_types = DataTypesConfig(**(pd.get(C.DATA_TYPES) or {}))
+        self.zero_config = DeepSpeedZeroConfig(**(pd.get("zero_optimization") or {}))
+        self.mesh_config = MeshConfig(**(pd.get(C.MESH) or {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **(pd.get("activation_checkpointing") or {}))
+        self.checkpoint_config = CheckpointConfig(**(pd.get(C.CHECKPOINT) or {}))
+        self.aio_config = AioConfig(**(pd.get("aio") or {}))
+        self.monitor_config = get_monitor_config(pd)
+        self.comms_logger = CommsLoggerConfig(**(pd.get("comms_logger") or {}))
+        self.flops_profiler = DeepSpeedFlopsProfilerConfig(
+            **(pd.get("flops_profiler") or {}))
+        self.pld = PldConfig(**(pd.get(C.PLD) or {}))
+        self.eigenvalue = EigenvalueConfig(**(pd.get(C.EIGENVALUE) or {}))
+
+        # --- scalars ---
+        self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = pd.get(
+            C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = pd.get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
+        self.communication_data_type = pd.get(
+            C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.disable_allgather = pd.get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.matmul_precision = pd.get(C.MATMUL_PRECISION, "default")
+
+        self._resolve_batch_parameters()
+        self._do_sanity_check()
+
+    # --- batch invariant (reference runtime/config.py:853-915) ---
+    def _resolve_batch_parameters(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        dp = self.dp_world_size
+
+        if all(x is not None for x in (train, micro, gas)):
+            pass  # checked in sanity check
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+        elif train is not None and gas is not None:
+            micro = train // (dp * gas)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            micro = train // dp
+        elif micro is not None:
+            train = micro * dp
+            gas = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _batch_assertion(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train > 0, f"Train batch size: {train} has to be greater than 0"
+        assert micro > 0, f"Micro batch size per device: {micro} has to be greater than 0"
+        assert gas > 0, f"Gradient accumulation steps: {gas} has to be greater than 0"
+        assert train == micro * gas * self.dp_world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_device * gradient_acc_step * world_size "
+            f"{train} != {micro} * {gas} * {self.dp_world_size}")
+
+    def _do_sanity_check(self):
+        self._batch_assertion()
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_config.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
+            logger.info("ZeRO with fp32 params: state sharding still applies")
+
+    # convenience accessors used across the runtime
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self):
+        if self.bf16.enabled:
+            return "bfloat16"
+        if self.fp16.enabled:
+            return "float16"
+        return "float32"
+
+    def print_config(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for key in sorted(self.__dict__):
+            if key.startswith("_"):
+                continue
+            logger.info(f"  {key} = {self.__dict__[key]}")

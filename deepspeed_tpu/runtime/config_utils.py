@@ -1,0 +1,126 @@
+"""Pydantic base model + helpers for the config tree.
+
+Reimplements the contract of the reference's ``runtime/config_utils.py:16``
+(``DeepSpeedConfigModel``) on pydantic v2: unknown keys are tolerated (with a
+log line), and a field may be declared deprecated with a ``new_param`` that it
+auto-populates, so old configs keep working.
+"""
+
+import json
+from functools import reduce
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config submodels.
+
+    Deprecated fields are declared via ``Field(json_schema_extra={
+    "deprecated": True, "new_param": "other_field", "new_param_fn": fn})``.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+
+    @model_validator(mode="after")
+    def _process_deprecated_fields(self):
+        fields_set = self.model_fields_set
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated", False):
+                continue
+            if name not in fields_set:
+                continue
+            new_param = extra.get("new_param", "")
+            dep_msg = f"Config parameter {name} is deprecated"
+            if new_param:
+                dep_msg += f", use {new_param} instead"
+            logger.warning(dep_msg)
+            if not new_param:
+                continue
+            # Only forward if the new param wasn't explicitly set by the user.
+            new_param_root = new_param.split(".")[0]
+            if new_param_root in fields_set:
+                continue
+            value = extra.get("new_param_fn", lambda x: x)(getattr(self, name))
+            try:
+                if "." in new_param:
+                    nodes = new_param.split(".")
+                    target = reduce(getattr, nodes[:-1], self)
+                    setattr(target, nodes[-1], value)
+                else:
+                    object.__setattr__(self, new_param, value)
+            except Exception as e:
+                logger.error(f"Tried to set value {value} for deprecated->new field "
+                             f"{name}->{new_param} but failed: {e}")
+                raise
+        return self
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load object_pairs_hook that rejects duplicate keys."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """JSON encoder printing large numbers in scientific notation
+    (reference ``runtime/config_utils.py`` namesake; used by config dump)."""
+
+    def iterencode(self, o, _one_shot=False, level=0):
+        indent = self.indent if self.indent is not None else 4
+        prefix_close = " " * level * indent
+        level += 1
+        prefix = " " * level * indent
+        if isinstance(o, bool):
+            return "true" if o else "false"
+        elif isinstance(o, float) and o >= 1e3:
+            return f"{o:e}"
+        elif isinstance(o, int) and o >= 1e3:
+            return f"{o:e}"
+        elif isinstance(o, dict):
+            x = [f"\n{prefix}\"{k}\": {self.iterencode(v, level=level)}"
+                 for k, v in o.items()]
+            return "{" + ", ".join(x) + f"\n{prefix_close}" + "}"
+        elif isinstance(o, list):
+            return "[" + ", ".join(self.iterencode(v, level=level) for v in o) + "]"
+        else:
+            return json.dumps(o)

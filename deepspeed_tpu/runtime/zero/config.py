@@ -1,0 +1,98 @@
+"""ZeRO config (reference: ``deepspeed/runtime/zero/config.py`` and
+``offload_config.py``).
+
+On TPU the stages translate to sharding policy, not bookkeeping:
+  stage 0 — params/grads/opt-state replicated over the data axis
+  stage 1 — optimizer state sharded over the data axis
+  stage 2 — + gradient (accumulator) sharded
+  stage 3 — + parameters sharded (fsdp); XLA inserts the just-in-time
+            all-gathers the reference does with module hooks
+Offload configs select the host-RAM / disk paths (ZeRO-Offload/Infinity).
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(100_000_000, ge=0)
+    max_in_cpu: int = Field(1_000_000_000, ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @property
+    def pipeline(self):
+        return self.pipeline_read or self.pipeline_write
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage: int = Field(0, ge=0, le=3)
+
+    # Bucketing / overlap knobs exist for config compatibility; XLA's
+    # latency-hiding scheduler supersedes manual bucketing on TPU.
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(500_000_000, ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(500_000_000, ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    stage3_max_live_parameters: int = Field(1_000_000_000, ge=0)
+    stage3_max_reuse_distance: int = Field(1_000_000_000, ge=0)
+    stage3_prefetch_bucket_size: int = Field(50_000_000, ge=0)
+    stage3_param_persistence_threshold: int = Field(100_000, ge=0)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    stage3_gather_fp16_weights_on_model_save: bool = Field(
+        False, json_schema_extra={"deprecated": True,
+                                  "new_param": "stage3_gather_16bit_weights_on_model_save"})
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+
+    sub_group_size: int = Field(1_000_000_000, ge=0)
+    cpu_offload_param: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_param",
+                                 "new_param_fn": lambda x: DeepSpeedZeroOffloadParamConfig(device="cpu") if x else None})
+    cpu_offload_use_pin_memory: Optional[bool] = None
+    cpu_offload: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_optimizer",
+                                 "new_param_fn": lambda x: DeepSpeedZeroOffloadOptimizerConfig(device="cpu") if x else None})
+
+    @model_validator(mode="after")
+    def _overlap_comm_default(self):
+        if self.overlap_comm is None:
+            # Reference defaults overlap_comm on for stage 3 only
+            # (zero/config.py `overlap_comm_valid`); same here.
+            object.__setattr__(self, "overlap_comm", self.stage == 3)
+        return self

@@ -1,0 +1,118 @@
+"""Communication logging (reference: ``deepspeed/utils/comms_logging.py``).
+
+``calc_bw_log`` reproduces the reference's algorithmic/bus-bandwidth formulas
+(:28): allreduce moves 2(n-1)/n of the message, all_gather/reduce_scatter
+(n-1)/n, all_to_all (n-1)/n.
+"""
+
+import math
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op, size, duration, n=1):
+    """Returns (msg_size_bytes, algo_bw_GBps, bus_bw_GBps)."""
+    duration = max(duration, 1e-9)
+    n = max(n, 1)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "psum"):
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    elif comm_op in ("send", "recv", "isend", "irecv", "broadcast", "ppermute",
+                     "reduce", "gather", "scatter", "barrier"):
+        tput = size / duration
+        busbw = tput
+    else:
+        logger.warning(f"Cannot derive BW for unknown op {comm_op}")
+        return size, 0.0, 0.0
+    # GB/s
+    return size, tput / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    """Accumulates per-op records; ``log_all`` prints a summary table."""
+
+    def __init__(self, config=None):
+        from deepspeed_tpu.comm.config import CommsLoggerConfig
+        config = config or CommsLoggerConfig()
+        self.enabled = config.enabled
+        self.prof_all = config.prof_all
+        self.prof_ops = config.prof_ops
+        self.verbose = config.verbose
+        self.debug = config.debug
+        self.comms_dict = {}
+
+    def configure(self, config):
+        self.enabled = config.enabled
+        self.prof_all = config.prof_all
+        self.prof_ops = config.prof_ops
+        self.verbose = config.verbose
+        self.debug = config.debug
+
+    def start_profiling_op(self, op_name_list):
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list):
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def append(self, raw_name, record_name, latency, msg_size, n=1):
+        algbw_gb = 0.0
+        msg_size, algbw, busbw = calc_bw_log(raw_name, msg_size, latency, n)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(latency)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+                self.comms_dict[record_name][msg_size][3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(
+                f"rank=? | comm op: {record_name} | time (ms): {latency * 1000:.2f} | "
+                f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw * 8:.2f} | "
+                f"busbw (Gbps): {busbw * 8:.2f}", ranks=[0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from numpy import mean
+        lines = [f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}"
+                 f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}"
+                 f"{'tput_avg (Gbps)': <20}{'busbw_avg (Gbps)': <20}"]
+        for record_name in self.comms_dict:
+            lines.append(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count = vals[0]
+                total_lat = sum(vals[1]) * 1000
+                avg_lat = mean(vals[1]) * 1000
+                tput = mean(vals[2]) * 8
+                busbw = mean(vals[3]) * 8
+                lines.append(
+                    f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                    f"{total_lat: <20.2f}{avg_lat: <20.2f}{tput: <20.2f}{busbw: <20.2f}")
+        out = "\n".join(lines)
+        if print_log:
+            print(out, flush=True)
+        return out

@@ -1,0 +1,67 @@
+"""Logging utilities.
+
+TPU-native equivalent of the reference's ``deepspeed/utils/logging.py``:
+a package-level ``logger`` plus ``log_dist(msg, ranks=[...])`` that only
+emits on the given process indices (JAX process index, not per-chip rank —
+one process drives many chips on TPU).
+"""
+
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def create_logger(name="deepspeed_tpu", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    if logger_.handlers:
+        return logger_
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setLevel(level)
+    formatter = logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s")
+    handler.setFormatter(formatter)
+    logger_.addHandler(handler)
+    return logger_
+
+
+logger = create_logger(
+    level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log only on the listed process indices (None or [-1] == all)."""
+    my_rank = _process_index()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def should_log_le(max_log_level_str):
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the `logging` levels")
+    return logger.getEffectiveLevel() <= log_levels[max_log_level_str]

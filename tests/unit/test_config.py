@@ -1,0 +1,80 @@
+"""Config-system tests (reference analogue: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_resolution_from_train_and_micro():
+    c = DeepSpeedConfig({"train_batch_size": 32,
+                         "train_micro_batch_size_per_gpu": 4}, dp_world_size=4)
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_batch_resolution_from_micro_and_gas():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 3}, dp_world_size=8)
+    assert c.train_batch_size == 48
+
+
+def test_batch_resolution_only_train():
+    c = DeepSpeedConfig({"train_batch_size": 16}, dp_world_size=4)
+    assert c.train_micro_batch_size_per_gpu == 4
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_batch_invariant_violation_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({"train_batch_size": 10,
+                         "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 1}, dp_world_size=4)
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, dp_world_size=1)
+
+
+def test_fp16_dynamic_scale():
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "fp16": {"enabled": True, "initial_scale_power": 8}})
+    assert c.fp16.dynamic_loss_scale
+    assert c.fp16.initial_dynamic_scale == 256
+
+
+def test_fp16_static_scale():
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "fp16": {"enabled": True, "loss_scale": 128}})
+    assert not c.fp16.dynamic_loss_scale
+    assert c.fp16.initial_dynamic_scale == 128
+
+
+def test_fp16_bf16_mutually_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_deprecated_cpu_offload():
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert c.zero_config.offload_optimizer is not None
+    assert c.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_zero_stage3_overlap_comm_default():
+    c3 = DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {"stage": 3}})
+    c1 = DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {"stage": 1}})
+    assert c3.zero_config.overlap_comm is True
+    assert c1.zero_config.overlap_comm is False
+
+
+def test_unknown_keys_tolerated():
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "zero_optimization": {"stage": 1, "who_knows": 7}})
+    assert c.zero_config.stage == 1
+
+
+def test_mesh_config():
+    c = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"data": 2, "model": 4}})
+    assert c.mesh_config.data == 2 and c.mesh_config.model == 4

@@ -1,0 +1,61 @@
+"""Topology math tests (reference: tests/unit/runtime/pipe/test_topology.py)."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.parallel.topology import (MESH_AXES, PipeModelDataParallelTopology,
+                                             ProcessTopology, make_mesh,
+                                             resolve_mesh_dims)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_comm_lists():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_dp=2, num_mp=1)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 2] in pipe_lists and [1, 3] in pipe_lists
+    data_lists = topo.get_axis_comm_lists("data")
+    assert [0, 1] in data_lists and [2, 3] in data_lists
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_dp=2, num_mp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert ranks == [0, 1, 2, 3]
+
+
+def test_topology_coord_roundtrip():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 2])
+    for r in range(topo.world_size()):
+        coord = topo.get_coord(r)
+        assert topo.get_rank(a=coord.a, b=coord.b, c=coord.c) == r
+
+
+def test_resolve_mesh_dims_wildcard():
+    sizes = resolve_mesh_dims(MeshConfig(data=-1, model=2), 8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_resolve_mesh_dims_mismatch():
+    with pytest.raises(ValueError):
+        resolve_mesh_dims(MeshConfig(data=3, model=3), 8)
+
+
+def test_make_mesh_8_devices():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["model"] == 2
+    assert mesh.size == 8
+
+
+def test_make_mesh_default_all_data():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == len(jax.devices())

@@ -1,0 +1,114 @@
+"""Benchmark: GPT-2-small training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): the reference's ZeRO-3 Offload sustained
+50 TFlops/GPU on V100 = 40% MFU (50/125 fp16 peak). vs_baseline is
+our_MFU / 0.40, so 1.0 == matching the reference's best published
+utilization on its own hardware class.
+"""
+
+import json
+import time
+
+import numpy as np
+
+PEAK_FLOPS = {
+    # bf16 dense peak per chip
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e12,  # nominal, so the script still runs off-TPU
+}
+
+
+def guess_peak(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=seq, dtype=jnp.bfloat16)
+    model = GPT2(cfg)
+    n_dev = len(jax.devices())
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": n_dev},
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    global_batch = batch * n_dev
+    batch_data = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(global_batch, seq)).astype(np.int32)}
+
+    def step():
+        loss = engine.forward(batch_data)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    def fence():
+        # A host transfer of a value derived from the params cannot complete
+        # before every prior step: a true fence even through async device
+        # relays where block_until_ready returns early.
+        leaf = jax.tree.leaves(engine.state.params)[0]
+        return float(jax.device_get(jnp.sum(leaf)))
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = step()
+    fence()
+
+    n_steps = 20 if on_tpu else 3
+    t0 = time.time()
+    for _ in range(n_steps):
+        loss = step()
+    fence()
+    dt = time.time() - t0
+
+    tokens_per_step = global_batch * seq
+    tokens_per_sec = tokens_per_step * n_steps / dt
+
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(engine.state.params))
+    # 6N per token (fwd+bwd) + attention term 12*L*hidden*seq
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    achieved = tokens_per_sec * flops_per_token
+    peak = guess_peak(jax.devices()[0]) * n_dev
+    mfu = achieved / peak
+    vs_baseline = mfu / 0.40
+
+    print(json.dumps({
+        "metric": "gpt2_small_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {"mfu": round(mfu, 4), "n_devices": n_dev,
+                  "platform": jax.devices()[0].platform,
+                  "device_kind": jax.devices()[0].device_kind,
+                  "batch": global_batch, "seq": seq,
+                  "final_loss": float(jax.device_get(loss))},
+    }))
+
+
+if __name__ == "__main__":
+    main()

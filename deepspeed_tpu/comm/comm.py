@@ -106,6 +106,7 @@ def destroy_process_group(group=None):
     global _INITIALIZED, _WORLD_MESH
     _WORLD_MESH = None
     _INITIALIZED = False
+    _EAGER_CACHE.clear()
 
 
 def _axes(group):
@@ -119,8 +120,12 @@ def _axes(group):
 
 def get_world_size(group=None):
     """Size of the group (product of its mesh axis sizes); with no mesh, the
-    total device count."""
+    total device count (explicit subgroups require a mesh)."""
     if _WORLD_MESH is None:
+        if group is not None:
+            raise RuntimeError(
+                "get_world_size(group=...) needs an installed mesh: call "
+                "init_distributed() or set_mesh(mesh) first")
         return jax.device_count()
     if group is None:
         return _WORLD_MESH.size
@@ -270,6 +275,7 @@ def _require_mesh():
 
 
 _EAGER_CACHE = {}
+_EAGER_CACHE_MAX = 128
 
 
 def eager_collective(fn, tensor, group=None, in_spec=None, out_spec=None,
@@ -291,6 +297,8 @@ def eager_collective(fn, tensor, group=None, in_spec=None, out_spec=None,
     key = (fn, mesh, in_spec, out_spec)
     shard_fn = _EAGER_CACHE.get(key)
     if shard_fn is None:
+        if len(_EAGER_CACHE) >= _EAGER_CACHE_MAX:
+            _EAGER_CACHE.pop(next(iter(_EAGER_CACHE)))
         shard_fn = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
                                          out_specs=out_spec, check_vma=False))
         _EAGER_CACHE[key] = shard_fn
@@ -301,8 +309,11 @@ def eager_collective(fn, tensor, group=None, in_spec=None, out_spec=None,
     jax.block_until_ready(out)
     dt = time.time() - t0
     if comms_logger.enabled:
-        size = tensor.size * tensor.dtype.itemsize
-        comms_logger.append(op_name, op_name, dt, size, n=get_world_size(group))
+        n = get_world_size(group)
+        # per-member message size (what each shard contributes), matching the
+        # per-rank tensors torch passes — calc_bw_log scales by n itself
+        size = tensor.size * tensor.dtype.itemsize // max(n, 1)
+        comms_logger.append(op_name, op_name, dt, size, n=n)
     return out
 
 

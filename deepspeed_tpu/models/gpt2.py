@@ -1,0 +1,176 @@
+"""GPT-2-family causal transformer, TPU-first.
+
+This is the flagship training model (BASELINE.json config #1: "HF GPT-2-small,
+ZeRO stage-1"). Design notes:
+
+* flax.linen with **logical axis names** on every param
+  (``nn.with_partitioning``) — `vocab/embed/heads/kv/mlp` — so tensor
+  parallelism is a sharding-rule choice (parallel/sharding.py), not a code
+  change. The reference reaches TP via Megatron mpu objects
+  (`deepspeed/__init__.py:59`); here it's `pjit` + rules.
+* attention may route through the Pallas flash kernel (ops/attention) or the
+  jnp reference oracle (CPU tests), selected by `attn_impl`.
+* remat ("activation checkpointing", reference
+  `runtime/activation_checkpointing/checkpointing.py`) is `nn.remat` on the
+  block, policy from config.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention.reference import causal_mask, mha_reference
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32          # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "reference"       # "reference" | "flash"
+    use_bias: bool = True
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def _dense(features, cfg, kernel_axes, name=None, use_bias=None):
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        use_bias=cfg.use_bias if use_bias is None else use_bias,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(stddev=0.02), kernel_axes),
+        name=name)
+
+
+class SelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        if cfg.attn_impl == "flash":
+            from deepspeed_tpu.ops.attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = mha_reference(q, k, v, causal=True)
+        out = out.reshape(b, l, cfg.hidden_size)
+        out = _dense(cfg.hidden_size, cfg, ("heads", "embed"), name="proj")(out)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        h = _dense(cfg.mlp_ratio * cfg.hidden_size, cfg, ("embed", "mlp"),
+                   name="fc_in")(x)
+        h = nn.gelu(h)
+        h = _dense(cfg.hidden_size, cfg, ("mlp", "embed"), name="fc_out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        x = x + SelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    """Returns logits [batch, len, vocab]."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.cfg
+        b, l = input_ids.shape
+        wte = self.param(
+            "wte",
+            nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wpe = self.param(
+            "wpe",
+            nn.with_partitioning(nn.initializers.normal(0.01), ("seq", "embed")),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        wte_v = wte.value if hasattr(wte, "value") else wte
+        wpe_v = wpe.value if hasattr(wpe, "value") else wpe
+        x = wte_v.astype(cfg.dtype)[input_ids] + \
+            wpe_v.astype(cfg.dtype)[jnp.arange(l)][None]
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype))
+        else:
+            logits = _dense(cfg.vocab_size, cfg, ("embed", "vocab"),
+                            name="lm_head", use_bias=False)(x)
+        return logits
+
+
+def gpt2_loss_fn(logits, batch):
+    """Mean next-token cross-entropy; expects batch['labels'] (already
+    shifted) or computes shift from input_ids."""
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)),
+                         constant_values=-100)
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - ll) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# canonical "HF GPT-2 small" hyperparameters
+def gpt2_small(**overrides):
+    return GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                     num_heads=12, max_seq_len=1024, **overrides)
+
+
+def gpt2_tiny(**overrides):
+    """Test fixture scale (reference tests/unit/simple_model.py spirit)."""
+    kwargs = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                  max_seq_len=128)
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)

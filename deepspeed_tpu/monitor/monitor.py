@@ -1,0 +1,105 @@
+"""Experiment monitors (reference: ``deepspeed/monitor/monitor.py`` —
+``Monitor`` ABC :13, ``MonitorMaster`` :29 fanning out to TensorBoard,
+WandB and CSV writers). Events are ``(tag, value, step)`` tuples."""
+
+import csv
+import os
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor(ABC):
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    @abstractmethod
+    def write_events(self, event_list):
+        pass
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(config.output_path or "./runs",
+                                       config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"TensorBoard unavailable ({e}); disabled")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group,
+                           entity=config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabled")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled
+        self.output_path = config.output_path or "./csv_monitor"
+        self.job_name = config.job_name
+        self.filenames = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            fname = os.path.join(self.output_path, self.job_name, safe + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", safe])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        self.enabled = getattr(monitor_config, "enabled", False)
+
+    def write_events(self, event_list):
+        if self.tb_monitor.enabled:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor.enabled:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor.enabled:
+            self.csv_monitor.write_events(event_list)

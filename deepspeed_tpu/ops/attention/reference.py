@@ -1,0 +1,79 @@
+"""Reference (pure-jnp) attention implementations.
+
+These are the correctness oracles for the Pallas kernels (reference test
+style: each CUDA op tested against an eager torch implementation,
+``tests/unit/ops/**``). They are also the fallback path on platforms without
+Pallas support (CPU test mesh).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def causal_mask(q_len, k_len, dtype=jnp.float32, offset=0):
+    """Additive causal mask; query i attends to keys <= i + offset."""
+    q_idx = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+    k_idx = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
+    mask = k_idx <= (q_idx + offset)
+    return jnp.where(mask, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def mha_reference(q, k, v, *, causal=True, bias=None, scale=None,
+                  segment_ids=None):
+    """Multi-head attention, [batch, len, heads, head_dim] layout.
+
+    Softmax statistics accumulate in fp32 regardless of input dtype
+    (matches the numerics the Pallas flash kernel keeps on TPU).
+    """
+    b, q_len, h, d = q.shape
+    k_len = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        logits = logits + causal_mask(q_len, k_len, jnp.float32,
+                                      offset=k_len - q_len)[None, None]
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, jnp.finfo(jnp.float32).min)
+    weights = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    weights = weights / weights.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def decode_attention_reference(q, k_cache, v_cache, cache_len, *, scale=None):
+    """Single-token decode attention against a KV cache.
+
+    q: [batch, 1, heads, dim]; caches: [batch, max_len, heads, dim];
+    cache_len: [batch] valid lengths (int32). Reference equivalent of the
+    CUDA ``softmax_context`` kernel (csrc/transformer/inference).
+    """
+    b, _, h, d = q.shape
+    max_len = k_cache.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    pos = lax.broadcasted_iota(jnp.int32, (b, 1, 1, max_len), 3)
+    valid = pos < cache_len[:, None, None, None]
+    logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
+    weights = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    weights = weights / weights.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_cache.dtype), v_cache)
+    return out.astype(q.dtype)
+
+
+def apply_rotary_emb(x, positions, *, base=10000.0):
+    """Rotary position embeddings, [batch, len, heads, dim] layout
+    (reference kernel: csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu)."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freq  # [b, l, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -85,9 +85,10 @@ def add_fsdp_axis(spec, shape, mesh, fsdp_axis="data"):
     return tuple(spec)
 
 
-def param_pspec(logical_spec, shape, mesh, zero_stage=0, rules=DEFAULT_LOGICAL_AXIS_RULES,
-                fsdp_axis="data"):
-    """PartitionSpec for a parameter under TP rules + ZeRO stage."""
+def _base_pspec(logical_spec, shape, mesh, zero_stage, min_fsdp_stage, rules,
+                fsdp_axis):
+    """TP spec from logical names + `data`-axis sharding once the ZeRO stage
+    reaches the threshold (params at stage 3, optimizer state at stage 1)."""
     mesh_axes = logical_to_mesh_axes(logical_spec, rules)
     if mesh_axes is None:
         mesh_axes = (None,) * len(shape)
@@ -95,23 +96,21 @@ def param_pspec(logical_spec, shape, mesh, zero_stage=0, rules=DEFAULT_LOGICAL_A
     mesh_axes = tuple(
         a if (a is None or (dim % _axis_size(mesh, a) == 0 and _axis_size(mesh, a) > 1)) else None
         for a, dim in zip(mesh_axes, shape))
-    if zero_stage >= 3:
+    if zero_stage >= min_fsdp_stage:
         mesh_axes = add_fsdp_axis(mesh_axes, shape, mesh, fsdp_axis)
     return P(*mesh_axes)
+
+
+def param_pspec(logical_spec, shape, mesh, zero_stage=0, rules=DEFAULT_LOGICAL_AXIS_RULES,
+                fsdp_axis="data"):
+    """PartitionSpec for a parameter under TP rules + ZeRO stage."""
+    return _base_pspec(logical_spec, shape, mesh, zero_stage, 3, rules, fsdp_axis)
 
 
 def optstate_pspec(logical_spec, shape, mesh, zero_stage=0,
                    rules=DEFAULT_LOGICAL_AXIS_RULES, fsdp_axis="data"):
     """PartitionSpec for optimizer state mirroring a parameter."""
-    mesh_axes = logical_to_mesh_axes(logical_spec, rules)
-    if mesh_axes is None:
-        mesh_axes = (None,) * len(shape)
-    mesh_axes = tuple(
-        a if (a is None or (dim % _axis_size(mesh, a) == 0 and _axis_size(mesh, a) > 1)) else None
-        for a, dim in zip(mesh_axes, shape))
-    if zero_stage >= 1:
-        mesh_axes = add_fsdp_axis(mesh_axes, shape, mesh, fsdp_axis)
-    return P(*mesh_axes)
+    return _base_pspec(logical_spec, shape, mesh, zero_stage, 1, rules, fsdp_axis)
 
 
 def get_logical_specs(variables):

@@ -128,7 +128,7 @@ class DeepSpeedConfig:
     (reference resolved it from torch.distributed world size / mp / pp).
     """
 
-    def __init__(self, config, dp_world_size=1, mesh=None):
+    def __init__(self, config, dp_world_size=1):
         if isinstance(config, str):
             if not os.path.exists(config):
                 raise DeepSpeedConfigError(f"Config file {config} not found")

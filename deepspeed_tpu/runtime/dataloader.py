@@ -1,0 +1,86 @@
+"""Data loading (reference: ``deepspeed/runtime/dataloader.py``, 162 LoC).
+
+Single-controller JAX feeds **global** batches (micro_batch x dp_world) that
+the engine shards over the `data` mesh axis, so there is no per-rank
+DistributedSampler; the loader's job is batching + collation + epoch cycling.
+Accepts indexable datasets (torch-style), iterables of ready batches, or
+dicts of arrays.
+"""
+
+import numpy as np
+
+
+def default_collate(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(it[k]) for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(it[i]) for it in items])
+                     for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+class DeepSpeedDataLoader:
+    def __init__(self, dataset, batch_size, collate_fn=None, drop_last=False,
+                 shuffle=False, seed=0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+
+    def __len__(self):
+        if hasattr(self.dataset, "__len__"):
+            n = len(self.dataset)
+            if self.drop_last:
+                return n // self.batch_size
+            return (n + self.batch_size - 1) // self.batch_size
+        raise TypeError("underlying dataset has no __len__")
+
+    def __iter__(self):
+        ds = self.dataset
+        if isinstance(ds, dict):
+            n = len(next(iter(ds.values())))
+            idx = np.arange(n)
+            if self.shuffle:
+                idx = np.random.default_rng(self.seed + self.epoch).permutation(n)
+            self.epoch += 1
+            for s in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+                           self.batch_size):
+                sel = idx[s:s + self.batch_size]
+                if len(sel) == 0:
+                    return
+                yield {k: np.asarray(v)[sel] for k, v in ds.items()}
+        elif hasattr(ds, "__getitem__") and hasattr(ds, "__len__"):
+            n = len(ds)
+            idx = np.arange(n)
+            if self.shuffle:
+                idx = np.random.default_rng(self.seed + self.epoch).permutation(n)
+            self.epoch += 1
+            stop = n - self.batch_size + 1 if self.drop_last else n
+            for s in range(0, max(stop, 0), self.batch_size):
+                sel = idx[s:s + self.batch_size]
+                yield self.collate_fn([ds[int(i)] for i in sel])
+        else:  # already an iterable of batches
+            yield from iter(ds)
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference
+    ``runtime/dataloader.py`` namesake, used by pipeline tests)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,521 @@
+"""DeepSpeedEngine: the central training wrapper, TPU-native.
+
+Reference: ``deepspeed/runtime/engine.py`` (3268 LoC) — ``forward`` :1653,
+``backward`` :1795, ``step`` :1991, ``save_checkpoint`` :2818,
+``load_checkpoint`` :2513. The torch engine mutates module state and drives
+collectives through hooks; here the train state (params, optimizer state,
+loss-scale state) is a pytree of **globally-sharded jax.Arrays** and the hot
+path is three jitted functions:
+
+  _fwd_bwd(params, scale, batch, rng) -> (loss, scaled grads)
+  _accum(acc, grads)                  -> acc + grads          (donated)
+  _apply(state, acc, lr)              -> new state, metrics   (donated)
+
+ZeRO stages are sharding choices (parallel/sharding.py), not code paths:
+grads/optimizer state/params pick up a `data`-axis dimension at stages 2/1/3
+and XLA emits the reduce-scatters and all-gathers the reference implements
+manually (stage_1_and_2.py:894, stage3.py:1076, utils.py:918). The fp16
+overflow check + skip-step + dynamic loss scale update run **inside** the
+jitted step (no host sync), reproducing the reference's skip semantics.
+
+The user-facing ``forward()/backward()/step()`` trio keeps reference call
+shape: forward computes loss+grads in one fused pass (JAX can't backprop an
+already-returned loss), backward accumulates, step applies at the gradient
+accumulation boundary.
+"""
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.parallel import sharding as shd
+from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, has_overflow,
+                                                    make_loss_scale_state,
+                                                    update_scale)
+from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule
+from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,
+                                       FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
+                                       NoopTimer, SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # i32: global (optimizer) steps attempted
+    skipped_steps: jnp.ndarray        # i32: overflow-skipped steps
+    params: Any                       # fp32 master params
+    opt_state: Any
+    scaler: LossScaleState
+
+
+class DeepSpeedEngine:
+    """Training engine. Build through :func:`deepspeed_tpu.initialize`."""
+
+    def __init__(self, model, config, loss_fn=None, mesh=None,
+                 training_data=None, lr_scheduler=None, collate_fn=None,
+                 example_batch=None, seed=0, dont_change_device=False,
+                 model_input_fn=None):
+        self.module = model
+        self.client_lr_scheduler = lr_scheduler
+        self.model_input_fn = model_input_fn
+
+        # --- mesh first: the batch invariant needs the data-axis size ---
+        raw = config if isinstance(config, dict) else None
+        if raw is None and isinstance(config, str):
+            with open(config) as f:
+                raw = json.load(f)
+        if mesh is None:
+            from deepspeed_tpu.runtime.config import MeshConfig
+            mesh = make_mesh(MeshConfig(**(raw or {}).get("mesh", {}) or {}))
+        self.mesh = mesh
+        dist.set_mesh(mesh)
+        self.dp_world_size = mesh.shape["data"]
+        self.mp_world_size = mesh.shape["model"]
+
+        self._config = DeepSpeedConfig(raw if raw is not None else config,
+                                       dp_world_size=self.dp_world_size)
+        self.zero_stage = self._config.zero_optimization_stage
+        self.compute_dtype = DTYPES[self._config.precision_dtype]
+        self.fp16_enabled = self._config.fp16.enabled
+        self.bfloat16_enabled = self._config.bf16.enabled
+        jax.config.update("jax_default_matmul_precision",
+                          self._config.matmul_precision) \
+            if self._config.matmul_precision != "default" else None
+
+        self.loss_fn = loss_fn or self._default_loss_fn()
+        self._rng = jax.random.PRNGKey(seed)
+        self._example_batch = example_batch
+
+        # optimizer
+        opt_cfg = self._config.optimizer
+        self.optimizer_name = opt_cfg.type or "adamw"
+        self.tx, self._base_lr = build_optimizer(
+            self.optimizer_name, opt_cfg.params,
+            gradient_clipping=self._config.gradient_clipping)
+
+        # lr schedule
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+
+        # bookkeeping
+        self.micro_steps = 0           # micro batches seen since init
+        self.global_steps = 0          # optimizer steps taken (host mirror)
+        self.global_samples = 0
+        self.state: Optional[TrainState] = None
+        self._grad_acc = None
+        self._pending = None           # (loss, grads) between forward and backward
+        self._last_metrics = {}
+        self.gas = self._config.gradient_accumulation_steps
+
+        self.timers = SynchronizedWallClockTimer() \
+            if self._config.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self._config.steps_per_print)
+
+        # monitor
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(self._config.monitor_config)
+
+        dist.configure(self._config)
+
+        self.training_dataloader = self.deepspeed_io(training_data, collate_fn) \
+            if training_data is not None else None
+
+        if example_batch is not None:
+            self._ensure_initialized(example_batch)
+
+    # ------------------------------------------------------------------ config
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def get_global_grad_norm(self):
+        return self._last_metrics.get("grad_norm")
+
+    @property
+    def loss_scale(self):
+        if self.state is None:
+            return 1.0
+        return float(jax.device_get(self.state.scaler.loss_scale))
+
+    @property
+    def skipped_steps(self):
+        if self.state is None:
+            return 0
+        return int(jax.device_get(self.state.skipped_steps))
+
+    def is_gradient_accumulation_boundary(self):
+        return (self.micro_steps + 1) % self.gas == 0
+
+    def _default_loss_fn(self):
+        """Default contract: module(input_ids) -> logits, next-token CE."""
+        from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+        module = self.module
+
+        def loss_fn(params, batch, rng):
+            logits = module.apply({"params": params}, batch["input_ids"],
+                                  rngs={"dropout": rng} if rng is not None else None)
+            return gpt2_loss_fn(logits, batch)
+
+        return loss_fn
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        if client_scheduler is not None:
+            return client_scheduler if isinstance(client_scheduler, LRScheduler) \
+                else client_scheduler
+        s = self._config.scheduler
+        if s.type:
+            return LRScheduler(get_lr_schedule(s.type, s.params))
+        return None
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_lr()
+        return [self._base_lr]
+
+    # ------------------------------------------------------------- init params
+    def _ensure_initialized(self, batch):
+        if self.state is not None:
+            return
+        t0 = time.time()
+        mesh = self.mesh
+        host_batch = jax.tree.map(np.asarray, batch)
+        init_rng, self._rng = jax.random.split(self._rng)
+
+        example_input = self._model_input(host_batch)
+
+        def init_fn(rng):
+            return self.module.init(rng, self._example_like(example_input))
+
+        boxed_shapes = jax.eval_shape(init_fn, init_rng)
+        boxed_shapes = boxed_shapes.get("params", boxed_shapes)
+        logical = shd.get_logical_specs(boxed_shapes)
+        shapes = shd.unbox(boxed_shapes)
+
+        self.param_pspecs = shd.tree_pspecs(mesh, shapes, logical,
+                                            self.zero_stage, kind="param")
+        opt_param_pspecs = shd.tree_pspecs(mesh, shapes, logical,
+                                           self.zero_stage, kind="opt")
+        opt_shapes = jax.eval_shape(self.tx.init, shapes)
+        self.opt_pspecs = shd.opt_state_pspecs(opt_shapes, shapes, opt_param_pspecs)
+        self.grad_pspecs = opt_param_pspecs if self.zero_stage >= 2 \
+            else self.param_pspecs
+
+        param_sh = shd.tree_shardings(mesh, self.param_pspecs)
+        opt_sh = shd.tree_shardings(mesh, self.opt_pspecs)
+        self._grad_sh = shd.tree_shardings(mesh, self.grad_pspecs)
+
+        params = jax.jit(
+            lambda r: shd.unbox(init_fn(r).get("params", init_fn(r))),
+            out_shardings=param_sh)(init_rng)
+        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
+
+        scaler = make_loss_scale_state(self._config.fp16, self.fp16_enabled)
+        self.state = TrainState(step=jnp.int32(0), skipped_steps=jnp.int32(0),
+                                params=params, opt_state=opt_state,
+                                scaler=scaler)
+        # pin state shardings so the apply step can't silently reshard params,
+        # and commit the scalar fields to the mesh (replicated) so every leaf
+        # lives on the same device set
+        rep = NamedSharding(mesh, P())
+        self._state_sh = jax.tree.map(lambda _: rep, self.state).replace(
+            params=param_sh, opt_state=opt_sh)
+        self.state = jax.tree.map(jax.device_put, self.state, self._state_sh)
+        self._grad_acc = jax.jit(
+            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), shapes),
+            out_shardings=self._grad_sh)()
+        self._zeros_fn = jax.jit(
+            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), shapes),
+            out_shardings=self._grad_sh)
+
+        self._build_jitted_fns()
+        n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+        log_dist(f"engine initialized: {n_params / 1e6:.2f}M params, mesh="
+                 f"{dict(mesh.shape)}, zero_stage={self.zero_stage}, "
+                 f"dtype={self._config.precision_dtype}, "
+                 f"init took {time.time() - t0:.1f}s", ranks=[0])
+
+    def _model_input(self, batch):
+        """The tensor the module's __call__ consumes, for shape inference.
+        Override with model_input_fn for exotic batch layouts."""
+        if self.model_input_fn is not None:
+            return self.model_input_fn(batch)
+        if isinstance(batch, dict):
+            for key in ("input_ids", "x", "inputs", "tokens"):
+                if key in batch:
+                    return batch[key]
+            return next(iter(batch.values()))
+        if isinstance(batch, (tuple, list)):
+            return batch[0]
+        return batch
+
+    def _example_like(self, x):
+        return jnp.asarray(x)
+
+    def _batch_sharding(self, batch):
+        mesh = self.mesh
+        def f(leaf):
+            arr = np.asarray(leaf)
+            spec = P("data") if arr.ndim >= 1 and \
+                arr.shape[0] % mesh.shape["data"] == 0 else P()
+            return NamedSharding(mesh, spec)
+        return jax.tree.map(f, batch)
+
+    def _put_batch(self, batch):
+        sh = self._batch_sharding(batch)
+        return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s),
+                            batch, sh)
+
+    # --------------------------------------------------------------- jitted fns
+    def _build_jitted_fns(self):
+        loss_fn = self.loss_fn
+        compute_dtype = self.compute_dtype
+        gas = float(self.gas)
+        tx = self.tx
+        clip_norm = float(self._config.gradient_clipping or 0.0)
+        predivide = float(self._config.gradient_predivide_factor or 1.0)
+
+        def cast(p):
+            return jax.tree.map(
+                lambda x: x.astype(compute_dtype)
+                if x.dtype == jnp.float32 and compute_dtype != jnp.float32 else x, p)
+
+        def fwd_bwd(params, scale, batch, rng):
+            def scaled_loss(p):
+                loss = loss_fn(cast(p), batch, rng)
+                return loss.astype(jnp.float32) * scale / gas, loss
+
+            (s_loss, loss), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(params)
+            return loss, grads
+
+        self._fwd_bwd = jax.jit(fwd_bwd, out_shardings=(None, self._grad_sh))
+
+        def accum(acc, grads):
+            return jax.tree.map(jnp.add, acc, grads)
+
+        self._accum = jax.jit(accum, donate_argnums=(0,),
+                              out_shardings=self._grad_sh)
+
+        def apply_step(state, acc, lr):
+            scale = state.scaler.loss_scale
+            grads = jax.tree.map(lambda g: g / (scale * predivide), acc)
+            overflow = has_overflow(grads)
+
+            gnorm = optax.global_norm(grads)
+            if clip_norm > 0.0:
+                factor = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+
+            opt_state = state.opt_state
+            # drive the LR schedule value into inject_hyperparams state
+            if hasattr(opt_state, "hyperparams"):
+                hp = dict(opt_state.hyperparams)
+                hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+                opt_state = opt_state._replace(hyperparams=hp)
+
+            updates, new_opt = tx.update(grads, opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+
+            # skip-step on overflow (reference stage_1_and_2.py:1636 semantics)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new_params, state.params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
+
+            scaler = update_scale(state.scaler, overflow)
+            new_state = state.replace(
+                step=state.step + 1,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+                params=new_params, opt_state=new_opt, scaler=scaler)
+            metrics = {"grad_norm": gnorm, "overflow": overflow,
+                       "loss_scale": scaler.loss_scale}
+            return new_state, metrics
+
+        self._apply = jax.jit(apply_step, donate_argnums=(0, 1),
+                              out_shardings=(self._state_sh, None))
+
+    # ------------------------------------------------------------------ train
+    def forward(self, batch, rng=None):
+        """Compute loss (and grads, cached for backward) on one micro batch."""
+        self._ensure_initialized(batch)
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        dev_batch = self._put_batch(batch)
+        if rng is None:
+            rng, self._rng = jax.random.split(self._rng)
+        loss, grads = self._fwd_bwd(self.state.params,
+                                    self.state.scaler.loss_scale, dev_batch, rng)
+        self._pending = (loss, grads)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def backward(self, loss=None, retain_graph=False, scale_wrt_gas=True):
+        """Accumulate the gradients computed by the last forward()."""
+        assert self._pending is not None, \
+            "backward() must follow forward() (grads are computed jointly)"
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        _, grads = self._pending
+        self._grad_acc = self._accum(self._grad_acc, grads)
+        self._pending = None
+        self.micro_steps += 1
+        self.global_samples += self.train_micro_batch_size_per_gpu() * \
+            self.dp_world_size
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def step(self):
+        """Optimizer step at the gradient-accumulation boundary."""
+        if self.micro_steps % self.gas != 0:
+            return  # mid-accumulation: nothing to do (reference no-ops too)
+        self.timers(STEP_GLOBAL_TIMER).start()
+        lr = float(self.get_lr()[0])
+        self.state, metrics = self._apply(self.state, self._grad_acc, lr)
+        self._grad_acc = self._zeros_fn()
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._last_metrics = metrics
+        self.timers(STEP_GLOBAL_TIMER).stop()
+
+        if self.monitor.enabled and self.global_steps % \
+                self._config.steps_per_print == 0:
+            m = jax.device_get(metrics)
+            self.monitor.write_events(
+                [("Train/Samples/lr", lr, self.global_samples),
+                 ("Train/Samples/loss_scale", float(m["loss_scale"]),
+                  self.global_samples)])
+        return metrics
+
+    def train_batch(self, data_iter=None, batches=None):
+        """Full step: GAS micro-batches -> one optimizer step. Returns mean loss."""
+        assert data_iter is not None or batches is not None or \
+            self.training_dataloader is not None
+        if data_iter is None and batches is None:
+            data_iter = iter(self.training_dataloader)
+        losses = []
+        self.tput_timer.start()
+        for i in range(self.gas):
+            batch = batches[i] if batches is not None else next(data_iter)
+            loss = self.forward(batch)
+            self.backward(loss)
+            losses.append(loss)
+        metrics = self.step()
+        self.tput_timer.stop(global_step=True)
+        mean_loss = float(np.mean([jax.device_get(l) for l in losses]))
+        if self.global_steps % self._config.steps_per_print == 0:
+            m = jax.device_get(metrics) if metrics else {}
+            log_dist(f"step={self.global_steps} loss={mean_loss:.4f} "
+                     f"lr={self.get_lr()[0]:.3e} "
+                     f"loss_scale={float(m.get('loss_scale', 1.0)):.0f} "
+                     f"grad_norm={float(m.get('grad_norm', 0.0)):.3f}",
+                     ranks=[0])
+            if self.monitor.enabled:
+                self.monitor.write_events(
+                    [("Train/Samples/train_loss", mean_loss, self.global_samples)])
+        return mean_loss
+
+    def eval_batch(self, batch):
+        """Loss-only forward (no grads)."""
+        self._ensure_initialized(batch)
+        if not hasattr(self, "_eval_fn"):
+            loss_fn = self.loss_fn
+            compute_dtype = self.compute_dtype
+
+            def ev(params, batch):
+                p = jax.tree.map(
+                    lambda x: x.astype(compute_dtype)
+                    if x.dtype == jnp.float32 and compute_dtype != jnp.float32
+                    else x, params)
+                return loss_fn(p, batch, None)
+
+            self._eval_fn = jax.jit(ev)
+        return self._eval_fn(self.state.params, self._put_batch(batch))
+
+    # ------------------------------------------------------------------- io
+    def deepspeed_io(self, dataset, collate_fn=None, route="train"):
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            collate_fn=collate_fn,
+            drop_last=self._config.dataloader_drop_last)
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Reference layout (engine.py:2818): <dir>/<tag>/ + `latest` file."""
+        from deepspeed_tpu.checkpoint.engine import save_state
+        assert self.state is not None, "nothing to save before first forward"
+        tag = tag or f"global_step{self.global_steps}"
+        path = os.path.join(save_dir, str(tag))
+        client = dict(client_state or {})
+        client.update({
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "global_samples": self.global_samples,
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if isinstance(self.lr_scheduler, LRScheduler) else None,
+        })
+        save_state(path, self.state, client)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return path
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True, example_batch=None):
+        from deepspeed_tpu.checkpoint.engine import load_state
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        if self.state is None:
+            batch = example_batch if example_batch is not None \
+                else self._example_batch
+            assert batch is not None, \
+                "load_checkpoint before init needs example_batch"
+            self._ensure_initialized(batch)
+        self.state, client = load_state(path, self.state, mesh=self.mesh)
+        self.global_steps = client.get("global_steps", 0)
+        self.micro_steps = client.get("micro_steps", 0)
+        self.global_samples = client.get("global_samples", 0)
+        if load_lr_scheduler_states and client.get("lr_scheduler") and \
+                isinstance(self.lr_scheduler, LRScheduler):
+            self.lr_scheduler.load_state_dict(client["lr_scheduler"])
+        log_dist(f"loaded checkpoint {path}", ranks=[0])
+        return path, client
+
+    # ------------------------------------------------------------------ misc
+    def get_params(self):
+        return self.state.params if self.state is not None else None
+
+    def __call__(self, batch):
+        return self.forward(batch)

@@ -1,0 +1,108 @@
+"""Loss scaling for fp16 training.
+
+Reference: ``deepspeed/runtime/fp16/loss_scaler.py`` (264 LoC) —
+``LossScaler`` (static) and ``DynamicLossScaler`` (grow/backoff on overflow
+with hysteresis). Here the scaler state is a pytree that lives **inside the
+jitted train step** so the overflow check and skip-step decision happen on
+device with no host sync (SURVEY.md §7 "hard parts" #4).
+"""
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class LossScaleState:
+    loss_scale: jnp.ndarray        # f32 scalar
+    good_steps: jnp.ndarray        # i32 scalar: consecutive non-overflow steps
+    hysteresis: jnp.ndarray        # i32 scalar: remaining tolerated overflows
+    # static config
+    scale_window: int = flax.struct.field(pytree_node=False, default=1000)
+    min_scale: float = flax.struct.field(pytree_node=False, default=1.0)
+    scale_factor: float = flax.struct.field(pytree_node=False, default=2.0)
+    init_hysteresis: int = flax.struct.field(pytree_node=False, default=2)
+    dynamic: bool = flax.struct.field(pytree_node=False, default=True)
+
+
+def make_loss_scale_state(fp16_config=None, enabled=True):
+    """Build scaler state from an Fp16Config; disabled/bf16 -> unit scale."""
+    if fp16_config is None or not enabled:
+        return LossScaleState(loss_scale=jnp.float32(1.0),
+                              good_steps=jnp.int32(0),
+                              hysteresis=jnp.int32(1),
+                              dynamic=False)
+    return LossScaleState(
+        loss_scale=jnp.float32(fp16_config.initial_dynamic_scale),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(fp16_config.hysteresis),
+        scale_window=fp16_config.loss_scale_window,
+        min_scale=fp16_config.min_loss_scale,
+        init_hysteresis=fp16_config.hysteresis,
+        dynamic=fp16_config.dynamic_loss_scale)
+
+
+def has_overflow(grads):
+    """True if any grad entry is non-finite (reference ``CheckOverflow``,
+    runtime/utils.py:173). Works on sharded global arrays under jit: the
+    reduction is global automatically."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.bool_(False)
+    finite = [jnp.isfinite(g).all() for g in leaves]
+    return ~jnp.stack(finite).all()
+
+
+def update_scale(state: LossScaleState, overflow):
+    """One reference `update_scale` step, traced (lax.cond-free, pure where)."""
+    if not state.dynamic:
+        return state
+    # overflow path
+    hysteresis_left = state.hysteresis - 1
+    exhausted = hysteresis_left <= 0
+    dec_scale = jnp.maximum(state.loss_scale / state.scale_factor,
+                            state.min_scale)
+    new_scale_ovf = jnp.where(exhausted, dec_scale, state.loss_scale)
+    new_hyst_ovf = jnp.where(exhausted, jnp.int32(state.init_hysteresis),
+                             hysteresis_left)
+    # success path
+    grown = (state.good_steps + 1) % state.scale_window == 0
+    new_scale_ok = jnp.where(grown, state.loss_scale * state.scale_factor,
+                             state.loss_scale)
+    new_good_ok = jnp.where(grown, jnp.int32(0), state.good_steps + 1)
+
+    return state.replace(
+        loss_scale=jnp.where(overflow, new_scale_ovf, new_scale_ok),
+        good_steps=jnp.where(overflow, jnp.int32(0), new_good_ok),
+        hysteresis=jnp.where(overflow, new_hyst_ovf,
+                             jnp.int32(state.init_hysteresis)))
+
+
+class DynamicLossScaler:
+    """Host-side convenience wrapper keeping the reference class surface."""
+
+    def __init__(self, init_scale=2**16, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=2, consecutive_hysteresis=False):
+        self.state = LossScaleState(
+            loss_scale=jnp.float32(init_scale), good_steps=jnp.int32(0),
+            hysteresis=jnp.int32(delayed_shift), scale_window=scale_window,
+            min_scale=min_scale, scale_factor=scale_factor,
+            init_hysteresis=delayed_shift)
+
+    @property
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def update_scale(self, overflow):
+        self.state = update_scale(self.state, jnp.bool_(overflow))
+
+    def backward(self, loss):
+        return loss * self.state.loss_scale
+
+
+class LossScaler(DynamicLossScaler):
+    """Static loss scaler (reference ``LossScaler``)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(init_scale=scale)
+        self.state = self.state.replace(dynamic=False)

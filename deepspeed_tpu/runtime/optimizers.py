@@ -1,0 +1,76 @@
+"""Optimizer factory: config ``optimizer.type`` -> optax transform.
+
+Reference: ``runtime/engine.py:1226`` (``_configure_basic_optimizer``) selects
+Adam/AdamW/FusedAdam/CPUAdam/Lamb/OnebitAdam/OnebitLamb/ZeroOneAdam. On TPU
+the "fused" variants are moot (XLA fuses the update), so every Adam spelling
+maps to one XLA-fused implementation; the 1-bit communication-compressed
+variants fall back to their uncompressed parents for now (grad compression is
+a comm-layer concern here, not an optimizer one).
+
+The returned transform is wrapped in ``optax.inject_hyperparams`` so the
+learning rate is a state field the engine can drive from an LR schedule
+without recompiling.
+"""
+
+import optax
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+def _lr_of(params, default=1e-3):
+    return params.get("lr", default)
+
+
+def build_optimizer(name, params=None, gradient_clipping=0.0):
+    """Build an optax GradientTransformation from a DeepSpeed optimizer
+    config section. Returns (tx, static_lr)."""
+    params = dict(params or {})
+    name = (name or C.ADAMW_OPTIMIZER).lower()
+    # normalize reference spellings
+    aliases = {
+        "fusedadam": C.ADAM_OPTIMIZER,
+        "cpuadam": C.ADAM_OPTIMIZER,  # host-offload handled by ZeRO offload path
+        "deepspeedcpuadam": C.ADAM_OPTIMIZER,
+        "fusedlamb": C.LAMB_OPTIMIZER,
+    }
+    name = aliases.get(name, name)
+
+    lr = params.pop("lr", 1e-3)
+    betas = params.pop("betas", (0.9, 0.999))
+    eps = params.pop("eps", 1e-8)
+    weight_decay = params.pop("weight_decay", 0.0)
+    adam_w_mode = params.pop("adam_w_mode", True)
+    momentum = params.pop("momentum", 0.0)
+    bias_correction = params.pop("bias_correction", True)
+    params.pop("torch_adam", None)
+    for k in list(params):
+        logger.warning(f"Optimizer param '{k}' ignored on TPU backend")
+
+    def make(learning_rate):
+        lr_ = learning_rate
+        if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.ONEBIT_ADAM_OPTIMIZER,
+                    C.ZERO_ONE_ADAM_OPTIMIZER):
+            if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+                logger.warning(f"{name}: using uncompressed Adam update "
+                               "(1-bit compression not applied)")
+            if name == C.ADAM_OPTIMIZER and not adam_w_mode:
+                return optax.adam(lr_, b1=betas[0], b2=betas[1], eps=eps)
+            return optax.adamw(lr_, b1=betas[0], b2=betas[1], eps=eps,
+                               weight_decay=weight_decay)
+        if name in (C.LAMB_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
+            if name == C.ONEBIT_LAMB_OPTIMIZER:
+                logger.warning("onebitlamb: using uncompressed LAMB update")
+            return optax.lamb(lr_, b1=betas[0], b2=betas[1], eps=eps,
+                              weight_decay=weight_decay)
+        if name == C.SGD_OPTIMIZER:
+            return optax.sgd(lr_, momentum=momentum or None)
+        if name == C.ADAGRAD_OPTIMIZER:
+            return optax.adagrad(lr_, eps=eps)
+        if name == C.LION_OPTIMIZER:
+            return optax.lion(lr_, b1=betas[0], b2=betas[1],
+                              weight_decay=weight_decay)
+        raise ValueError(f"Unknown optimizer type: {name}")
+
+    tx = optax.inject_hyperparams(make)(lr)
+    return tx, lr

@@ -174,14 +174,15 @@ class ThroughputTimer:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             self.start_time = 0
-            if global_step and report_speed and \
-                    self.global_step_count % self.steps_per_output == 0:
-                log_dist(
-                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                    f"global_step={self.global_step_count}, "
-                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
-                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.4f}",
-                    ranks=[0])
+            if global_step:
+                if report_speed and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    log_dist(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
+                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.4f}",
+                        ranks=[0])
                 self.step_elapsed_time = 0
 
     def avg_samples_per_sec(self):

@@ -1,0 +1,40 @@
+"""Tiny model fixtures (reference: tests/unit/simple_model.py — SimpleModel
+:18, random dataloaders :228-251)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Two-layer MLP regression fixture."""
+    hidden_dim: int = 64
+    out_dim: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden_dim,
+                     kernel_init=nn.with_partitioning(
+                         nn.initializers.normal(1.0), ("embed", "mlp")))(x)
+        h = nn.tanh(h)
+        return nn.Dense(self.out_dim,
+                        kernel_init=nn.with_partitioning(
+                            nn.initializers.normal(1.0), ("mlp", "embed")))(h)
+
+
+def simple_loss_fn(module):
+    def loss_fn(params, batch, rng):
+        out = module.apply({"params": params}, batch["x"])
+        return jnp.mean((out - batch["y"]) ** 2)
+    return loss_fn
+
+
+def random_regression_data(n=64, in_dim=16, out_dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(n, in_dim)).astype(np.float32),
+            "y": rng.normal(size=(n, out_dim)).astype(np.float32)}
+
+
+def random_lm_data(n=64, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(n, seq)).astype(np.int32)}

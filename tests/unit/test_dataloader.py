@@ -1,0 +1,53 @@
+"""Dataloader tests."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader, default_collate)
+
+
+def test_dict_dataset_batching():
+    data = {"x": np.arange(10), "y": np.arange(10) * 2}
+    loader = DeepSpeedDataLoader(data, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0]["x"].shape == (4,)
+    assert batches[-1]["x"].shape == (2,)
+
+
+def test_drop_last():
+    data = {"x": np.arange(10)}
+    loader = DeepSpeedDataLoader(data, batch_size=4, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert all(b["x"].shape == (4,) for b in batches)
+
+
+def test_indexable_dataset():
+    ds = [{"x": np.float32(i), "y": np.float32(i * 2)} for i in range(8)]
+    loader = DeepSpeedDataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0]["x"], [0, 1, 2, 3])
+
+
+def test_shuffle_changes_order_deterministically():
+    data = {"x": np.arange(100)}
+    l1 = DeepSpeedDataLoader(data, batch_size=100, shuffle=True, seed=1)
+    l2 = DeepSpeedDataLoader(data, batch_size=100, shuffle=True, seed=1)
+    b1, b2 = next(iter(l1)), next(iter(l2))
+    np.testing.assert_array_equal(b1["x"], b2["x"])
+    assert not np.array_equal(b1["x"], np.arange(100))
+
+
+def test_repeating_loader():
+    data = {"x": np.arange(4)}
+    loader = RepeatingLoader(DeepSpeedDataLoader(data, batch_size=2))
+    batches = [next(loader) for _ in range(5)]
+    assert len(batches) == 5
+
+
+def test_collate_tuples():
+    items = [(np.float32(1), np.float32(2)), (np.float32(3), np.float32(4))]
+    out = default_collate(items)
+    np.testing.assert_array_equal(out[0], [1, 3])

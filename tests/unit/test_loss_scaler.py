@@ -1,0 +1,86 @@
+"""Dynamic loss-scale semantics (reference: tests/unit/runtime/half_precision/
+test_dynamic_loss_scale.py — exact skip/halve/grow dynamics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
+                                                    LossScaleState, has_overflow,
+                                                    update_scale)
+
+
+def make_state(scale=2.0 ** 8, window=4, hysteresis=1, min_scale=1.0):
+    return LossScaleState(loss_scale=jnp.float32(scale),
+                          good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(hysteresis),
+                          scale_window=window, min_scale=min_scale,
+                          init_hysteresis=hysteresis)
+
+
+def test_overflow_halves_scale():
+    s = make_state(scale=256.0)
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 128.0
+    assert int(s.good_steps) == 0
+
+
+def test_scale_grows_after_window():
+    s = make_state(scale=8.0, window=3)
+    for _ in range(2):
+        s = update_scale(s, jnp.bool_(False))
+        assert float(s.loss_scale) == 8.0
+    s = update_scale(s, jnp.bool_(False))
+    assert float(s.loss_scale) == 16.0
+    assert int(s.good_steps) == 0
+
+
+def test_hysteresis_delays_backoff():
+    s = make_state(scale=256.0, hysteresis=3)
+    s = update_scale(s, jnp.bool_(True))   # hysteresis 3 -> 2, scale kept
+    assert float(s.loss_scale) == 256.0
+    s = update_scale(s, jnp.bool_(True))   # 2 -> 1, kept
+    assert float(s.loss_scale) == 256.0
+    s = update_scale(s, jnp.bool_(True))   # exhausted -> halve, reset
+    assert float(s.loss_scale) == 128.0
+    assert int(s.hysteresis) == 3
+
+
+def test_success_resets_hysteresis():
+    s = make_state(scale=256.0, hysteresis=2)
+    s = update_scale(s, jnp.bool_(True))
+    assert int(s.hysteresis) == 1
+    s = update_scale(s, jnp.bool_(False))
+    assert int(s.hysteresis) == 2
+
+
+def test_min_scale_floor():
+    s = make_state(scale=2.0, min_scale=1.0)
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 1.0
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 1.0
+
+
+def test_static_scaler_never_changes():
+    s = make_state(scale=64.0)
+    s = s.replace(dynamic=False)
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 64.0
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros((2,))}
+    assert bool(has_overflow(bad))
+    nan = {"a": jnp.array([jnp.nan])}
+    assert bool(has_overflow(nan))
+
+
+def test_wrapper_class():
+    sc = DynamicLossScaler(init_scale=16.0, scale_window=2, delayed_shift=1)
+    assert sc.loss_scale == 16.0
+    sc.update_scale(True)
+    assert sc.loss_scale == 8.0
+    loss = sc.backward(jnp.float32(2.0))
+    np.testing.assert_allclose(float(loss), 16.0)

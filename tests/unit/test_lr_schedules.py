@@ -1,0 +1,59 @@
+"""LR schedule tests (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, get_lr_schedule,
+                                                lr_range_test, one_cycle,
+                                                warmup_decay_lr, warmup_lr)
+
+
+def test_warmup_lr_reaches_max():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    assert s(0) <= 0.1
+    assert s(10) == pytest.approx(0.1)
+    assert s(100) == pytest.approx(0.1)
+
+
+def test_warmup_lr_linear_monotonic():
+    s = warmup_lr(0.0, 1.0, 10, warmup_type="linear")
+    vals = [s(i) for i in range(12)]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+    assert s(4) == pytest.approx(0.5)
+
+
+def test_warmup_decay_ends_at_zero():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1,
+                        warmup_num_steps=10)
+    assert s(100) == pytest.approx(0.0)
+    assert s(55) == pytest.approx(0.05)
+
+
+def test_one_cycle_peak_and_return():
+    s = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                  cycle_first_step_size=10)
+    assert s(0) == pytest.approx(0.01)
+    assert s(10) == pytest.approx(0.1)
+    assert s(20) == pytest.approx(0.01)
+
+
+def test_lr_range_test_staircase():
+    s = lr_range_test(lr_range_test_min_lr=0.1, lr_range_test_step_size=5,
+                      lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert s(0) == pytest.approx(0.1)
+    assert s(4) == pytest.approx(0.1)
+    assert s(5) == pytest.approx(0.2)
+
+
+def test_get_lr_schedule_unknown_raises():
+    with pytest.raises(ValueError):
+        get_lr_schedule("NopeLR", {})
+
+
+def test_scheduler_wrapper_state_dict():
+    sched = LRScheduler(warmup_lr(0, 1.0, 10, "linear"))
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    sched2 = LRScheduler(warmup_lr(0, 1.0, 10, "linear"))
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr() == sched.get_lr()

@@ -41,7 +41,8 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     engine = DeepSpeedEngine(model=model, config=config, loss_fn=loss_fn,
                              mesh=mesh, training_data=training_data,
                              lr_scheduler=lr_scheduler, collate_fn=collate_fn,
-                             example_batch=example_batch, seed=seed)
+                             example_batch=example_batch, seed=seed,
+                             client_optimizer=optimizer)
     return engine, engine.tx, engine.training_dataloader, engine.lr_scheduler
 
 

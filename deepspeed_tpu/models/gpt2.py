@@ -37,7 +37,7 @@ class GPTConfig:
     dtype: Any = jnp.float32          # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
-    attn_impl: str = "reference"       # "reference" | "flash"
+    attn_impl: str = "auto"            # "auto" | "reference" | "flash"
     use_bias: bool = True
     tie_embeddings: bool = True
 
@@ -69,7 +69,12 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, l, cfg.num_heads, cfg.head_dim)
-        if cfg.attn_impl == "flash":
+        impl = cfg.attn_impl
+        if impl == "auto":
+            # the Pallas kernel needs block-aligned seq lens; oracle otherwise
+            impl = "flash" if (jax.default_backend() == "tpu" and
+                               l % 128 == 0) else "reference"
+        if impl == "flash":
             from deepspeed_tpu.ops.attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
         else:

@@ -1,0 +1,11 @@
+"""Attention ops: Pallas flash kernel + pure-jnp oracles.
+
+Reference parity: csrc/transformer fused attention kernels and
+deepspeed/ops/sparse_attention (block-sparse Triton) map here.
+"""
+
+from deepspeed_tpu.ops.attention.reference import (apply_rotary_emb,  # noqa: F401
+                                                   causal_mask,
+                                                   decode_attention_reference,
+                                                   mha_reference)
+from deepspeed_tpu.ops.attention.flash import flash_attention  # noqa: F401

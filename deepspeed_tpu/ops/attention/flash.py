@@ -1,0 +1,333 @@
+"""Pallas TPU flash attention (forward + backward).
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(csrc/transformer/ds_transformer_cuda.cpp, softmax_kernels.cu) and the
+Triton block-sparse path (deepspeed/ops/sparse_attention/matmul.py): one
+online-softmax kernel that never materializes the [q_len, k_len] score
+matrix in HBM.
+
+Design:
+  * grid = (batch*heads, q_blocks, k_blocks); the k axis is innermost so
+    the online-softmax state (m, l, acc) lives in VMEM scratch carried
+    across sequential grid steps.
+  * fp32 softmax statistics regardless of input dtype; matmuls request
+    ``preferred_element_type=float32`` so the MXU accumulates in fp32.
+  * causal blocks that are fully masked are skipped (`pl.when`), giving the
+    ~2x causal speedup.
+  * backward = two kernels (dq; dk+dv) recomputing p from the saved
+    logsumexp, flash-attention-2 style.
+
+The public entry :func:`flash_attention` falls back to interpret mode off
+TPU, so the same code path is exercised by the CPU test mesh.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = pl.ANY
+
+NEG_INF = float(-1e30)  # large-negative instead of -inf: keeps exp() exact-0
+                        # without nan from (-inf) - (-inf)
+
+
+def _causal_valid(qi, ki, block_q, block_k, offset):
+    """Whether block (qi, ki) has any unmasked entry under causal+offset."""
+    max_q = qi * block_q + block_q - 1 + offset
+    return max_q >= ki * block_k
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                scale, block_q, block_k, causal, offset, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + offset
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:][:, :1]
+        l_prev = l_scr[:][:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)       # fully-masked row -> zeros
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:][:, :1] + jnp.log(l)
+
+
+def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
+    """q3/k3/v3: [bh, len, d] -> (o [bh, q_len, d], lse [bh, q_len])."""
+    bh, q_len, d = q3.shape
+    k_len = k3.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    assert q_len % block_q == 0 and k_len % block_k == 0, \
+        f"seq lens ({q_len},{k_len}) must be multiples of blocks " \
+        f"({block_q},{block_k})"
+    nq, nk = q_len // block_q, k_len // block_k
+    offset = k_len - q_len
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, offset=offset, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, k: (i, k, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, k: (i, k, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0)),
+            # lse rides as [bh, q_len, 1]: TPU blocks need their last two
+            # dims (8,128)-divisible or array-spanning
+            pl.BlockSpec((1, block_q, 1), lambda i, j, k: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pl.ANY if pltpu is None else pltpu.VMEM((block_q, 128), jnp.float32),
+            pl.ANY if pltpu is None else pltpu.VMEM((block_q, 128), jnp.float32),
+            pl.ANY if pltpu is None else pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# -------------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block_q, block_k, causal, offset, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]          # (block_q, 1)
+        delta = delta_ref[0]      # (block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + offset
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale, block_q, block_k, causal, offset, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]          # (block_q, 1)
+        delta = delta_ref[0]      # (block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + offset
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                  # (bq, bk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
+               interpret):
+    bh, q_len, d = q3.shape
+    k_len = k3.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    nq, nk = q_len // block_q, k_len // block_k
+    offset = k_len - q_len
+
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # (bh, q_len, 1) to match lse layout
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, k: (i, k, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, k: (i, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, offset=offset, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+        scratch_shapes=[
+            pl.ANY if pltpu is None else pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    # dkv grid: k outer, q inner (accumulate over q)
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda i, k, j: (i, j, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda i, k, j: (i, k, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 1), lambda i, k, j: (i, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, offset=offset, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, k, j: (i, k, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, k, j: (i, k, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, k_len, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pl.ANY if pltpu is None else pltpu.VMEM((block_k, d), jnp.float32),
+            pl.ANY if pltpu is None else pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public entry
+@functools.lru_cache(maxsize=None)
+def _make_op(causal, scale, block_q, block_k, interpret):
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        o, _ = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, interpret=interpret)
+        return o
+
+    def fwd(q3, k3, v3):
+        o, lse = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
+                            block_k=block_k, causal=causal,
+                            interpret=interpret)
+        return o, (q3, k3, v3, o, lse)
+
+    def bwd(res, do):
+        q3, k3, v3, o, lse = res
+        return _flash_bwd(q3, k3, v3, o, lse, do, scale=scale,
+                          block_q=block_q, block_k=block_k, causal=causal,
+                          interpret=interpret)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Flash attention on [batch, len, heads, head_dim] inputs.
+
+    Drop-in for :func:`ops.attention.reference.mha_reference` (the oracle).
+    `interpret=None` auto-selects interpret mode off-TPU so CPU tests run
+    the same kernel.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, q_len, h, d = q.shape
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    op = _make_op(bool(causal), scale, int(block_q), int(block_k),
+                  bool(interpret))
+    o3 = op(to3(q), to3(k), to3(v))
+    return o3.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)

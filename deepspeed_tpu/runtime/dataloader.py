@@ -32,12 +32,15 @@ class DeepSpeedDataLoader:
         self.epoch = 0
 
     def __len__(self):
-        if hasattr(self.dataset, "__len__"):
+        if isinstance(self.dataset, dict):
+            n = len(next(iter(self.dataset.values())))
+        elif hasattr(self.dataset, "__len__"):
             n = len(self.dataset)
-            if self.drop_last:
-                return n // self.batch_size
-            return (n + self.batch_size - 1) // self.batch_size
-        raise TypeError("underlying dataset has no __len__")
+        else:
+            raise TypeError("underlying dataset has no __len__")
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self):
         ds = self.dataset

@@ -70,7 +70,7 @@ class DeepSpeedEngine:
     def __init__(self, model, config, loss_fn=None, mesh=None,
                  training_data=None, lr_scheduler=None, collate_fn=None,
                  example_batch=None, seed=0, dont_change_device=False,
-                 model_input_fn=None):
+                 model_input_fn=None, client_optimizer=None):
         self.module = model
         self.client_lr_scheduler = lr_scheduler
         self.model_input_fn = model_input_fn
@@ -102,12 +102,19 @@ class DeepSpeedEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._example_batch = example_batch
 
-        # optimizer
+        # optimizer: a client-supplied optax transform wins over the config
+        # one (reference engine.py:1176 "client vs config optimizer")
         opt_cfg = self._config.optimizer
-        self.optimizer_name = opt_cfg.type or "adamw"
-        self.tx, self._base_lr = build_optimizer(
-            self.optimizer_name, opt_cfg.params,
-            gradient_clipping=self._config.gradient_clipping)
+        if client_optimizer is not None:
+            self.optimizer_name = "client"
+            self.tx = client_optimizer
+            self._base_lr = float(opt_cfg.params.get("lr", 0.0)) \
+                if opt_cfg.params else 0.0
+        else:
+            self.optimizer_name = opt_cfg.type or "adamw"
+            self.tx, self._base_lr = build_optimizer(
+                self.optimizer_name, opt_cfg.params,
+                gradient_clipping=self._config.gradient_clipping)
 
         # lr schedule
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -185,8 +192,14 @@ class DeepSpeedEngine:
 
     def _configure_lr_scheduler(self, client_scheduler):
         if client_scheduler is not None:
-            return client_scheduler if isinstance(client_scheduler, LRScheduler) \
-                else client_scheduler
+            # a bare schedule callable (step -> lr) gets the LRScheduler
+            # interface; an LRScheduler (or duck-typed object with
+            # get_lr/step) passes through
+            if not isinstance(client_scheduler, LRScheduler) and \
+                    callable(client_scheduler) and \
+                    not hasattr(client_scheduler, "get_lr"):
+                return LRScheduler(client_scheduler)
+            return client_scheduler
         s = self._config.scheduler
         if s.type:
             return LRScheduler(get_lr_schedule(s.type, s.params))
@@ -229,9 +242,11 @@ class DeepSpeedEngine:
         opt_sh = shd.tree_shardings(mesh, self.opt_pspecs)
         self._grad_sh = shd.tree_shardings(mesh, self.grad_pspecs)
 
-        params = jax.jit(
-            lambda r: shd.unbox(init_fn(r).get("params", init_fn(r))),
-            out_shardings=param_sh)(init_rng)
+        def init_params(r):
+            variables = init_fn(r)
+            return shd.unbox(variables.get("params", variables))
+
+        params = jax.jit(init_params, out_shardings=param_sh)(init_rng)
         opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
 
         scaler = make_loss_scale_state(self._config.fp16, self.fp16_enabled)
@@ -245,12 +260,10 @@ class DeepSpeedEngine:
         self._state_sh = jax.tree.map(lambda _: rep, self.state).replace(
             params=param_sh, opt_state=opt_sh)
         self.state = jax.tree.map(jax.device_put, self.state, self._state_sh)
-        self._grad_acc = jax.jit(
-            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), shapes),
-            out_shardings=self._grad_sh)()
         self._zeros_fn = jax.jit(
             lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), shapes),
             out_shardings=self._grad_sh)
+        self._grad_acc = self._zeros_fn()
 
         self._build_jitted_fns()
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))

@@ -23,6 +23,11 @@ class LossScaleState:
     scale_factor: float = flax.struct.field(pytree_node=False, default=2.0)
     init_hysteresis: int = flax.struct.field(pytree_node=False, default=2)
     dynamic: bool = flax.struct.field(pytree_node=False, default=True)
+    # reference loss_scaler.py:191-196: with consecutive_hysteresis=False
+    # (the reference default) hysteresis only replenishes at scale-window
+    # growth; True replenishes on every non-overflow step.
+    consecutive_hysteresis: bool = flax.struct.field(pytree_node=False,
+                                                     default=False)
 
 
 def make_loss_scale_state(fp16_config=None, enabled=True):
@@ -39,7 +44,9 @@ def make_loss_scale_state(fp16_config=None, enabled=True):
         scale_window=fp16_config.loss_scale_window,
         min_scale=fp16_config.min_loss_scale,
         init_hysteresis=fp16_config.hysteresis,
-        dynamic=fp16_config.dynamic_loss_scale)
+        dynamic=fp16_config.dynamic_loss_scale,
+        consecutive_hysteresis=getattr(fp16_config, "consecutive_hysteresis",
+                                       False))
 
 
 def has_overflow(grads):
@@ -70,12 +77,17 @@ def update_scale(state: LossScaleState, overflow):
     new_scale_ok = jnp.where(grown, state.loss_scale * state.scale_factor,
                              state.loss_scale)
     new_good_ok = jnp.where(grown, jnp.int32(0), state.good_steps + 1)
+    if state.consecutive_hysteresis:
+        new_hyst_ok = jnp.int32(state.init_hysteresis)
+    else:
+        # replenish only when the scale grows (reference :191-196)
+        new_hyst_ok = jnp.where(grown, jnp.int32(state.init_hysteresis),
+                                state.hysteresis)
 
     return state.replace(
         loss_scale=jnp.where(overflow, new_scale_ovf, new_scale_ok),
         good_steps=jnp.where(overflow, jnp.int32(0), new_good_ok),
-        hysteresis=jnp.where(overflow, new_hyst_ovf,
-                             jnp.int32(state.init_hysteresis)))
+        hysteresis=jnp.where(overflow, new_hyst_ovf, new_hyst_ok))
 
 
 class DynamicLossScaler:
@@ -87,7 +99,8 @@ class DynamicLossScaler:
             loss_scale=jnp.float32(init_scale), good_steps=jnp.int32(0),
             hysteresis=jnp.int32(delayed_shift), scale_window=scale_window,
             min_scale=min_scale, scale_factor=scale_factor,
-            init_hysteresis=delayed_shift)
+            init_hysteresis=delayed_shift,
+            consecutive_hysteresis=consecutive_hysteresis)
 
     @property
     def loss_scale(self):

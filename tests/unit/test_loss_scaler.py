@@ -9,12 +9,14 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
                                                     update_scale)
 
 
-def make_state(scale=2.0 ** 8, window=4, hysteresis=1, min_scale=1.0):
+def make_state(scale=2.0 ** 8, window=4, hysteresis=1, min_scale=1.0,
+               consecutive_hysteresis=False):
     return LossScaleState(loss_scale=jnp.float32(scale),
                           good_steps=jnp.int32(0),
                           hysteresis=jnp.int32(hysteresis),
                           scale_window=window, min_scale=min_scale,
-                          init_hysteresis=hysteresis)
+                          init_hysteresis=hysteresis,
+                          consecutive_hysteresis=consecutive_hysteresis)
 
 
 def test_overflow_halves_scale():
@@ -45,11 +47,36 @@ def test_hysteresis_delays_backoff():
     assert int(s.hysteresis) == 3
 
 
-def test_success_resets_hysteresis():
-    s = make_state(scale=256.0, hysteresis=2)
+def test_hysteresis_not_replenished_by_single_good_step():
+    # reference loss_scaler.py:191-196 (consecutive_hysteresis=False default):
+    # an interleaved good step does NOT top hysteresis back up, so alternating
+    # overflow/good still halves the scale on the second overflow
+    s = make_state(scale=256.0, hysteresis=2, window=100)
+    s = update_scale(s, jnp.bool_(True))
+    assert int(s.hysteresis) == 1
+    assert float(s.loss_scale) == 256.0     # first overflow tolerated
+    s = update_scale(s, jnp.bool_(False))
+    assert int(s.hysteresis) == 1           # unchanged mid-window
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 128.0     # second overflow halves
+
+
+def test_consecutive_hysteresis_replenishes_each_good_step():
+    s = make_state(scale=256.0, hysteresis=2, window=100,
+                   consecutive_hysteresis=True)
     s = update_scale(s, jnp.bool_(True))
     assert int(s.hysteresis) == 1
     s = update_scale(s, jnp.bool_(False))
+    assert int(s.hysteresis) == 2
+
+
+def test_hysteresis_replenished_at_window_growth():
+    s = make_state(scale=8.0, hysteresis=2, window=2)
+    s = update_scale(s, jnp.bool_(True))
+    assert int(s.hysteresis) == 1
+    s = update_scale(s, jnp.bool_(False))
+    s = update_scale(s, jnp.bool_(False))   # window boundary -> scale grows
+    assert float(s.loss_scale) == 16.0
     assert int(s.hysteresis) == 2
 
 

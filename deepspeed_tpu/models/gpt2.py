@@ -40,6 +40,15 @@ class GPTConfig:
     attn_impl: str = "auto"            # "auto" | "reference" | "flash"
     use_bias: bool = True
     tie_embeddings: bool = True
+    # MoE (reference deepspeed/moe): every `moe_every`-th block swaps its MLP
+    # for a sharded MoE layer
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_use_residual: bool = False
+    moe_loss_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -103,15 +112,28 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic=True):
         cfg = self.cfg
         x = x + SelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
-        return x
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        if self.use_moe:
+            from deepspeed_tpu.moe import MoE
+            h, _, _ = MoE(hidden_size=cfg.hidden_size,
+                          num_experts=cfg.moe_num_experts,
+                          ffn_hidden_size=cfg.mlp_ratio * cfg.hidden_size,
+                          k=cfg.moe_top_k,
+                          capacity_factor=cfg.moe_capacity_factor,
+                          min_capacity=cfg.moe_min_capacity,
+                          use_residual=cfg.moe_use_residual,
+                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                          name="moe")(h, deterministic)
+        else:
+            h = MLP(cfg, name="mlp")(h, deterministic)
+        return x + h
 
 
 class GPT2(nn.Module):
@@ -139,7 +161,9 @@ class GPT2(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"h_{i}")(x, deterministic)
+            use_moe = (cfg.moe_num_experts > 1 and
+                       i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, use_moe, name=f"h_{i}")(x, deterministic)
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_embeddings:

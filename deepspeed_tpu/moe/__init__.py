@@ -1,0 +1,6 @@
+"""Mixture-of-Experts / expert parallelism (reference: deepspeed/moe/)."""
+
+from deepspeed_tpu.moe.layer import MoE, ExpertsMLP  # noqa: F401
+from deepspeed_tpu.moe.sharded_moe import (capacity, combine_tokens,  # noqa: F401
+                                           dispatch_tokens, gate,
+                                           top1_gating, top2_gating)

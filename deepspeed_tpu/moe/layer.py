@@ -1,0 +1,149 @@
+"""MoE layer (reference: ``deepspeed/moe/layer.py:16`` ``MoE``).
+
+TPU-native: experts are **stacked** weight tensors with a leading `expert`
+axis carrying the logical name "expert", so expert parallelism is a sharding
+rule (parallel/sharding.py routes "expert" -> the `expert` mesh axis) and
+the dispatch/return all-to-alls are inserted by XLA at the
+``with_sharding_constraint`` boundaries — no explicit process groups
+(reference builds them in utils/groups.py:108,202).
+
+Residual MoE (``use_residual=True``) reproduces PR-MoE (reference
+layer.py:16 use_residual + docs): output = moe_out * sigmoid-weighted mix
+with a dense MLP branch.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe import sharded_moe
+
+
+def _maybe_constrain(x, *spec):
+    """Sharding constraint if a mesh is active; no-op otherwise."""
+    from deepspeed_tpu import comm as dist
+    mesh = dist.get_mesh()
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    # drop axes the mesh doesn't have or that don't divide
+    axes = []
+    for ax, dim in zip(spec, x.shape):
+        ok = ax is not None and ax in mesh.shape and \
+            mesh.shape[ax] > 1 and dim % mesh.shape[ax] == 0
+        axes.append(ax if ok else None)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*axes)))
+
+
+class ExpertsMLP(nn.Module):
+    """Stacked expert FFNs: params [e, ...] with logical axis "expert"."""
+    num_experts: int
+    hidden_size: int
+    ffn_hidden_size: int
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [e, c, m]
+        e, m, f = self.num_experts, self.hidden_size, self.ffn_hidden_size
+        wi = self.param("wi", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("expert", "embed", "mlp")),
+            (e, m, f), self.param_dtype)
+        bi = self.param("bi", nn.with_partitioning(
+            nn.initializers.zeros_init(), ("expert", "mlp")),
+            (e, f), self.param_dtype)
+        wo = self.param("wo", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("expert", "mlp", "embed")),
+            (e, f, m), self.param_dtype)
+        bo = self.param("bo", nn.with_partitioning(
+            nn.initializers.zeros_init(), ("expert", "embed")),
+            (e, m), self.param_dtype)
+        wi_v = wi.value if hasattr(wi, "value") else wi
+        bi_v = bi.value if hasattr(bi, "value") else bi
+        wo_v = wo.value if hasattr(wo, "value") else wo
+        bo_v = bo.value if hasattr(bo, "value") else bo
+        h = jnp.einsum("ecm,emf->ecf", x, wi_v.astype(self.dtype)) + \
+            bi_v.astype(self.dtype)[:, None]
+        h = self.activation(h)
+        out = jnp.einsum("ecf,efm->ecm", h, wo_v.astype(self.dtype)) + \
+            bo_v.astype(self.dtype)[:, None]
+        return out
+
+
+class MoE(nn.Module):
+    """Sharded MoE layer. __call__ x: [batch, seq, hidden] ->
+    (out [batch, seq, hidden], l_aux scalar, exp_counts [e]).
+
+    Mirrors reference ``MoE.__init__`` arguments (moe/layer.py:16); `expert`
+    module injection is replaced by the stacked ``ExpertsMLP`` contract (or
+    a custom ``experts_cls``).
+    """
+    hidden_size: int
+    num_experts: int = 1
+    ffn_hidden_size: Optional[int] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    use_residual: bool = False           # PR-MoE
+    noisy_gate_policy: Optional[str] = None
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        b, s, m = x.shape
+        ffn = self.ffn_hidden_size or 4 * self.hidden_size
+
+        # gate in fp32 (reference TopKGate casts to float, sharded_moe.py:425)
+        gate_w = self.param("gate", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("embed", None)),
+            (m, self.num_experts), jnp.float32)
+        gate_w = gate_w.value if hasattr(gate_w, "value") else gate_w
+
+        tokens = x.reshape(b * s, m)
+        logits = tokens.astype(jnp.float32) @ gate_w
+
+        rng = None
+        if self.noisy_gate_policy == "RSample" and not deterministic:
+            rng = self.make_rng("gating")
+        cf = self.capacity_factor if not deterministic \
+            else self.eval_capacity_factor
+        l_aux, combine, dispatch, exp_counts = sharded_moe.gate(
+            logits, k=self.k, capacity_factor=cf,
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
+            **({"noisy_gate_policy": self.noisy_gate_policy, "rng": rng}
+               if self.k == 1 else {}))
+
+        dispatched = sharded_moe.dispatch_tokens(dispatch, tokens)  # [e,c,m]
+        dispatched = _maybe_constrain(dispatched, "expert", "data", None)
+        expert_out = ExpertsMLP(self.num_experts, m, ffn, self.activation,
+                                self.dtype, self.param_dtype,
+                                name="experts")(dispatched)
+        expert_out = _maybe_constrain(expert_out, "expert", "data", None)
+        out = sharded_moe.combine_tokens(combine, expert_out)       # [s,m]
+        out = out.reshape(b, s, m).astype(x.dtype)
+
+        if self.use_residual:
+            # PR-MoE: dense MLP branch mixed by a learned 2-way coefficient
+            # (reference layer.py forward, use_residual branch)
+            dense = nn.Dense(ffn, dtype=self.dtype,
+                             param_dtype=self.param_dtype, name="res_fc_in")(x)
+            dense = self.activation(dense)
+            dense = nn.Dense(m, dtype=self.dtype,
+                             param_dtype=self.param_dtype,
+                             name="res_fc_out")(dense)
+            coef = nn.Dense(2, dtype=jnp.float32, param_dtype=jnp.float32,
+                            name="coefficient")(x.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = (out * coef[..., 0:1] + dense * coef[..., 1:2]).astype(x.dtype)
+
+        self.sow("intermediates", "moe_aux_loss", l_aux)
+        self.sow("intermediates", "exp_counts", exp_counts)
+        return out, l_aux, exp_counts

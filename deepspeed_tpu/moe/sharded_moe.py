@@ -1,0 +1,145 @@
+"""Top-k gating + dispatch math for Mixture-of-Experts.
+
+Reference: ``deepspeed/moe/sharded_moe.py`` — ``_capacity`` :157,
+``top1gating`` :179, ``top2gating`` :277, ``TopKGate`` :343, dispatch via
+einsum + ``_AllToAll`` :90. Here gating is pure jax (fp32 throughout) and
+the EP all-to-all is *not* an explicit op: the dispatched tensor carries an
+``expert``-axis sharding constraint and XLA inserts the collective
+(SURVEY.md §2.2 EP row: "lax.all_to_all over an expert mesh axis; gating in
+pure jax; capacity/dropping identical").
+
+Shapes follow the reference's einsum notation:
+  s = tokens, e = experts, c = capacity, m = model dim.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(num_tokens, num_experts, capacity_factor, min_capacity=4):
+    """Per-expert token slots: ceil(tokens/experts * factor), floored at
+    min_capacity (reference ``_capacity``, sharded_moe.py:157)."""
+    cap = math.ceil(num_tokens / num_experts * capacity_factor)
+    return max(cap, int(min_capacity))
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def top1_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
+                noisy_gate_policy=None, rng=None, used_token_mask=None):
+    """Top-1 gating (reference top1gating, sharded_moe.py:179).
+
+    logits: [s, e] raw gate scores (fp32 recommended).
+    Returns (l_aux, combine_weights [s,e,c], dispatch_mask [s,e,c] bool,
+    exp_counts [e]).
+    """
+    s, e = logits.shape
+    cap = capacity(s, e, capacity_factor, min_capacity) if drop_tokens else s
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    select_logits = logits
+    if noisy_gate_policy == "RSample":
+        assert rng is not None, "RSample needs an rng"
+        select_logits = logits + jax.random.gumbel(rng, logits.shape,
+                                                   jnp.float32)
+    indices1 = jnp.argmax(select_logits, axis=-1)            # [s]
+    mask1 = _one_hot(indices1, e)                            # [s, e]
+    if used_token_mask is not None:                          # padding tokens
+        mask1 = mask1 * used_token_mask[:, None]
+
+    # position of each token within its expert's queue
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1           # [s, e]
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)    # [e]
+
+    # load-balancing loss (reference :232): mean gate mass x mean routed
+    # fraction per expert, scaled by e
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    if drop_tokens:
+        mask1 = mask1 * (locations1 < cap)
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)  # [s]
+
+    gates1_s = jnp.sum(gates * mask1, axis=1)                # [s]
+    combine = (gates1_s[:, None, None] * mask1[:, :, None] *
+               _one_hot(locations1_s, cap)[:, None, :])      # [s, e, c]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
+                rng=None, second_policy_jitter=True):
+    """Top-2 gating (reference top2gating, sharded_moe.py:277).
+
+    Capacity doubles (k=2). Combine weights are the two gate values
+    renormalized to sum to 1 per token.
+    """
+    s, e = logits.shape
+    cap = capacity(s, e, 2 * capacity_factor, min_capacity) if drop_tokens \
+        else s
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(indices1, e)
+    # second expert: argmax with the first masked out
+    logits_no1 = jnp.where(mask1 > 0, -jnp.inf, gates)
+    indices2 = jnp.argmax(logits_no1, axis=-1)
+    mask2 = _one_hot(indices2, e)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    # expert-2 tokens queue after all expert-1 tokens (reference :300)
+    locations2 = jnp.cumsum(mask2, axis=0) - mask2 + \
+        jnp.sum(mask1, axis=0, keepdims=True)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    if drop_tokens:
+        mask1 = mask1 * (locations1 < cap)
+        mask2 = mask2 * (locations2 < cap)
+    loc1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+    loc2_s = jnp.sum(locations2 * mask2, axis=1).astype(jnp.int32)
+
+    gates1_s = jnp.sum(gates * mask1, axis=1)
+    gates2_s = jnp.sum(gates * mask2, axis=1)
+    denom = gates1_s + gates2_s
+    denom = jnp.where(denom < jnp.finfo(jnp.float32).eps, 1.0, denom)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    combine1 = (gates1_s[:, None, None] * mask1[:, :, None] *
+                _one_hot(loc1_s, cap)[:, None, :])
+    combine2 = (gates2_s[:, None, None] * mask2[:, :, None] *
+                _one_hot(loc2_s, cap)[:, None, :])
+    combine = combine1 + combine2
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1 + mask2, axis=0).astype(jnp.int32)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def gate(logits, k=1, **kw):
+    """TopKGate dispatcher (reference TopKGate.forward, sharded_moe.py:409)."""
+    if k == 1:
+        return top1_gating(logits, **kw)
+    if k == 2:
+        kw.pop("noisy_gate_policy", None)
+        kw.pop("used_token_mask", None)
+        return top2_gating(logits, **kw)
+    raise ValueError(f"k={k} not supported (reference supports 1 and 2)")
+
+
+def dispatch_tokens(dispatch_mask, x):
+    """[s,e,c] x [s,m] -> [e,c,m] (reference einsum "sec,sm->ecm", :509)."""
+    return jnp.einsum("sec,sm->ecm", dispatch_mask.astype(x.dtype), x)
+
+
+def combine_tokens(combine_weights, expert_out):
+    """[s,e,c] x [e,c,m] -> [s,m] (reference einsum "sec,ecm->sm", :524)."""
+    return jnp.einsum("sec,ecm->sm",
+                      combine_weights.astype(expert_out.dtype), expert_out)

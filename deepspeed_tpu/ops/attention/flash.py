@@ -56,8 +56,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
-        if causal else True
+    run = _causal_valid(qi, ki, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -78,8 +77,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_prev = l_scr[:][:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # fully-masked rows (q_len > k_len prefill shapes): m_new stays at
+        # NEG_INF and exp(NEG_INF - NEG_INF) would be 1 — force p/alpha to 0
+        row_live = m_new > NEG_INF / 2
+        alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -149,8 +151,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
-        if causal else True
+    run = _causal_valid(qi, ki, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -169,7 +170,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        # fully-masked rows carry lse = NEG_INF; their p must be 0, not
+        # exp(s - NEG_INF)
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -194,8 +197,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    run = (qi * block_q + block_q - 1 + offset >= ki * block_k) \
-        if causal else True
+    run = _causal_valid(qi, ki, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -214,7 +216,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                                  # (bq, bk)
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # (bq, bk)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)

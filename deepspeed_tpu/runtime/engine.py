@@ -30,6 +30,7 @@ import time
 from typing import Any, Optional
 
 import flax.struct
+import flax.traverse_util
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -110,11 +111,16 @@ class DeepSpeedEngine:
             self.tx = client_optimizer
             self._base_lr = float(opt_cfg.params.get("lr", 0.0)) \
                 if opt_cfg.params else 0.0
+            # a client optimizer owns its own hyperparams unless the client
+            # also handed us a schedule to drive
+            self._drive_lr = lr_scheduler is not None or \
+                (self._config.scheduler.type is not None)
         else:
             self.optimizer_name = opt_cfg.type or "adamw"
             self.tx, self._base_lr = build_optimizer(
                 self.optimizer_name, opt_cfg.params,
                 gradient_clipping=self._config.gradient_clipping)
+            self._drive_lr = True
 
         # lr schedule
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -179,14 +185,30 @@ class DeepSpeedEngine:
         return (self.micro_steps + 1) % self.gas == 0
 
     def _default_loss_fn(self):
-        """Default contract: module(input_ids) -> logits, next-token CE."""
+        """Default contract: module(input_ids) -> logits, next-token CE.
+        MoE aux losses sown under "intermediates" (moe/layer.py) are added
+        with the model's `moe_loss_coef` (reference adds l_aux in the client
+        loss; the engine folds it in for the default path)."""
         from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
         module = self.module
+        coef = getattr(getattr(module, "cfg", None), "moe_loss_coef", None)
+        moe_coef = 0.01 if coef is None else float(coef)
 
         def loss_fn(params, batch, rng):
-            logits = module.apply({"params": params}, batch["input_ids"],
-                                  rngs={"dropout": rng} if rng is not None else None)
-            return gpt2_loss_fn(logits, batch)
+            logits, mut = module.apply(
+                {"params": params}, batch["input_ids"],
+                rngs={"dropout": rng} if rng is not None else None,
+                mutable=["intermediates"])
+            loss = gpt2_loss_fn(logits, batch)
+            aux = [v for path, v in
+                   flax.traverse_util.flatten_dict(
+                       mut.get("intermediates", {})).items()
+                   if path[-1] == "moe_aux_loss"]
+            if aux:
+                # sow stores a tuple per call site
+                terms = [jnp.asarray(x) for tup in aux for x in tup]
+                loss = loss + moe_coef * sum(terms)
+            return loss
 
         return loss_fn
 
@@ -311,6 +333,7 @@ class DeepSpeedEngine:
         tx = self.tx
         clip_norm = float(self._config.gradient_clipping or 0.0)
         predivide = float(self._config.gradient_predivide_factor or 1.0)
+        drive_lr = self._drive_lr
 
         def cast(p):
             return jax.tree.map(
@@ -346,7 +369,9 @@ class DeepSpeedEngine:
 
             opt_state = state.opt_state
             # drive the LR schedule value into inject_hyperparams state
-            if hasattr(opt_state, "hyperparams"):
+            # (skipped for a client optimizer with no schedule: its own
+            # hyperparams stand)
+            if drive_lr and hasattr(opt_state, "hyperparams"):
                 hp = dict(opt_state.hyperparams)
                 hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
                 opt_state = opt_state._replace(hyperparams=hp)

@@ -38,6 +38,24 @@ def test_forward_cross_attention_lengths():
                                atol=2e-3, rtol=2e-3)
 
 
+def test_fully_masked_rows_zero_fwd_and_bwd():
+    # causal with q_len > k_len: leading query rows attend to nothing; the
+    # kernel must emit zeros (and zero grads), not exp(-inf - -inf) garbage
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 256, 1, 32, k_len=128)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    n_masked = 256 - 128  # offset = k_len - q_len = -128
+    np.testing.assert_allclose(np.asarray(out[:, :n_masked]), 0.0)
+    ref = mha_reference  # live rows still match the oracle
+    np.testing.assert_allclose(
+        np.asarray(out[:, n_masked:]),
+        np.asarray(ref(q, k, v, causal=True)[:, n_masked:]),
+        atol=2e-3, rtol=2e-3)
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g[:, :n_masked]), 0.0)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_backward_matches_reference(causal):
     b, l, h, d = 1, 256, 2, 32

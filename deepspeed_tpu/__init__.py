@@ -51,8 +51,10 @@ def init_inference(model=None, config=None, **kwargs):
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 
+    params = kwargs.pop("params", None)
+    mesh = kwargs.pop("mesh_obj", None)
     if isinstance(config, DeepSpeedInferenceConfig):
-        cfg = config
+        cfg = config.model_copy(update=kwargs) if kwargs else config
     else:
         if isinstance(config, str):
             import json
@@ -61,7 +63,7 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config or {})
         merged.update(kwargs)
         cfg = DeepSpeedInferenceConfig(**merged)
-    return InferenceEngine(model, cfg)
+    return InferenceEngine(model, cfg, params=params, mesh=mesh)
 
 
 def add_config_arguments(parser):

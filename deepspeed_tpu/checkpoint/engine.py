@@ -62,3 +62,32 @@ def load_state(path, target_state, mesh=None):
         with open(cs) as fh:
             client = json.load(fh)
     return state, client
+
+
+def load_subtree(path, target, prefix=""):
+    """Restore a subtree of a saved state into `target` (same structure),
+    re-applying each target leaf's sharding/dtype. `prefix` addresses the
+    subtree inside the saved pytree (e.g. ".params" for the TrainState's
+    parameter branch) — the engine-side half of the reference's
+    universal-checkpoint param-fragment loading
+    (deepspeed/checkpoint/universal_checkpoint.py:12)."""
+    f = os.path.join(path, "model_states.npz")
+    if not os.path.exists(f):
+        raise FileNotFoundError(f"checkpoint file not found: {f}")
+    data = np.load(f, allow_pickle=False)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    new = []
+    for path_k, leaf in flat:
+        key = prefix + jax.tree_util.keystr(path_k)
+        if key not in data:
+            raise KeyError(f"checkpoint missing entry {key}")
+        arr = data[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"shape mismatch for {key}: checkpoint "
+                             f"{arr.shape} vs target {np.shape(leaf)}")
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            new.append(jax.device_put(arr.astype(leaf.dtype), sharding))
+        else:
+            new.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new)

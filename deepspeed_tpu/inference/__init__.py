@@ -1,0 +1,4 @@
+"""Inference stack (reference: deepspeed/inference/)."""
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401

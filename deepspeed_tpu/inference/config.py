@@ -1,0 +1,75 @@
+"""Inference config (reference: ``deepspeed/inference/config.py:128``
+``DeepSpeedInferenceConfig`` + ``DeepSpeedTPConfig`` :49, ``DeepSpeedMoEConfig``
+:67, quant config :114).
+
+Same JSON/kwargs surface; TPU semantics: `tensor_parallel.tp_size` becomes
+the `model` mesh axis size, dtype becomes the compute dtype, and
+`replace_with_kernel_inject` selects the Pallas attention path (on TPU the
+"kernel injection" decision is just an attention-impl flag — the model is
+already native).
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class DeepSpeedTPConfig(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: Any = 1
+    type: str = "standard"
+
+
+class QuantizationConfig(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+
+
+class InferenceCheckpointConfig(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(BaseModel):
+    """Mirrors the reference's field surface (inference/config.py:128)."""
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: str = "bfloat16"            # torch.* names accepted via validator
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Any] = None
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    max_batch_size: int = 1
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False    # accepted, no-op (XLA always compiles)
+    zero: Dict[str, Any] = Field(default_factory=dict)
+    triangular_masking: bool = True
+    return_tuple: bool = True
+    # TPU additions
+    mesh: Optional[Dict[str, int]] = None
+    kv_cache_dtype: str = "bfloat16"
+
+    def model_post_init(self, _ctx):
+        # normalize torch-style dtype strings ("torch.float16", "fp16", "half")
+        name = str(self.dtype).lower().replace("torch.", "")
+        aliases = {"half": "float16", "fp16": "float16", "bf16": "bfloat16",
+                   "float": "float32", "fp32": "float32", "int8": "int8"}
+        object.__setattr__(self, "dtype", aliases.get(name, name))

@@ -1,0 +1,283 @@
+"""InferenceEngine: TPU-native serving wrapper.
+
+Reference: ``deepspeed/inference/engine.py:37`` — dtype conversion :422, TP
+group creation :198, kernel injection :321, CUDA-graph capture :437,
+``forward`` :497, generate wrapper :525 with token-latency hooks :162-196.
+
+TPU redesign:
+  * "kernel injection" (`replace_transformer_layer`) becomes a no-op
+    decision: models are already native flax; `replace_with_kernel_inject`
+    toggles the Pallas flash path via the model's `attn_impl`.
+  * auto-TP (`module_inject/auto_tp.py`) becomes sharding: the same logical
+    axis rules shard qkv/mlp weights over the `model` mesh axis; the
+    row-parallel all-reduce the reference inserts as ``LinearAllreduce``
+    (module_inject/layers.py:15) is emitted by XLA at the matmul.
+  * CUDA-graph capture/replay is XLA compilation — always on.
+  * generation = jitted prefill (batch seq -> logits+cache) + jitted
+    single-token decode step, KV cache as a device-resident pytree.
+"""
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.parallel import sharding as shd
+from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.utils.logging import log_dist
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+          "float16": jnp.float16}
+
+
+def _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p):
+    """Next-token selection on [batch, vocab] logits, fully traced."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if temperature and temperature != 1.0:
+        logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class InferenceEngine:
+    """Wraps a flax module (+ params) for generation/serving."""
+
+    def __init__(self, model, config, params=None, mesh=None, seed=0):
+        self._config = config
+        self.module = model
+        self.mp_world_size = config.tensor_parallel.tp_size
+
+        if mesh is None:
+            from deepspeed_tpu.runtime.config import MeshConfig
+            mcfg = config.mesh or {"data": -1,
+                                   "model": config.tensor_parallel.tp_size}
+            mesh = make_mesh(MeshConfig(**mcfg), allow_subset=True)
+        self.mesh = mesh
+        dist.set_mesh(mesh)
+
+        self.dtype = DTYPES.get(config.dtype, jnp.bfloat16)
+        self.kv_dtype = DTYPES.get(config.kv_cache_dtype, jnp.bfloat16)
+        self._rng = jax.random.PRNGKey(seed)
+        self._model_times = []
+        self.params = None
+        self._decode_fn = None
+        self._prefill_fn = None
+        self._fwd = None
+
+        # "kernel injection": route attention to the Pallas path via a fresh
+        # config (never mutate the caller's model — it may be live in a
+        # training engine). "auto" keeps the block-alignment guard.
+        cfg = getattr(model, "cfg", None)
+        if config.replace_with_kernel_inject and cfg is not None and \
+                getattr(cfg, "attn_impl", None) not in (None, "auto"):
+            import dataclasses
+            self.module = type(model)(dataclasses.replace(cfg,
+                                                          attn_impl="auto"))
+
+        if params is not None:
+            self.set_params(params)
+
+        ckpt = config.checkpoint
+        if isinstance(ckpt, dict):
+            ckpt = ckpt.get("checkpoint_dir") or ckpt.get("base_dir")
+        elif hasattr(ckpt, "checkpoint_dir"):
+            ckpt = ckpt.checkpoint_dir or getattr(ckpt, "base_dir", None)
+        if isinstance(ckpt, str):
+            self.load_checkpoint(ckpt)
+        elif config.checkpoint is not None and ckpt is None:
+            raise ValueError(
+                f"unusable checkpoint config: {config.checkpoint!r} "
+                "(expected a path or {'checkpoint_dir': path})")
+
+    # ------------------------------------------------------------------ params
+    def _param_shardings(self, params):
+        logical = shd.get_logical_specs(params)   # from Partitioned metadata
+        unboxed = shd.unbox(params)
+        shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), self.dtype), unboxed)
+        pspecs = shd.tree_pspecs(self.mesh, shapes, logical, zero_stage=0,
+                                 kind="param")
+        return shd.tree_shardings(self.mesh, pspecs)
+
+    def set_params(self, params):
+        """Cast to inference dtype and shard over the mesh (the reference's
+        _convert_to_dtype + ReplaceWithTensorSlicing combined)."""
+        sh = self._param_shardings(params)     # needs Partitioned metadata
+        params = shd.unbox(params)
+        cast = jax.jit(
+            lambda p: jax.tree.map(
+                lambda x: x.astype(self.dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+                p),
+            out_shardings=sh)
+        self.params = cast(params)
+        n = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(self.params))
+        log_dist(f"inference params ready: {n/1e6:.1f}M, dtype={self._config.dtype}, "
+                 f"tp={self.mp_world_size}", ranks=[0])
+        return self
+
+    def init_params(self, example_ids=None, seed=0):
+        """Random init (benchmarks / smoke tests)."""
+        ids = example_ids if example_ids is not None \
+            else jnp.zeros((1, 8), jnp.int32)
+        variables = self.module.init(jax.random.PRNGKey(seed),
+                                     jnp.asarray(ids))
+        return self.set_params(variables.get("params", variables))
+
+    def load_checkpoint(self, path, tag=None):
+        """Load params saved by the training engine's save_checkpoint."""
+        import os
+        from deepspeed_tpu.checkpoint.engine import load_subtree
+        if tag is None:
+            latest = os.path.join(path, "latest")
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+        full = os.path.join(path, tag) if tag else path
+        if self.params is None:
+            self.init_params()
+        # restore only the params subtree of the saved TrainState
+        self.params = load_subtree(full, self.params, prefix=".params")
+        log_dist(f"inference checkpoint loaded from {full}", ranks=[0])
+        return self
+
+    # ----------------------------------------------------------------- forward
+    def forward(self, input_ids, **kwargs):
+        """Full forward -> logits (reference engine.forward :497)."""
+        assert self.params is not None, "set_params/init_params first"
+        if self._fwd is None:
+            module = self.module
+
+            def fwd(params, ids):
+                return module.apply({"params": params}, ids)
+
+            self._fwd = jax.jit(fwd)
+        t0 = time.time()
+        out = self._fwd(self.params, jnp.asarray(input_ids))
+        out.block_until_ready()
+        self._model_times.append(time.time() - t0)
+        return out
+
+    __call__ = forward
+
+    def model_times(self):
+        """Per-call latencies (reference token-latency hooks :162-196)."""
+        t, self._model_times = self._model_times, []
+        return t
+
+    # ---------------------------------------------------------------- generate
+    def _supports_cache(self):
+        from deepspeed_tpu.models.llama import Llama
+        return isinstance(self.module, Llama)
+
+    def _build_gen_fns(self, max_len):
+        module = self.module
+        kv_dtype = self.kv_dtype
+
+        def prefill(params, ids, cache):
+            logits, cache = module.apply({"params": params}, ids, cache=cache)
+            return logits[:, -1], cache
+
+        def decode(params, tok, cache, rng, do_sample, temperature, top_k,
+                   top_p):
+            logits, cache = module.apply({"params": params}, tok[:, None],
+                                         cache=cache)
+            nxt = _sample_tokens(logits[:, 0], rng, do_sample, temperature,
+                                 top_k, top_p)
+            return nxt, cache
+
+        self._prefill_fn = jax.jit(prefill, donate_argnums=(2,))
+        # sampling params static: new compile per (do_sample, temp, k, p) combo
+        self._decode_fn = jax.jit(decode, donate_argnums=(2,),
+                                  static_argnums=(4, 5, 6, 7))
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 max_length=None, **kwargs):
+        """Autoregressive generation with device-resident KV cache."""
+        assert self.params is not None, "set_params/init_params first"
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        if max_length is not None:
+            max_new_tokens = max(int(max_length) - prompt_len, 0)
+        if max_new_tokens == 0:
+            return ids
+        max_len = prompt_len + max_new_tokens
+
+        if not self._supports_cache():
+            return self._generate_nocache(ids, max_new_tokens, do_sample,
+                                          temperature, top_k, top_p,
+                                          eos_token_id)
+
+        from deepspeed_tpu.models.llama import init_kv_cache
+        cache = init_kv_cache(self.module.cfg, b, max_len=max_len,
+                              dtype=self.kv_dtype)
+        if self._prefill_fn is None:
+            self._build_gen_fns(max_len)
+
+        t0 = time.time()
+        logits, cache = self._prefill_fn(self.params, jnp.asarray(ids), cache)
+        self._rng, rng = jax.random.split(self._rng)
+        tok = _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p)
+        out = [np.asarray(jax.device_get(tok))]
+        self._model_times.append(time.time() - t0)
+
+        finished = np.zeros(b, bool)
+        for _ in range(max_new_tokens - 1):
+            t0 = time.time()
+            self._rng, rng = jax.random.split(self._rng)
+            tok, cache = self._decode_fn(self.params, tok, cache, rng,
+                                         bool(do_sample), float(temperature),
+                                         int(top_k), float(top_p))
+            host_tok = np.asarray(jax.device_get(tok))
+            self._model_times.append(time.time() - t0)
+            out.append(host_tok)
+            if eos_token_id is not None:
+                finished |= host_tok == eos_token_id
+                if finished.all():
+                    break
+        gen = np.stack(out, axis=1)
+        return np.concatenate([ids, gen], axis=1)
+
+    def _generate_nocache(self, ids, max_new_tokens, do_sample, temperature,
+                          top_k, top_p, eos_token_id):
+        """Fallback for models without a KV-cache contract: full re-forward
+        per token (correct, O(n^2); the reference non-injected path)."""
+        module = self.module
+
+        if self._fwd is None:
+            self._fwd = jax.jit(
+                lambda params, ids: module.apply({"params": params}, ids))
+        cur = jnp.asarray(ids)
+        b = cur.shape[0]
+        finished = np.zeros(b, bool)
+        for _ in range(max_new_tokens):
+            logits = self._fwd(self.params, cur)
+            self._rng, rng = jax.random.split(self._rng)
+            tok = _sample_tokens(logits[:, -1], rng, do_sample, temperature,
+                                 top_k, top_p)
+            cur = jnp.concatenate([cur, tok[:, None]], axis=1)
+            if eos_token_id is not None:
+                finished |= np.asarray(jax.device_get(tok)) == eos_token_id
+                if finished.all():
+                    break
+        return np.asarray(jax.device_get(cur))

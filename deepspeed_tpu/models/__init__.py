@@ -1,0 +1,20 @@
+"""Model families (flax, logical-axis partitioned).
+
+Reference counterparts: the HF architectures deepspeed's inference policy
+registry covers (module_inject/replace_policy.py: BERT/GPT2/GPT-J/NeoX/
+OPT/BLOOM/...) plus the training fixtures (tests/unit/simple_model.py,
+tests/unit/modeling.py). Here each family is a native flax model whose
+params carry logical axis names, so TP/FSDP/EP are sharding-rule choices.
+"""
+
+from deepspeed_tpu.models.gpt2 import (GPT2, GPTConfig, gpt2_loss_fn,  # noqa: F401
+                                       gpt2_small, gpt2_tiny)
+from deepspeed_tpu.models.llama import (Llama, LlamaConfig,  # noqa: F401
+                                        init_kv_cache, llama2_7b,
+                                        llama2_70b, llama_tiny)
+from deepspeed_tpu.models.bert import (Bert, BertConfig,  # noqa: F401
+                                       bert_large, bert_mlm_loss_fn,
+                                       bert_tiny)
+
+# generic causal-LM loss: gpt2's implementation is model-agnostic
+causal_lm_loss_fn = gpt2_loss_fn

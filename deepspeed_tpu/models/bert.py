@@ -1,0 +1,182 @@
+"""BERT-family bidirectional encoder, TPU-first.
+
+Reference anchor: the transformer-kernel test models
+(`tests/unit/modeling.py`, ~2400 LoC BERT impl) and
+``DeepSpeedTransformerLayer`` (ops/transformer/transformer.py:296) — the
+reference's "fastest BERT" training benchmark model (BASELINE.md row 1).
+Same logical-axis partitioning as models/gpt2.py; attention is the shared
+oracle/flash pair with ``causal=False``.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention.reference import mha_reference
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "auto"
+    pre_layer_norm: bool = True        # reference kernel supports both
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def _dense(cfg, features, axes, name):
+    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    kernel_init=nn.with_partitioning(
+                        nn.initializers.normal(0.02), axes),
+                    name=name)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic=True):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        h, d = cfg.num_heads, cfg.head_dim
+        qkv = _dense(cfg, 3 * cfg.hidden_size, ("embed", "kv"), "qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, h, d)
+        k = k.reshape(b, l, h, d)
+        v = v.reshape(b, l, h, d)
+        bias = None
+        if attention_mask is not None:
+            # [b, l] 1/0 mask -> additive [b, 1, 1, l]
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             jnp.finfo(jnp.float32).min)
+        impl = cfg.attn_impl
+        if impl == "auto":
+            impl = "flash" if (jax.default_backend() == "tpu" and
+                               l % 128 == 0) else "reference"
+        if bias is not None:
+            impl = "reference"  # flash kernel has no bias support yet
+        if impl == "flash":
+            from deepspeed_tpu.ops.attention import flash_attention
+            out = flash_attention(q, k, v, causal=False)
+        else:
+            out = mha_reference(q, k, v, causal=False, bias=bias)
+        out = out.reshape(b, l, cfg.hidden_size)
+        out = _dense(cfg, cfg.hidden_size, ("heads", "embed"), "proj")(out)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic=True):
+        cfg = self.cfg
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           name="ln_attn")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           name="ln_mlp")
+        attn = BertSelfAttention(cfg, name="attn")
+        if cfg.pre_layer_norm:
+            x = x + attn(ln1(x), attention_mask, deterministic)
+            h = ln2(x)
+        else:
+            x = ln1(x + attn(x, attention_mask, deterministic))
+            h = x
+        h = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "fc_in")(h)
+        h = nn.gelu(h)
+        h = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "fc_out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        if cfg.pre_layer_norm:
+            return x + h
+        return ln2(x + h)
+
+
+class Bert(nn.Module):
+    """Returns MLM logits [b, l, vocab] (the pretraining objective the
+    reference's BERT benchmarks train)."""
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True, attention_mask=None,
+                 token_type_ids=None):
+        cfg = self.cfg
+        b, l = input_ids.shape
+        wte = self.param("word_embeddings", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wpe = self.param("position_embeddings", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        wtt = self.param("token_type_embeddings", nn.with_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte_v = wte.value if hasattr(wte, "value") else wte
+        wpe_v = wpe.value if hasattr(wpe, "value") else wpe
+        wtt_v = wtt.value if hasattr(wtt, "value") else wtt
+
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (wte_v.astype(cfg.dtype)[input_ids] +
+             wpe_v.astype(cfg.dtype)[jnp.arange(l)][None] +
+             wtt_v.astype(cfg.dtype)[token_type_ids])
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_embed")(x)
+
+        layer = BertLayer
+        if cfg.remat:
+            layer = nn.remat(BertLayer, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, attention_mask,
+                                              deterministic)
+
+        # MLM head: transform + tied decoder (HF BertLMPredictionHead shape)
+        h = _dense(cfg, cfg.hidden_size, ("embed", "embed"), "mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_ln")(h)
+        logits = jnp.einsum("ble,ve->blv", h, wte_v.astype(cfg.dtype))
+        return logits
+
+
+def bert_mlm_loss_fn(logits, batch):
+    """Masked-LM cross entropy; labels -100 = unmasked (ignored)."""
+    labels = batch["labels"]
+    logits = logits.astype(jnp.float32)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = (logz - ll) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def bert_tiny(**overrides):
+    kwargs = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                  intermediate_size=128, max_seq_len=128)
+    kwargs.update(overrides)
+    return BertConfig(**kwargs)
+
+
+def bert_large(**overrides):
+    return BertConfig(vocab_size=30522, hidden_size=1024, num_layers=24,
+                      num_heads=16, intermediate_size=4096, max_seq_len=512,
+                      **overrides)

@@ -1,0 +1,242 @@
+"""Llama-family causal transformer (RMSNorm, RoPE, SwiGLU, GQA), TPU-first.
+
+This is the flagship for the north-star ZeRO-3 target (BASELINE.json:
+"Llama-2-70B on v5p-256") and the inference stack. Same logical-axis
+partitioning scheme as models/gpt2.py; reference parity targets
+deepspeed's Llama policy/containers (module_inject/containers/llama.py
+in later snapshots) re-designed as a native flax model.
+
+KV-cache decode is built in: ``__call__(ids, positions=..., cache=...)``
+returns ``(logits, new_cache)`` — the cache is a plain pytree updated with
+``lax.dynamic_update_slice`` so single-token decode jits to the
+``softmax_context`` equivalent (reference csrc/transformer/inference).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.attention.reference import (apply_rotary_emb,
+                                                   decode_attention_reference,
+                                                   mha_reference)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32            # < num_heads => GQA
+    intermediate_size: int = 11008
+    max_seq_len: int = 4096
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "auto"
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.with_partitioning(
+            nn.initializers.ones_init(), ("embed",)), (x.shape[-1],),
+            jnp.float32)
+        scale = scale.value if hasattr(scale, "value") else scale
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = x.astype(jnp.float32) * lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def _proj(cfg, features, axes, name):
+    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.with_partitioning(
+                        nn.initializers.normal(0.02), axes),
+                    name=name)
+
+
+def _repeat_kv(x, n_rep):
+    """[b, l, kv_heads, d] -> [b, l, kv_heads*n_rep, d] (GQA expansion)."""
+    if n_rep == 1:
+        return x
+    b, l, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None], (b, l, h, n_rep, d)) \
+        .reshape(b, l, h * n_rep, d)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        h, kv_h, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        q = _proj(cfg, h * d, ("embed", "heads"), "wq")(x)
+        k = _proj(cfg, kv_h * d, ("embed", "kv"), "wk")(x)
+        v = _proj(cfg, kv_h * d, ("embed", "kv"), "wv")(x)
+        q = q.reshape(b, l, h, d)
+        k = k.reshape(b, l, kv_h, d)
+        v = v.reshape(b, l, kv_h, d)
+        q = apply_rotary_emb(q, positions, base=cfg.rope_base)
+        k = apply_rotary_emb(k, positions, base=cfg.rope_base)
+
+        new_cache = None
+        if cache is not None:
+            # decode: append k/v at cache["index"], attend over valid prefix
+            k_cache = lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0))
+            new_cache = {"k": k_cache, "v": v_cache,
+                         "index": cache["index"] + l}
+            k_full = _repeat_kv(k_cache, h // kv_h)
+            v_full = _repeat_kv(v_cache, h // kv_h)
+            # attend over the whole cache buffer with a positional mask:
+            # slot j is visible to query at absolute position p iff j <= p
+            # (cache["index"] is traced, so no dynamic slicing)
+            max_len = k_cache.shape[1]
+            k_pos = jnp.arange(max_len)
+            mask = k_pos[None, None, :] <= positions[:, :, None]  # [b,l,max]
+            bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)
+            out = mha_reference(q, k_full, v_full, causal=False,
+                                bias=bias[:, None])
+
+        else:
+            k_full = _repeat_kv(k, h // kv_h)
+            v_full = _repeat_kv(v, h // kv_h)
+            impl = cfg.attn_impl
+            if impl == "auto":
+                impl = "flash" if (jax.default_backend() == "tpu" and
+                                   l % 128 == 0) else "reference"
+            if impl == "flash":
+                from deepspeed_tpu.ops.attention import flash_attention
+                out = flash_attention(q, k_full, v_full, causal=True)
+            else:
+                out = mha_reference(q, k_full, v_full, causal=True)
+
+        out = out.reshape(b, l, h * d)
+        out = _proj(cfg, cfg.hidden_size, ("heads", "embed"), "wo")(out)
+        return out, new_cache
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _proj(cfg, cfg.intermediate_size, ("embed", "mlp"), "w_gate")(x)
+        up = _proj(cfg, cfg.intermediate_size, ("embed", "mlp"), "w_up")(x)
+        h = nn.silu(gate) * up
+        return _proj(cfg, cfg.hidden_size, ("mlp", "embed"), "w_down")(h)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None):
+        cfg = self.cfg
+        attn_out, new_cache = LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x),
+            positions, cache)
+        x = x + attn_out
+        x = x + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x))
+        return x, new_cache
+
+
+class Llama(nn.Module):
+    """Returns logits [b, l, vocab]; with ``cache`` returns (logits, cache)."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True, positions=None,
+                 cache=None):
+        cfg = self.cfg
+        b, l = input_ids.shape
+        if positions is None:
+            if cache is not None:
+                start = cache["layers"][0]["index"]
+                positions = start + jnp.arange(l)[None, :]
+                positions = jnp.broadcast_to(positions, (b, l))
+            else:
+                positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+
+        embed = self.param("embed_tokens", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        embed_v = embed.value if hasattr(embed, "value") else embed
+        x = embed_v.astype(cfg.dtype)[input_ids]
+
+        block = LlamaBlock
+        if cfg.remat and cache is None:
+            # cache=None is an empty pytree, safe through remat
+            block = nn.remat(LlamaBlock, prevent_cse=False)
+        new_layer_caches = []
+        for i in range(cfg.num_layers):
+            layer_cache = cache["layers"][i] if cache is not None else None
+            x, new_c = block(cfg, name=f"layers_{i}")(x, positions,
+                                                      layer_cache)
+            new_layer_caches.append(new_c)
+
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("ble,ve->blv", x, embed_v.astype(cfg.dtype))
+        else:
+            logits = _proj(cfg, cfg.vocab_size, ("embed", "vocab"),
+                           "lm_head")(x)
+        if cache is not None:
+            return logits, {"layers": new_layer_caches}
+        return logits
+
+
+def init_kv_cache(cfg: LlamaConfig, batch_size, max_len=None,
+                  dtype=jnp.bfloat16):
+    """Empty KV cache pytree (reference inference_context.h workspace)."""
+    max_len = max_len or cfg.max_seq_len
+    layer = lambda: {
+        "k": jnp.zeros((batch_size, max_len, cfg.num_kv_heads, cfg.head_dim),
+                       dtype),
+        "v": jnp.zeros((batch_size, max_len, cfg.num_kv_heads, cfg.head_dim),
+                       dtype),
+        "index": jnp.int32(0),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}
+
+
+def llama_tiny(**overrides):
+    """Test-fixture scale (reference tests/unit/simple_model.py spirit)."""
+    kwargs = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, intermediate_size=128, max_seq_len=128)
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def llama2_7b(**overrides):
+    return LlamaConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=32, intermediate_size=11008,
+                       max_seq_len=4096, **overrides)
+
+
+def llama2_70b(**overrides):
+    return LlamaConfig(vocab_size=32000, hidden_size=8192, num_layers=80,
+                       num_heads=64, num_kv_heads=8, intermediate_size=28672,
+                       max_seq_len=4096, **overrides)

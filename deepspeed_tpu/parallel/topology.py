@@ -115,8 +115,12 @@ class PipeDataParallelTopology(ProcessTopology):
         super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
 
 
-def resolve_mesh_dims(mesh_config, n_devices):
-    """Resolve -1 on at most one axis to 'all remaining devices'."""
+def resolve_mesh_dims(mesh_config, n_devices, allow_subset=False):
+    """Resolve -1 on at most one axis to 'all remaining devices'.
+
+    `allow_subset=True` (inference) permits a mesh smaller than the host's
+    device count; training keeps the strict all-devices check so a
+    mis-sized config fails loudly instead of silently idling chips."""
     sizes = {ax: getattr(mesh_config, ax, 1) or 1 for ax in MESH_AXES}
     wild = [ax for ax, s in sizes.items() if s == -1]
     if len(wild) > 1:
@@ -128,13 +132,13 @@ def resolve_mesh_dims(mesh_config, n_devices):
                 f"device count {n_devices} not divisible by fixed axes product {fixed}")
         sizes[wild[0]] = n_devices // fixed
     total = int(np.prod(list(sizes.values())))
-    if total != n_devices:
+    if total > n_devices or (total != n_devices and not allow_subset):
         raise ValueError(
             f"mesh {sizes} needs {total} devices but {n_devices} are available")
     return sizes
 
 
-def make_mesh(mesh_config=None, devices=None):
+def make_mesh(mesh_config=None, devices=None, allow_subset=False):
     """Build the global Mesh from a MeshConfig (or use all devices on `data`)."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
@@ -142,8 +146,10 @@ def make_mesh(mesh_config=None, devices=None):
         sizes = {ax: 1 for ax in MESH_AXES}
         sizes["data"] = n
     else:
-        sizes = resolve_mesh_dims(mesh_config, n)
+        sizes = resolve_mesh_dims(mesh_config, n, allow_subset=allow_subset)
     shape = tuple(sizes[ax] for ax in MESH_AXES)
+    total = int(np.prod(shape))
+    devices = list(devices)[:total]
     try:
         from jax.experimental import mesh_utils
         device_array = mesh_utils.create_device_mesh(shape, devices=devices)

@@ -1,0 +1,79 @@
+"""Llama family: shapes, GQA, KV-cache decode == full-forward oracle,
+engine training smoke."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import (Llama, init_kv_cache, llama_tiny)
+
+
+def test_forward_shape_and_finite():
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_gqa_param_shapes():
+    cfg = llama_tiny(num_heads=4, num_kv_heads=2)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    attn = params["layers_0"]["attn"]
+    d = cfg.head_dim
+    assert attn["wq"]["kernel"].value.shape == (cfg.hidden_size, 4 * d)
+    assert attn["wk"]["kernel"].value.shape == (cfg.hidden_size, 2 * d)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Incremental decode through the cache must reproduce the full causal
+    forward logits token-for-token (the reference softmax_context contract)."""
+    cfg = llama_tiny(num_layers=2)
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 10)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+
+    full_logits = model.apply({"params": params}, jnp.asarray(ids))
+
+    cache = init_kv_cache(cfg, batch_size=2, max_len=16, dtype=jnp.float32)
+    # prefill first 6 tokens, then decode one-by-one
+    logits_pre, cache = model.apply({"params": params},
+                                    jnp.asarray(ids[:, :6]), cache=cache)
+    np.testing.assert_allclose(np.asarray(logits_pre),
+                               np.asarray(full_logits[:, :6]),
+                               atol=1e-4, rtol=1e-4)
+    for t in range(6, 10):
+        step_logits, cache = model.apply({"params": params},
+                                         jnp.asarray(ids[:, t:t + 1]),
+                                         cache=cache)
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full_logits[:, t]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_llama_trains_with_engine():
+    model = Llama(llama_tiny())
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"data": 4, "model": 2},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gen = np.random.default_rng(0)
+    batch = {"input_ids": gen.integers(0, 256, size=(16, 32)).astype(np.int32)}
+    losses = []
+    for _ in range(8):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0], losses

@@ -54,7 +54,9 @@ def init_inference(model=None, config=None, **kwargs):
     params = kwargs.pop("params", None)
     mesh = kwargs.pop("mesh_obj", None)
     if isinstance(config, DeepSpeedInferenceConfig):
-        cfg = config.model_copy(update=kwargs) if kwargs else config
+        # re-validate so nested dicts/aliases in kwargs are coerced
+        cfg = DeepSpeedInferenceConfig(**{**config.model_dump(), **kwargs}) \
+            if kwargs else config
     else:
         if isinstance(config, str):
             import json

@@ -37,25 +37,7 @@ def save_state(path, state, client_state=None):
 
 def load_state(path, target_state, mesh=None):
     """Restore into the structure/shardings of `target_state`."""
-    f = os.path.join(path, "model_states.npz")
-    if not os.path.exists(f):
-        raise FileNotFoundError(f"checkpoint file not found: {f}")
-    data = np.load(f, allow_pickle=False)
-    names, leaves, treedef = _flatten_named(target_state)
-    new_leaves = []
-    for name, leaf in zip(names, leaves):
-        if name not in data:
-            raise KeyError(f"checkpoint missing entry {name}")
-        arr = data[name]
-        if tuple(arr.shape) != tuple(np.shape(leaf)):
-            raise ValueError(f"shape mismatch for {name}: checkpoint "
-                             f"{arr.shape} vs target {np.shape(leaf)}")
-        sharding = getattr(leaf, "sharding", None)
-        if sharding is not None:
-            new_leaves.append(jax.device_put(arr.astype(leaf.dtype), sharding))
-        else:
-            new_leaves.append(arr)
-    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    state = load_subtree(path, target_state, prefix="")
     client = {}
     cs = os.path.join(path, "client_state.json")
     if os.path.exists(cs):

@@ -70,10 +70,18 @@ class InferenceEngine:
                                    "model": config.tensor_parallel.tp_size}
             mesh = make_mesh(MeshConfig(**mcfg), allow_subset=True)
         self.mesh = mesh
-        dist.set_mesh(mesh)
+        # don't clobber a live training engine's global mesh; shardings here
+        # use self.mesh explicitly
+        if dist.get_mesh() is None:
+            dist.set_mesh(mesh)
 
-        self.dtype = DTYPES.get(config.dtype, jnp.bfloat16)
-        self.kv_dtype = DTYPES.get(config.kv_cache_dtype, jnp.bfloat16)
+        if config.dtype not in DTYPES:
+            raise ValueError(
+                f"unsupported inference dtype {config.dtype!r}; pick one of "
+                f"{sorted(DTYPES)} (int8 weight quantization is configured "
+                "via the quant section, not dtype)")
+        self.dtype = DTYPES[config.dtype]
+        self.kv_dtype = DTYPES[config.kv_cache_dtype]
         self._rng = jax.random.PRNGKey(seed)
         self._model_times = []
         self.params = None
@@ -160,17 +168,19 @@ class InferenceEngine:
 
     # ----------------------------------------------------------------- forward
     def forward(self, input_ids, **kwargs):
-        """Full forward -> logits (reference engine.forward :497)."""
+        """Full forward -> logits (reference engine.forward :497). Extra
+        kwargs (attention_mask, token_type_ids, ...) reach the module."""
         assert self.params is not None, "set_params/init_params first"
         if self._fwd is None:
             module = self.module
 
-            def fwd(params, ids):
-                return module.apply({"params": params}, ids)
+            def fwd(params, ids, **kw):
+                return module.apply({"params": params}, ids, **kw)
 
             self._fwd = jax.jit(fwd)
         t0 = time.time()
-        out = self._fwd(self.params, jnp.asarray(input_ids))
+        kwargs = {k: jnp.asarray(v) for k, v in kwargs.items()}
+        out = self._fwd(self.params, jnp.asarray(input_ids), **kwargs)
         out.block_until_ready()
         self._model_times.append(time.time() - t0)
         return out
@@ -187,9 +197,8 @@ class InferenceEngine:
         from deepspeed_tpu.models.llama import Llama
         return isinstance(self.module, Llama)
 
-    def _build_gen_fns(self, max_len):
+    def _build_gen_fns(self):
         module = self.module
-        kv_dtype = self.kv_dtype
 
         def prefill(params, ids, cache):
             logits, cache = module.apply({"params": params}, ids, cache=cache)
@@ -213,6 +222,11 @@ class InferenceEngine:
                  max_length=None, **kwargs):
         """Autoregressive generation with device-resident KV cache."""
         assert self.params is not None, "set_params/init_params first"
+        if kwargs:
+            raise TypeError(
+                f"generate() got unsupported arguments {sorted(kwargs)}; "
+                "supported: max_new_tokens, do_sample, temperature, top_k, "
+                "top_p, eos_token_id, max_length")
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
@@ -222,6 +236,11 @@ class InferenceEngine:
         if max_new_tokens == 0:
             return ids
         max_len = prompt_len + max_new_tokens
+        if max_len > self._config.max_out_tokens:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_out_tokens={self._config.max_out_tokens}; "
+                "raise max_out_tokens in the inference config")
 
         if not self._supports_cache():
             return self._generate_nocache(ids, max_new_tokens, do_sample,
@@ -232,7 +251,7 @@ class InferenceEngine:
         cache = init_kv_cache(self.module.cfg, b, max_len=max_len,
                               dtype=self.kv_dtype)
         if self._prefill_fn is None:
-            self._build_gen_fns(max_len)
+            self._build_gen_fns()
 
         t0 = time.time()
         logits, cache = self._prefill_fn(self.params, jnp.asarray(ids), cache)

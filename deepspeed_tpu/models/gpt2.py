@@ -86,6 +86,14 @@ class SelfAttention(nn.Module):
         if impl == "flash":
             from deepspeed_tpu.ops.attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
+        elif impl in ("ring", "ulysses"):
+            # sequence/context parallelism over the `sequence` mesh axis
+            from deepspeed_tpu import comm as dist
+            from deepspeed_tpu.sequence import DistributedAttention
+            mesh = dist.get_mesh()
+            assert mesh is not None and mesh.shape.get("sequence", 1) > 1, \
+                f"attn_impl={impl} needs a mesh with a sequence axis > 1"
+            out = DistributedAttention(mesh, impl=impl)(q, k, v)
         else:
             out = mha_reference(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.hidden_size)

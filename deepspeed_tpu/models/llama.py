@@ -128,6 +128,14 @@ class LlamaAttention(nn.Module):
             if impl == "flash":
                 from deepspeed_tpu.ops.attention import flash_attention
                 out = flash_attention(q, k_full, v_full, causal=True)
+            elif impl in ("ring", "ulysses"):
+                from deepspeed_tpu import comm as dist
+                from deepspeed_tpu.sequence import DistributedAttention
+                mesh = dist.get_mesh()
+                assert mesh is not None and \
+                    mesh.shape.get("sequence", 1) > 1, \
+                    f"attn_impl={impl} needs a sequence mesh axis > 1"
+                out = DistributedAttention(mesh, impl=impl)(q, k_full, v_full)
             else:
                 out = mha_reference(q, k_full, v_full, causal=True)
 

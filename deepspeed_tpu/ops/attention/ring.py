@@ -1,0 +1,105 @@
+"""Ring attention: context parallelism over the `sequence` mesh axis.
+
+Fills the reference's long-context gap (SURVEY.md §5.7: v0.8.3 has no ring
+attention / context parallelism — only block-sparse kernels). Design is the
+blockwise-attention ring of Liu et al. (Ring Attention) mapped to the TPU
+ICI torus: every device holds one sequence chunk of q/k/v; k/v chunks hop
+around the ring via ``lax.ppermute`` while each device accumulates online
+softmax statistics for its local queries — so peak memory is O(L/P) per
+device and the N^2 score matrix never materializes.
+
+Causality is handled by absolute chunk offsets: a device skips nothing
+structurally (static schedule), it just masks chunks ahead of its queries.
+
+Used inside ``shard_map`` over the `sequence` axis;
+:func:`ring_attention_sharded` wraps that for [b, l, h, d] global arrays.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+NEG_INF = float(-1e30)
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
+    """Per-shard body (call under shard_map, sequence-sharded on dim 1).
+
+    q/k/v: [b, chunk, h, d] local chunks. Returns [b, chunk, h, d].
+    """
+    b, chunk, h, d = q.shape
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my_idx * chunk + jnp.arange(chunk)            # absolute positions
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        # k_cur originated on device (my_idx - i) mod n
+        src = (my_idx - i) % n
+        k_pos = src * chunk + jnp.arange(chunk)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]       # [chunk, chunk]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1)                       # [b, h, q]
+        m_new = jnp.maximum(m, m_cur)
+        live = m_new > NEG_INF / 2
+        alpha = jnp.where(live, jnp.exp(m - m_new), 0.0)
+        p = jnp.where(live[..., None], jnp.exp(s - m_new[..., None]), 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * alpha[..., None] + pv
+
+        k_nxt = lax.ppermute(k_cur, axis_name, _ring_perm(n))
+        v_nxt = lax.ppermute(v_cur, axis_name, _ring_perm(n))
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    # derive initial carries from q so they inherit its device-varying axes
+    # (a plain jnp.zeros would be "unvarying" and trip shard_map's scan
+    # carry type check whenever extra mesh axes like `data` are manual)
+    qT = q32.transpose(0, 2, 1, 3)                        # [b, h, chunk, d]
+    m0 = jnp.full((b, h, chunk), NEG_INF, jnp.float32) + 0.0 * qT[..., 0]
+    l0 = 0.0 * qT[..., 0]
+    acc0 = 0.0 * qT
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
+                                    jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]                              # [b, h, q, d]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _bhd_spec(mesh, q_shape, axis):
+    """[b, l, h, d] spec composing with the data (batch) and model (heads)
+    axes when they exist and divide — so the op drops into an engine-jitted
+    program without forcing replication."""
+    def use(ax, dim):
+        return ax if ax in mesh.shape and mesh.shape[ax] > 1 and \
+            dim % mesh.shape[ax] == 0 else None
+    return P(use("data", q_shape[0]), axis, use("model", q_shape[2]), None)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, axis="sequence", causal=True,
+                           scale=None):
+    """Global entry: q/k/v [b, L, h, d] jax.Arrays; shards L over `axis`."""
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = _bhd_spec(mesh, q.shape, axis)
+    fn = functools.partial(ring_attention_local, axis_name=axis,
+                           causal=causal, scale=scale)
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
+    return sharded(q, k, v)

@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+DeepSpeed-Ulysses (postdates the reference snapshot; SURVEY.md §5.7 marks
+it as the gap to fill): attention inputs arrive sequence-sharded
+[b, L/P, h, d]; an all-to-all re-shards to head-sharded [b, L, h/P, d] so
+each device runs *full-sequence* attention on a subset of heads (any
+kernel works locally — including the Pallas flash kernel), then an inverse
+all-to-all restores sequence sharding. Communication volume is O(L·h·d/P)
+per device vs allgather's O(L·h·d).
+
+Requires num_heads % P == 0.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.attention.reference import mha_reference
+
+
+def ulysses_attention_local(q, k, v, axis_name, *, causal=True,
+                            attn_fn=None):
+    """Per-shard body (under shard_map; inputs [b, chunk, h, d])."""
+    attn_fn = attn_fn or (lambda q, k, v: mha_reference(q, k, v,
+                                                        causal=causal))
+
+    def seq_to_heads(x):
+        # [b, L/P, h, d] -> [b, L, h/P, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = attn_fn(qh, kh, vh)
+    return heads_to_seq(oh)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, axis="sequence", causal=True,
+                              attn_fn=None):
+    """Global entry: q/k/v [b, L, h, d]; shards L over `axis`, swaps to
+    heads for compute (DistributedAttention in deepspeed/sequence/layer.py
+    of later snapshots)."""
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+    assert q.shape[2] % n == 0, \
+        f"num_heads {q.shape[2]} must divide sequence axis size {n}"
+    from deepspeed_tpu.ops.attention.ring import _bhd_spec
+    spec = _bhd_spec(mesh, q.shape, axis)
+    if spec[2] is not None:
+        # heads already model-sharded: the per-shard head count must still
+        # divide the sequence axis for the all-to-all swap
+        assert (q.shape[2] // mesh.shape["model"]) % n == 0, \
+            "heads per model shard must divide the sequence axis size"
+    fn = functools.partial(ulysses_attention_local, axis_name=axis,
+                           causal=causal, attn_fn=attn_fn)
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
+    return sharded(q, k, v)

@@ -1,0 +1,33 @@
+"""Sequence/context parallelism (the reference's §5.7 gap, filled natively).
+
+``DistributedAttention`` mirrors the name later DeepSpeed gives its Ulysses
+layer (deepspeed/sequence/layer.py); here it dispatches to either the
+Ulysses all-to-all path or the ring-attention path over the `sequence`
+mesh axis.
+"""
+
+from deepspeed_tpu.ops.attention.ring import (ring_attention_local,  # noqa: F401
+                                              ring_attention_sharded)
+from deepspeed_tpu.ops.attention.ulysses import (  # noqa: F401
+    ulysses_attention_local, ulysses_attention_sharded)
+
+
+class DistributedAttention:
+    """Callable wrapper: DistributedAttention(mesh, impl=...)(q, k, v)."""
+
+    def __init__(self, mesh, *, axis="sequence", impl="ulysses", causal=True,
+                 attn_fn=None):
+        assert impl in ("ulysses", "ring"), impl
+        self.mesh = mesh
+        self.axis = axis
+        self.impl = impl
+        self.causal = causal
+        self.attn_fn = attn_fn
+
+    def __call__(self, q, k, v):
+        if self.impl == "ring":
+            return ring_attention_sharded(q, k, v, self.mesh, axis=self.axis,
+                                          causal=self.causal)
+        return ulysses_attention_sharded(q, k, v, self.mesh, axis=self.axis,
+                                         causal=self.causal,
+                                         attn_fn=self.attn_fn)

@@ -1,0 +1,55 @@
+"""End-to-end: GPT-2 trained with ring/Ulysses attention over a
+(data x sequence) mesh through the engine (context-parallel training)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_gpt2_trains_context_parallel(impl):
+    model = GPT2(gpt2_tiny(num_layers=2, attn_impl=impl))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": 2, "sequence": 4},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gen = np.random.default_rng(0)
+    batch = {"input_ids": gen.integers(0, 256, size=(4, 32)).astype(np.int32)}
+    losses = []
+    for _ in range(8):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_context_parallel_loss_matches_reference_impl():
+    """Same seed: ring-attention training step == reference-attention step."""
+    gen = np.random.default_rng(0)
+    batch = {"input_ids": gen.integers(0, 256, size=(4, 32)).astype(np.int32)}
+    losses = {}
+    for impl, mesh in (("reference", {"data": 8}),
+                       ("ring", {"data": 2, "sequence": 4})):
+        model = GPT2(gpt2_tiny(num_layers=2, attn_impl=impl))
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": mesh,
+            "steps_per_print": 1000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
+                                                   seed=0)
+        loss = engine.forward(batch)
+        losses[impl] = float(jax.device_get(loss))
+    np.testing.assert_allclose(losses["ring"], losses["reference"],
+                               rtol=1e-5)

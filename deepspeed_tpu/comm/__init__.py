@@ -5,9 +5,10 @@ from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tenso
                                      destroy_process_group, eager_collective,
                                      get_local_rank, get_mesh, get_rank,
                                      get_world_size, init_distributed,
-                                     is_initialized, log_summary, ppermute,
-                                     reduce, reduce_scatter, reduce_scatter_tensor,
-                                     send_recv_next, send_recv_prev, set_mesh)
+                                     is_initialized, log_summary, mesh_scope,
+                                     ppermute, reduce, reduce_scatter,
+                                     reduce_scatter_tensor, send_recv_next,
+                                     send_recv_prev, set_mesh)
 
 __all__ = [
     "ReduceOp", "all_gather", "all_gather_into_tensor", "all_reduce",
@@ -15,6 +16,6 @@ __all__ = [
     "barrier_eager", "broadcast", "comms_logger", "configure",
     "destroy_process_group", "eager_collective", "get_local_rank", "get_mesh",
     "get_rank", "get_world_size", "init_distributed", "is_initialized",
-    "log_summary", "ppermute", "reduce", "reduce_scatter",
+    "log_summary", "mesh_scope", "ppermute", "reduce", "reduce_scatter",
     "reduce_scatter_tensor", "send_recv_next", "send_recv_prev", "set_mesh",
 ]

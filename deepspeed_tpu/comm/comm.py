@@ -102,6 +102,28 @@ def get_mesh():
     return _WORLD_MESH
 
 
+class mesh_scope:
+    """Temporarily install `mesh` as the active global mesh. Used by the
+    inference engine so module internals (MoE constraints, sequence
+    parallelism, pipelines) trace against *its* mesh without clobbering a
+    live training engine's."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._saved = None
+
+    def __enter__(self):
+        global _WORLD_MESH
+        self._saved = _WORLD_MESH
+        _WORLD_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _WORLD_MESH
+        _WORLD_MESH = self._saved
+        return False
+
+
 def destroy_process_group(group=None):
     global _INITIALIZED, _WORLD_MESH
     _WORLD_MESH = None

@@ -70,16 +70,18 @@ class InferenceEngine:
                                    "model": config.tensor_parallel.tp_size}
             mesh = make_mesh(MeshConfig(**mcfg), allow_subset=True)
         self.mesh = mesh
-        # don't clobber a live training engine's global mesh; shardings here
-        # use self.mesh explicitly
+        # don't clobber a live training engine's global mesh; module
+        # internals see self.mesh via dist.mesh_scope around every trace
         if dist.get_mesh() is None:
             dist.set_mesh(mesh)
 
-        if config.dtype not in DTYPES:
-            raise ValueError(
-                f"unsupported inference dtype {config.dtype!r}; pick one of "
-                f"{sorted(DTYPES)} (int8 weight quantization is configured "
-                "via the quant section, not dtype)")
+        for field, val in (("dtype", config.dtype),
+                           ("kv_cache_dtype", config.kv_cache_dtype)):
+            if val not in DTYPES:
+                raise ValueError(
+                    f"unsupported inference {field} {val!r}; pick one of "
+                    f"{sorted(DTYPES)} (int8 weight quantization is "
+                    "configured via the quant section, not dtype)")
         self.dtype = DTYPES[config.dtype]
         self.kv_dtype = DTYPES[config.kv_cache_dtype]
         self._rng = jax.random.PRNGKey(seed)
@@ -169,18 +171,27 @@ class InferenceEngine:
     # ----------------------------------------------------------------- forward
     def forward(self, input_ids, **kwargs):
         """Full forward -> logits (reference engine.forward :497). Extra
-        kwargs (attention_mask, token_type_ids, ...) reach the module."""
+        kwargs reach the module: arrays are traced (attention_mask,
+        token_type_ids), python scalars/bools are static (deterministic)."""
         assert self.params is not None, "set_params/init_params first"
-        if self._fwd is None:
+        static = {k: v for k, v in kwargs.items()
+                  if isinstance(v, (bool, str)) or v is None}
+        arrays = {k: jnp.asarray(v) for k, v in kwargs.items()
+                  if k not in static}
+        key = tuple(sorted(static.items()))
+        if not hasattr(self, "_fwd_cache"):
+            self._fwd_cache = {}
+        if key not in self._fwd_cache:
             module = self.module
 
             def fwd(params, ids, **kw):
-                return module.apply({"params": params}, ids, **kw)
+                return module.apply({"params": params}, ids, **static, **kw)
 
-            self._fwd = jax.jit(fwd)
+            self._fwd_cache[key] = jax.jit(fwd)
         t0 = time.time()
-        kwargs = {k: jnp.asarray(v) for k, v in kwargs.items()}
-        out = self._fwd(self.params, jnp.asarray(input_ids), **kwargs)
+        with dist.mesh_scope(self.mesh):
+            out = self._fwd_cache[key](self.params, jnp.asarray(input_ids),
+                                       **arrays)
         out.block_until_ready()
         self._model_times.append(time.time() - t0)
         return out
@@ -254,7 +265,9 @@ class InferenceEngine:
             self._build_gen_fns()
 
         t0 = time.time()
-        logits, cache = self._prefill_fn(self.params, jnp.asarray(ids), cache)
+        with dist.mesh_scope(self.mesh):
+            logits, cache = self._prefill_fn(self.params, jnp.asarray(ids),
+                                             cache)
         self._rng, rng = jax.random.split(self._rng)
         tok = _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p)
         out = [np.asarray(jax.device_get(tok))]
@@ -264,9 +277,11 @@ class InferenceEngine:
         for _ in range(max_new_tokens - 1):
             t0 = time.time()
             self._rng, rng = jax.random.split(self._rng)
-            tok, cache = self._decode_fn(self.params, tok, cache, rng,
-                                         bool(do_sample), float(temperature),
-                                         int(top_k), float(top_p))
+            with dist.mesh_scope(self.mesh):
+                tok, cache = self._decode_fn(self.params, tok, cache, rng,
+                                             bool(do_sample),
+                                             float(temperature),
+                                             int(top_k), float(top_p))
             host_tok = np.asarray(jax.device_get(tok))
             self._model_times.append(time.time() - t0)
             out.append(host_tok)
@@ -290,7 +305,8 @@ class InferenceEngine:
         b = cur.shape[0]
         finished = np.zeros(b, bool)
         for _ in range(max_new_tokens):
-            logits = self._fwd(self.params, cur)
+            with dist.mesh_scope(self.mesh):
+                logits = self._fwd(self.params, cur)
             self._rng, rng = jax.random.split(self._rng)
             tok = _sample_tokens(logits[:, -1], rng, do_sample, temperature,
                                  top_k, top_p)

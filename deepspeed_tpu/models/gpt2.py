@@ -144,6 +144,40 @@ class Block(nn.Module):
         return x + h
 
 
+def _make_embed_tables(mdl, cfg):
+    """Create wte/wpe on `mdl` (shared by GPT2 and GPT2Embed so the init
+    scales and logical axis names live in exactly one place)."""
+    wte = mdl.param(
+        "wte",
+        nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+        (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+    wpe = mdl.param(
+        "wpe",
+        nn.with_partitioning(nn.initializers.normal(0.01), ("seq", "embed")),
+        (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+    wte_v = wte.value if hasattr(wte, "value") else wte
+    wpe_v = wpe.value if hasattr(wpe, "value") else wpe
+    return wte_v, wpe_v
+
+
+def _embed_tokens(wte_v, wpe_v, input_ids, cfg):
+    l = input_ids.shape[1]
+    return wte_v.astype(cfg.dtype)[input_ids] + \
+        wpe_v.astype(cfg.dtype)[jnp.arange(l)][None]
+
+
+def _head_logits(x, cfg, *, wte_v=None, dense_ctor=None):
+    """ln_f + LM projection; tied path multiplies by wte, untied builds a
+    lm_head Dense (caller supplies the constructors so params land on the
+    calling module)."""
+    x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+    if cfg.tie_embeddings:
+        assert wte_v is not None, "tied head needs the embedding table"
+        return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype))
+    return dense_ctor(cfg.vocab_size, cfg, ("embed", "vocab"),
+                      name="lm_head", use_bias=False)(x)
+
+
 class GPT2(nn.Module):
     """Returns logits [batch, len, vocab]."""
     cfg: GPTConfig
@@ -151,19 +185,8 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic=True):
         cfg = self.cfg
-        b, l = input_ids.shape
-        wte = self.param(
-            "wte",
-            nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
-            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
-        wpe = self.param(
-            "wpe",
-            nn.with_partitioning(nn.initializers.normal(0.01), ("seq", "embed")),
-            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-        wte_v = wte.value if hasattr(wte, "value") else wte
-        wpe_v = wpe.value if hasattr(wpe, "value") else wpe
-        x = wte_v.astype(cfg.dtype)[input_ids] + \
-            wpe_v.astype(cfg.dtype)[jnp.arange(l)][None]
+        wte_v, wpe_v = _make_embed_tables(self, cfg)
+        x = _embed_tokens(wte_v, wpe_v, input_ids, cfg)
 
         block = Block
         if cfg.remat:
@@ -173,13 +196,7 @@ class GPT2(nn.Module):
                        i % cfg.moe_every == cfg.moe_every - 1)
             x = block(cfg, use_moe, name=f"h_{i}")(x, deterministic)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype))
-        else:
-            logits = _dense(cfg.vocab_size, cfg, ("embed", "vocab"),
-                            name="lm_head", use_bias=False)(x)
-        return logits
+        return _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
 
 
 def gpt2_loss_fn(logits, batch):
@@ -197,6 +214,46 @@ def gpt2_loss_fn(logits, batch):
     ll = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
     nll = (logz - ll) * valid
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+class GPT2Embed(nn.Module):
+    """Embedding front (outside the pipelined region in PP)."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        wte_v, wpe_v = _make_embed_tables(self, self.cfg)
+        return _embed_tokens(wte_v, wpe_v, input_ids, self.cfg)
+
+
+class GPT2Head(nn.Module):
+    """Final norm + LM projection (outside the pipelined region in PP).
+    With cfg.tie_embeddings the decoder reuses the embedding table, passed
+    in as `embed_params` by PipelineModule (tied_head=True)."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, embed_params=None):
+        cfg = self.cfg
+        wte_v = None
+        if cfg.tie_embeddings:
+            assert embed_params is not None, \
+                "tie_embeddings needs PipelineModule(tied_head=True)"
+            wte_v = embed_params["wte"]
+            wte_v = wte_v.value if hasattr(wte_v, "value") else wte_v
+        return _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
+
+
+def gpt2_pipeline(cfg, num_stages, num_microbatches=None):
+    """GPT-2 as a pipeline-parallel model (reference PipelineModule usage,
+    e.g. Megatron GPT on DeepSpeed PP). Honors cfg.tie_embeddings via the
+    PipelineModule tied-head path (reference TiedLayerSpec)."""
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    return PipelineModule(block=Block(cfg), num_blocks=cfg.num_layers,
+                          num_stages=num_stages,
+                          embed=GPT2Embed(cfg), head=GPT2Head(cfg),
+                          num_microbatches=num_microbatches,
+                          tied_head=cfg.tie_embeddings)
 
 
 # canonical "HF GPT-2 small" hyperparameters

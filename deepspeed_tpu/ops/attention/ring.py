@@ -42,8 +42,7 @@ def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
     q32 = q.astype(jnp.float32)
     q_pos = my_idx * chunk + jnp.arange(chunk)            # absolute positions
 
-    def step(carry, i):
-        m, l, acc, k_cur, v_cur = carry
+    def accumulate(m, l, acc, k_cur, v_cur, i):
         # k_cur originated on device (my_idx - i) mod n
         src = (my_idx - i) % n
         k_pos = src * chunk + jnp.arange(chunk)
@@ -60,11 +59,14 @@ def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
         p = jnp.where(live[..., None], jnp.exp(s - m_new[..., None]), 0.0)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
-        acc_new = acc * alpha[..., None] + pv
+        return m_new, l_new, acc * alpha[..., None] + pv
 
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = accumulate(m, l, acc, k_cur, v_cur, i)
         k_nxt = lax.ppermute(k_cur, axis_name, _ring_perm(n))
         v_nxt = lax.ppermute(v_cur, axis_name, _ring_perm(n))
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
     # derive initial carries from q so they inherit its device-varying axes
     # (a plain jnp.zeros would be "unvarying" and trip shard_map's scan
@@ -73,8 +75,11 @@ def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
     m0 = jnp.full((b, h, chunk), NEG_INF, jnp.float32) + 0.0 * qT[..., 0]
     l0 = 0.0 * qT[..., 0]
     acc0 = 0.0 * qT
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
-                                    jnp.arange(n))
+    # n-1 hop-and-accumulate steps, then a final accumulate with no hop
+    # (the last ppermute's result would be thrown away)
+    (m, l, acc, k_last, v_last), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n - 1))
+    m, l, acc = accumulate(m, l, acc, k_last, v_last, n - 1)
     l = jnp.where(l == 0.0, 1.0, l)
     out = acc / l[..., None]                              # [b, h, q, d]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

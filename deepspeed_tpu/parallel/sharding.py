@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # an axis already taken by another dim of the same param is skipped.
 DEFAULT_LOGICAL_AXIS_RULES = (
     ("batch", "data"),
+    ("pipe", "pipe"),
     ("vocab", "model"),
     ("embed", None),
     ("heads", "model"),

@@ -1,0 +1,8 @@
+"""Pipeline parallelism (reference: deepspeed/runtime/pipe/)."""
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec,  # noqa: F401
+                                               PipelineModule,
+                                               TiedLayerSpec,
+                                               partition_balanced,
+                                               pipeline_spmd_forward)
+from deepspeed_tpu.runtime.pipe import schedule  # noqa: F401

@@ -1,0 +1,185 @@
+"""Pipeline instruction schedules (reference: ``runtime/pipe/schedule.py``
+— ``PipeSchedule`` base, ``InferenceSchedule`` :135, ``TrainSchedule`` :189
+(1F1B), instruction classes :237+).
+
+On TPU the *jitted* pipeline (pipe/module.py) executes a fused SPMD
+program, so these schedules serve two roles: (1) parity surface + host-side
+driver for eager/debug stage execution, (2) the specification the fused
+program is tested against (each microbatch's forward must precede its
+backward, buffer counts bounded by stages, etc.).
+"""
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Operates on a numbered activation buffer (reference :291)."""
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Yields lists of instructions per step for one (stage, #stages,
+    #microbatches) coordinate (reference PipeSchedule)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self):
+        raise NotImplementedError
+
+    def steps(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference :135)."""
+
+    def num_pipe_buffers(self):
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        out = []
+        for step_id in range(total):
+            cmds = []
+            mb = step_id - self.stage_id
+            buf = mb % 2
+            if 0 <= mb < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            out.append(cmds)
+        return out
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference TrainSchedule :189): each stage runs
+    `stages - stage_id - 1` warmup forwards, then alternates 1 forward /
+    1 backward, then drains backwards. Peak live activations per stage =
+    stages - stage_id, which is what num_pipe_buffers reports."""
+
+    def num_pipe_buffers(self):
+        return max(min(self.stages - self.stage_id,
+                       self.micro_batches), 2)
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(S - s - 1, M)
+        nbuf = self.num_pipe_buffers()
+        out = []
+        fwd_mb = 0   # next microbatch to forward
+        bwd_mb = 0   # next microbatch to backward
+
+        def fwd_cmds(mb):
+            buf = mb % nbuf
+            cmds = []
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(buf))
+            else:
+                cmds.append(RecvActivation(buf))
+            cmds.append(ForwardPass(buf))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(buf))
+            return cmds
+
+        def bwd_cmds(mb):
+            buf = mb % nbuf
+            cmds = []
+            if not self.is_last_stage:
+                cmds.append(RecvGrad(buf))
+            cmds.append(BackwardPass(buf))
+            if not self.is_first_stage:
+                cmds.append(SendGrad(buf))
+            return cmds
+
+        # warmup forwards
+        for _ in range(warmup):
+            out.append(fwd_cmds(fwd_mb))
+            fwd_mb += 1
+        # steady state: 1F1B
+        while fwd_mb < M:
+            out.append(fwd_cmds(fwd_mb))
+            fwd_mb += 1
+            out.append(bwd_cmds(bwd_mb))
+            bwd_mb += 1
+        # drain backwards
+        while bwd_mb < M:
+            out.append(bwd_cmds(bwd_mb))
+            bwd_mb += 1
+        # epilogue (reference :232-246)
+        out.append([ReduceTiedGrads(), ReduceGrads(), OptimizerStep()])
+        return out

@@ -1,0 +1,72 @@
+"""Schedule unit tests (pure, no dist — reference
+tests/unit/runtime/pipe/test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as S
+
+
+def _flat(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 4), (4, 8), (4, 2), (1, 4)])
+def test_train_schedule_invariants(stages, micro):
+    for stage_id in range(stages):
+        sched = S.TrainSchedule(micro_batches=micro, stages=stages,
+                                stage_id=stage_id)
+        cmds = _flat(sched.steps())
+        fwd = [c for c in cmds if isinstance(c, S.ForwardPass)]
+        bwd = [c for c in cmds if isinstance(c, S.BackwardPass)]
+        # every microbatch gets exactly one forward and one backward
+        assert len(fwd) == micro
+        assert len(bwd) == micro
+        # each buffer's forward precedes its backward for the same mb order
+        assert [c.buffer_id for c in fwd] == \
+            [c.buffer_id for c in bwd]
+        # epilogue present exactly once and last
+        assert isinstance(cmds[-1], S.OptimizerStep)
+        assert isinstance(cmds[-2], S.ReduceGrads)
+        assert isinstance(cmds[-3], S.ReduceTiedGrads)
+
+
+def test_train_schedule_first_stage_loads_last_stage_no_send():
+    sched = S.TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    cmds = _flat(sched.steps())
+    assert any(isinstance(c, S.LoadMicroBatch) for c in cmds)
+    assert not any(isinstance(c, S.RecvActivation) for c in cmds)
+    last = S.TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    lcmds = _flat(last.steps())
+    assert not any(isinstance(c, S.SendActivation) for c in lcmds)
+    assert not any(isinstance(c, S.RecvGrad) for c in lcmds)
+
+
+def test_1f1b_warmup_depth():
+    # stage 0 of 4 runs 3 warmup forwards before its first backward
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    cmds = _flat(sched.steps())
+    first_bwd = next(i for i, c in enumerate(cmds)
+                     if isinstance(c, S.BackwardPass))
+    n_fwd_before = sum(isinstance(c, S.ForwardPass)
+                       for c in cmds[:first_bwd])
+    assert n_fwd_before == 4  # 3 warmup + 1 steady-state fwd
+
+    last = S.TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    lcmds = _flat(last.steps())
+    first_bwd = next(i for i, c in enumerate(lcmds)
+                     if isinstance(c, S.BackwardPass))
+    assert sum(isinstance(c, S.ForwardPass) for c in lcmds[:first_bwd]) == 1
+
+
+def test_inference_schedule():
+    sched = S.InferenceSchedule(micro_batches=3, stages=2, stage_id=1)
+    cmds = _flat(sched.steps())
+    assert sum(isinstance(c, S.ForwardPass) for c in cmds) == 3
+    assert not any(isinstance(c, S.BackwardPass) for c in cmds)
+
+
+def test_num_pipe_buffers_bounded():
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 4
+    sched = S.TrainSchedule(micro_batches=1, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 2

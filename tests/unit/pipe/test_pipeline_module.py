@@ -1,0 +1,141 @@
+"""Pipelined execution: partition math, SPMD pipeline == sequential
+execution, end-to-end PP(+DP) training (reference
+tests/unit/runtime/pipe/test_pipe.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.models.gpt2 import (GPT2, Block, GPT2Embed, GPT2Head,
+                                       gpt2_pipeline, gpt2_tiny)
+from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.runtime.pipe import partition_balanced
+
+
+def test_partition_balanced_uniform():
+    assert partition_balanced([1] * 8, 4) == [0, 2, 4, 6, 8]
+
+
+def test_partition_balanced_weighted():
+    bounds = partition_balanced([10, 1, 1, 1, 1, 10], 2)
+    assert bounds[0] == 0 and bounds[-1] == 6
+    # both halves carry comparable weight (the 10s split apart)
+    w = [10, 1, 1, 1, 1, 10]
+    parts = [sum(w[bounds[i]:bounds[i + 1]]) for i in range(2)]
+    assert max(parts) <= 14
+
+
+def test_pipeline_forward_matches_sequential():
+    """The fused SPMD pipeline must equal running blocks in order."""
+    cfg = gpt2_tiny(num_layers=4)
+    pipe = gpt2_pipeline(cfg, num_stages=4, num_microbatches=2)
+    mesh = make_mesh(MeshConfig(pipe=4, data=2))
+    dist.set_mesh(mesh)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (4, 16), 0, cfg.vocab_size)
+    variables = pipe.init(rng, ids)
+    logits = pipe.apply(jax.tree.map(
+        lambda x: x, variables), ids)
+
+    # sequential oracle using the same params
+    import flax.linen as nn
+    p = nn.meta.unbox(variables["params"])
+    x = GPT2Embed(cfg).apply({"params": p["embed"]}, ids)
+    block = Block(cfg)
+    for s in range(4):
+        for k in range(1):
+            layer_p = jax.tree.map(lambda a: a[s, k], p["stages"])
+            x = block.apply({"params": layer_p}, x)
+    ref = GPT2Head(cfg).apply({"params": p["head"]}, x,
+                              embed_params=p["embed"])
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_tied_embeddings_no_lm_head():
+    """cfg.tie_embeddings=True: the head reuses wte — no lm_head matrix."""
+    cfg = gpt2_tiny(num_layers=2, tie_embeddings=True)
+    pipe = gpt2_pipeline(cfg, num_stages=2)
+    mesh = make_mesh(MeshConfig(pipe=2, data=4))
+    dist.set_mesh(mesh)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    variables = pipe.init(jax.random.PRNGKey(0), ids)
+    assert "lm_head" not in variables["params"].get("head", {})
+    untied = gpt2_pipeline(gpt2_tiny(num_layers=2, tie_embeddings=False),
+                           num_stages=2)
+    v2 = untied.init(jax.random.PRNGKey(0), ids)
+    assert "lm_head" in v2["params"]["head"]
+
+
+def test_pipeline_dropout_rng_used():
+    """dropout>0: two forwards with different rngs differ, deterministic
+    eval does not (the rngs/deterministic plumbing through shard_map)."""
+    cfg = gpt2_tiny(num_layers=2, dropout=0.3)
+    pipe = gpt2_pipeline(cfg, num_stages=2)
+    mesh = make_mesh(MeshConfig(pipe=2, data=4))
+    dist.set_mesh(mesh)
+    gen = np.random.default_rng(0)
+    ids = jnp.asarray(gen.integers(0, 256, size=(2, 8)).astype(np.int32))
+    variables = pipe.init(jax.random.PRNGKey(0), ids)
+    out1 = pipe.apply(variables, ids, deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    out2 = pipe.apply(variables, ids, deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(2)})
+    assert np.abs(np.asarray(out1) - np.asarray(out2)).max() > 1e-6
+    det1 = pipe.apply(variables, ids, deterministic=True)
+    det2 = pipe.apply(variables, ids, deterministic=True)
+    np.testing.assert_allclose(np.asarray(det1), np.asarray(det2))
+
+
+def test_pipeline_trains_with_engine():
+    """PP=2 x DP=4 training through deepspeed_tpu.initialize."""
+    cfg = gpt2_tiny(num_layers=4)
+    model = gpt2_pipeline(cfg, num_stages=2, num_microbatches=2)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pipe": 2, "data": 4},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gen = np.random.default_rng(0)
+    batch = {"input_ids": gen.integers(0, 256, size=(8, 32)).astype(np.int32)}
+    losses = []
+    for _ in range(8):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0], losses
+    # stage params really sharded over pipe
+    leaf = jax.tree.leaves(engine.state.params["stages"])[0]
+    assert "pipe" in str(leaf.sharding.spec), leaf.sharding.spec
+
+
+def test_pipeline_loss_matches_nonpipelined():
+    """Same init seed: PP model's first-step loss == dense GPT-2 loss is not
+    expected (different param trees), but the pipeline must be deterministic
+    across microbatch counts (M=1 vs M=2 reorder the same math)."""
+    cfg = gpt2_tiny(num_layers=2)
+    losses = {}
+    for m in (1, 2):
+        model = gpt2_pipeline(cfg, num_stages=2, num_microbatches=m)
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"pipe": 2, "data": 4},
+            "steps_per_print": 1000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config=config, seed=0)
+        gen = np.random.default_rng(0)
+        batch = {"input_ids": gen.integers(0, 256,
+                                           size=(16, 16)).astype(np.int32)}
+        losses[m] = float(jax.device_get(engine.forward(batch)))
+    np.testing.assert_allclose(losses[1], losses[2], rtol=1e-5)

@@ -4,12 +4,20 @@ Reference: ``deepspeed/runtime/engine.py`` (3268 LoC) — ``forward`` :1653,
 ``backward`` :1795, ``step`` :1991, ``save_checkpoint`` :2818,
 ``load_checkpoint`` :2513. The torch engine mutates module state and drives
 collectives through hooks; here the train state (params, optimizer state,
-loss-scale state) is a pytree of **globally-sharded jax.Arrays** and the hot
-path is three jitted functions:
+loss-scale state) is a pytree of **globally-sharded jax.Arrays** and each
+micro batch is exactly ONE jitted dispatch:
 
-  _fwd_bwd(params, scale, batch, rng) -> (loss, scaled grads)
-  _accum(acc, grads)                  -> acc + grads          (donated)
-  _apply(state, acc, lr)              -> new state, metrics   (donated)
+  gas == 1:    _step_gas1(state, batch, rng, lr) -> loss, state', metrics
+  gas > 1:     _micro_first(params, scale, batch, rng)      -> loss, acc
+               _micro_next(params, scale, acc, batch, rng)  -> loss, acc
+               _step_last(state, acc, batch, rng, lr) -> loss, state', metrics
+
+The boundary step fuses forward+backward+optimizer-apply into one XLA
+program: grads never round-trip through a persistent fp32 accumulator for
+gas=1 and the optimizer update fuses into the backward epilogue. The fp32
+optimizer moments are donated and alias in place; master params are NOT
+donated so they stay readable between backward() and step() (reference
+engine semantics: state mutates at step).
 
 ZeRO stages are sharding choices (parallel/sharding.py), not code paths:
 grads/optimizer state/params pick up a `data`-axis dimension at stages 2/1/3
@@ -130,8 +138,10 @@ class DeepSpeedEngine:
         self.global_steps = 0          # optimizer steps taken (host mirror)
         self.global_samples = 0
         self.state: Optional[TrainState] = None
-        self._grad_acc = None
-        self._pending = None           # (loss, grads) between forward and backward
+        self._grad_acc = None          # running grad sum (gas > 1 windows)
+        self._pending = None           # forward() result awaiting backward()
+        self._next_state = None        # boundary result awaiting step()
+        self._next_metrics = None
         self._last_metrics = {}
         self.gas = self._config.gradient_accumulation_steps
 
@@ -173,13 +183,13 @@ class DeepSpeedEngine:
     def loss_scale(self):
         if self.state is None:
             return 1.0
-        return float(jax.device_get(self.state.scaler.loss_scale))
+        return float(jax.device_get(self._live_state().scaler.loss_scale))
 
     @property
     def skipped_steps(self):
         if self.state is None:
             return 0
-        return int(jax.device_get(self.state.skipped_steps))
+        return int(jax.device_get(self._live_state().skipped_steps))
 
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps + 1) % self.gas == 0
@@ -282,11 +292,6 @@ class DeepSpeedEngine:
         self._state_sh = jax.tree.map(lambda _: rep, self.state).replace(
             params=param_sh, opt_state=opt_sh)
         self.state = jax.tree.map(jax.device_put, self.state, self._state_sh)
-        self._zeros_fn = jax.jit(
-            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), shapes),
-            out_shardings=self._grad_sh)
-        self._grad_acc = self._zeros_fn()
-
         self._build_jitted_fns()
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
         log_dist(f"engine initialized: {n_params / 1e6:.2f}M params, mesh="
@@ -349,15 +354,7 @@ class DeepSpeedEngine:
                 scaled_loss, has_aux=True)(params)
             return loss, grads
 
-        self._fwd_bwd = jax.jit(fwd_bwd, out_shardings=(None, self._grad_sh))
-
-        def accum(acc, grads):
-            return jax.tree.map(jnp.add, acc, grads)
-
-        self._accum = jax.jit(accum, donate_argnums=(0,),
-                              out_shardings=self._grad_sh)
-
-        def apply_step(state, acc, lr):
+        def apply_grads(state, acc, lr):
             scale = state.scaler.loss_scale
             grads = jax.tree.map(lambda g: g / (scale * predivide), acc)
             overflow = has_overflow(grads)
@@ -394,30 +391,114 @@ class DeepSpeedEngine:
                        "loss_scale": scaler.loss_scale}
             return new_state, metrics
 
-        self._apply = jax.jit(apply_step, donate_argnums=(0, 1),
-                              out_shardings=(self._state_sh, None))
+        # One fused dispatch per micro batch; the boundary step folds the
+        # optimizer apply into the same XLA program so the whole train step
+        # is a single executable (no persistent fp32 accumulator at gas=1).
+        # Only opt_state is donated: params must stay readable between
+        # backward() and step() (reference engine semantics — state mutates
+        # at step), and the optimizer moments are the bulk of the bytes.
+        def step_gas1(params, opt_state, rest, batch, rng, lr):
+            state = rest.replace(params=params, opt_state=opt_state)
+            loss, grads = fwd_bwd(params, state.scaler.loss_scale, batch, rng)
+            new_state, metrics = apply_grads(state, grads, lr)
+            return loss, new_state, metrics
+
+        self._step_gas1 = jax.jit(
+            step_gas1, donate_argnums=(1,),
+            out_shardings=(None, self._state_sh, None))
+
+        def micro_first(params, scale, batch, rng):
+            return fwd_bwd(params, scale, batch, rng)
+
+        self._micro_first = jax.jit(
+            micro_first, out_shardings=(None, self._grad_sh))
+
+        def micro_next(params, scale, acc, batch, rng):
+            loss, grads = fwd_bwd(params, scale, batch, rng)
+            return loss, jax.tree.map(jnp.add, acc, grads)
+
+        self._micro_next = jax.jit(
+            micro_next, donate_argnums=(2,),
+            out_shardings=(None, self._grad_sh))
+
+        def step_last(params, opt_state, rest, acc, batch, rng, lr):
+            state = rest.replace(params=params, opt_state=opt_state)
+            loss, grads = fwd_bwd(params, state.scaler.loss_scale, batch, rng)
+            acc = jax.tree.map(jnp.add, acc, grads)
+            new_state, metrics = apply_grads(state, acc, lr)
+            return loss, new_state, metrics
+
+        self._step_last = jax.jit(
+            step_last, donate_argnums=(1, 3),
+            out_shardings=(None, self._state_sh, None))
 
     # ------------------------------------------------------------------ train
+    def _live_state(self):
+        """The most recent state tree with live (non-donated) buffers.
+
+        At a GAS boundary the fused train step donates the old opt-state
+        buffers at forward() dispatch; until step() commits, the
+        fully-readable tree is the pending result (params stay live either
+        way)."""
+        if self._next_state is not None:
+            return self._next_state
+        if self._pending is not None and self._pending[0] == "commit":
+            return self._pending[2]
+        return self.state
+
     def forward(self, batch, rng=None):
-        """Compute loss (and grads, cached for backward) on one micro batch."""
+        """One micro batch: fused forward+backward (+optimizer apply at the
+        gradient-accumulation boundary), a single jitted dispatch."""
         self._ensure_initialized(batch)
+        assert self._next_state is None, \
+            "step() must run before the next forward(): the previous " \
+            "boundary step donated the old optimizer-state buffers"
+        assert self._pending is None, \
+            "backward() must run between forward() calls: forward donates " \
+            "buffers that only backward() re-homes (for a loss-only pass " \
+            "use eval_batch)"
         self.timers(FORWARD_GLOBAL_TIMER).start()
         dev_batch = self._put_batch(batch)
         if rng is None:
             rng, self._rng = jax.random.split(self._rng)
-        loss, grads = self._fwd_bwd(self.state.params,
-                                    self.state.scaler.loss_scale, dev_batch, rng)
-        self._pending = (loss, grads)
+        boundary = (self.micro_steps + 1) % self.gas == 0
+        rest = self.state.replace(params=None, opt_state=None)
+        if self.gas == 1:
+            loss, new_state, metrics = self._step_gas1(
+                self.state.params, self.state.opt_state, rest,
+                dev_batch, rng, float(self.get_lr()[0]))
+            self._pending = ("commit", loss, new_state, metrics)
+        elif boundary:
+            loss, new_state, metrics = self._step_last(
+                self.state.params, self.state.opt_state, rest,
+                self._grad_acc, dev_batch, rng, float(self.get_lr()[0]))
+            self._grad_acc = None
+            self._pending = ("commit", loss, new_state, metrics)
+        elif self.micro_steps % self.gas == 0:
+            loss, acc = self._micro_first(
+                self.state.params, self.state.scaler.loss_scale,
+                dev_batch, rng)
+            self._pending = ("acc", loss, acc)
+        else:
+            loss, acc = self._micro_next(
+                self.state.params, self.state.scaler.loss_scale,
+                self._grad_acc, dev_batch, rng)
+            self._grad_acc = None
+            self._pending = ("acc", loss, acc)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
     def backward(self, loss=None, retain_graph=False, scale_wrt_gas=True):
-        """Accumulate the gradients computed by the last forward()."""
+        """Commit the gradients (or the fused boundary result) of forward()."""
         assert self._pending is not None, \
             "backward() must follow forward() (grads are computed jointly)"
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        _, grads = self._pending
-        self._grad_acc = self._accum(self._grad_acc, grads)
+        kind = self._pending[0]
+        if kind == "acc":
+            self._grad_acc = self._pending[2]
+        else:
+            self._next_state = self._pending[2]
+            self._next_metrics = self._pending[3]
         self._pending = None
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu() * \
@@ -426,13 +507,20 @@ class DeepSpeedEngine:
         return loss
 
     def step(self):
-        """Optimizer step at the gradient-accumulation boundary."""
+        """Commit the optimizer step at the gradient-accumulation boundary.
+
+        The update itself was computed (fused with the last backward) in
+        forward(); this publishes the new state and advances schedules."""
         if self.micro_steps % self.gas != 0:
             return  # mid-accumulation: nothing to do (reference no-ops too)
+        assert self._next_state is not None, \
+            "step() must follow forward()+backward() at the GAS boundary"
         self.timers(STEP_GLOBAL_TIMER).start()
-        lr = float(self.get_lr()[0])
-        self.state, metrics = self._apply(self.state, self._grad_acc, lr)
-        self._grad_acc = self._zeros_fn()
+        self.state = self._next_state
+        metrics = self._next_metrics
+        self._next_state = None
+        self._next_metrics = None
+        lr = float(self.get_lr()[0])   # the lr this step was taken with
         self.global_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
@@ -491,7 +579,7 @@ class DeepSpeedEngine:
                 return loss_fn(p, batch, None)
 
             self._eval_fn = jax.jit(ev)
-        return self._eval_fn(self.state.params, self._put_batch(batch))
+        return self._eval_fn(self._live_state().params, self._put_batch(batch))
 
     # ------------------------------------------------------------------- io
     def deepspeed_io(self, dataset, collate_fn=None, route="train"):
@@ -517,7 +605,7 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict()
             if isinstance(self.lr_scheduler, LRScheduler) else None,
         })
-        save_state(path, self.state, client)
+        save_state(path, self._live_state(), client)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
@@ -553,7 +641,7 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ misc
     def get_params(self):
-        return self.state.params if self.state is not None else None
+        return self._live_state().params if self.state is not None else None
 
     def __call__(self, batch):
         return self.forward(batch)

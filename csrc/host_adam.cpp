@@ -1,0 +1,127 @@
+// Host-RAM optimizer kernels for ZeRO-Offload on TPU-VMs.
+//
+// TPU-native counterpart of the reference's AVX CPU-Adam
+// (/root/reference/csrc/adam/cpu_adam.cpp:1, csrc/includes/simd.h): the
+// optimizer state (fp32 master params + moments) lives in host memory and
+// the update runs on the host CPUs while the chip keeps the bf16 compute
+// copy. Vectorization is delegated to the compiler (-O3 -mavx2 plus
+// OpenMP 'parallel for simd'), which emits the same fused AVX loops the
+// reference hand-writes with intrinsics.
+//
+// All entry points are plain C so ctypes can bind them without pybind11.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// bf16 <-> f32: round-to-nearest-even truncation, matching XLA's convert.
+void ds_f32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits;
+    std::memcpy(&bits, &src[i], 4);
+    uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+    dst[i] = (uint16_t)((bits + rounding) >> 16);
+  }
+}
+
+void ds_bf16_to_f32(const uint16_t* src, float* dst, int64_t n) {
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits = ((uint32_t)src[i]) << 16;
+    std::memcpy(&dst[i], &bits, 4);
+  }
+}
+
+// Sum of squares (for the global grad-norm clip, reference
+// runtime/utils.py:306 clip_grad_norm_).
+double ds_l2_norm_sq(const float* x, int64_t n) {
+  double acc = 0.0;
+#pragma omp parallel for simd reduction(+ : acc)
+  for (int64_t i = 0; i < n; ++i) acc += (double)x[i] * (double)x[i];
+  return acc;
+}
+
+// 1 if any element is inf/nan (fp16 overflow check, reference
+// runtime/utils.py:173 CheckOverflow).
+int ds_has_inf_nan(const float* x, int64_t n) {
+  int bad = 0;
+#pragma omp parallel for simd reduction(| : bad)
+  for (int64_t i = 0; i < n; ++i) bad |= !std::isfinite(x[i]);
+  return bad;
+}
+
+void ds_axpy(float* acc, const float* x, int64_t n) {
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) acc[i] += x[i];
+}
+
+void ds_scale(float* x, int64_t n, float s) {
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) x[i] *= s;
+}
+
+// Fused Adam/AdamW step on host arrays. Mirrors
+// Adam_Optimizer::Step (/root/reference/csrc/adam/cpu_adam.cpp:1) minus the
+// CUDA copy-back: the bf16 device copy is produced into `bf16_out` in the
+// same pass and shipped to the chip by the caller.
+//   grad_scale  divide grads by this (loss-scale * predivide)
+//   clip_coef   multiply grads by this after unscaling (1.0 = no clip)
+//   adamw_mode  1: decoupled weight decay (AdamW); 0: L2 into the gradient
+void ds_adam_step(float* param, float* m, float* v, const float* grad,
+                  int64_t n, float lr, float beta1, float beta2, float eps,
+                  float weight_decay, int adamw_mode, int step,
+                  float grad_scale, float clip_coef, uint16_t* bf16_out) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float inv_scale = grad_scale != 0.0f ? clip_coef / grad_scale : 0.0f;
+  const float l2_wd = adamw_mode ? 0.0f : weight_decay;
+  const float decoupled_wd = adamw_mode ? lr * weight_decay : 0.0f;
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i] * inv_scale;
+    float p = param[i];
+    g += l2_wd * p;
+    float mi = beta1 * m[i] + (1.0f - beta1) * g;
+    float vi = beta2 * v[i] + (1.0f - beta2) * g * g;
+    m[i] = mi;
+    v[i] = vi;
+    float denom = std::sqrt(vi / bc2) + eps;
+    // decoupled decay exactly as optax.adamw: p -= lr*wd*p_old
+    p -= step_size * (mi / denom) + decoupled_wd * p;
+    param[i] = p;
+    if (bf16_out) {
+      uint32_t bits;
+      std::memcpy(&bits, &p, 4);
+      uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+      bf16_out[i] = (uint16_t)((bits + rounding) >> 16);
+    }
+  }
+}
+
+// Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp).
+void ds_adagrad_step(float* param, float* v, const float* grad, int64_t n,
+                     float lr, float eps, float weight_decay, int step,
+                     float grad_scale, float clip_coef, uint16_t* bf16_out) {
+  const float inv_scale = grad_scale != 0.0f ? clip_coef / grad_scale : 0.0f;
+#pragma omp parallel for simd
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i] * inv_scale;
+    if (weight_decay > 0.0f) g += weight_decay * param[i];
+    float vi = v[i] + g * g;
+    v[i] = vi;
+    float p = param[i] - lr * g / (std::sqrt(vi) + eps);
+    param[i] = p;
+    if (bf16_out) {
+      uint32_t bits;
+      std::memcpy(&bits, &p, 4);
+      uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+      bf16_out[i] = (uint16_t)((bits + rounding) >> 16);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import \
+    DeepSpeedAccelerator  # noqa: F401
+from deepspeed_tpu.accelerator.real_accelerator import (  # noqa: F401
+    get_accelerator, set_accelerator)
+from deepspeed_tpu.accelerator.tpu_accelerator import (  # noqa: F401
+    CPU_Accelerator, TPU_Accelerator)

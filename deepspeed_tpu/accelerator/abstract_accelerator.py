@@ -1,0 +1,130 @@
+"""Abstract accelerator contract.
+
+Reference: ``accelerator/abstract_accelerator.py:10`` (DeepSpeedAccelerator)
+— the conformance surface every accelerator must provide: naming, device
+management, RNG, memory statistics, dtype support, communication backend
+name (:177) and op-builder discovery (:225-235). The torch API surface
+(streams/events) collapses on TPU: XLA owns scheduling, so stream/event
+methods are explicit no-ops that keep client code portable.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name = None
+    _communication_backend_name = None
+
+    # ----------------------------------------------------------- naming
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # ---------------------------------------------------------- devices
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    def current_device_name(self):
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def set_device(self, device_index):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    def is_available(self):
+        return self.device_count() > 0
+
+    # -------------------------------------------------------------- rng
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    def initial_seed(self):
+        return self._seed
+
+    # ------------------------------------------------------------ memory
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    def available_memory(self, device_index=None):
+        return self.total_memory(device_index) - \
+            self.memory_allocated(device_index)
+
+    def memory_stats(self, device_index=None):
+        return {"allocated_bytes": self.memory_allocated(device_index),
+                "total_bytes": self.total_memory(device_index)}
+
+    def empty_cache(self):
+        """XLA manages HBM; nothing to flush."""
+
+    # ------------------------------------------------------------ dtypes
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        out = [jnp.float32]
+        if self.is_bf16_supported():
+            out.append(jnp.bfloat16)
+        if self.is_fp16_supported():
+            out.append(jnp.float16)
+        return out
+
+    # ------------------------------------------- streams/events (no-ops)
+    def stream(self, *a, **k):
+        """XLA schedules asynchronously; explicit streams don't exist."""
+        import contextlib
+        return contextlib.nullcontext()
+
+    def default_stream(self):
+        return None
+
+    def range_push(self, name):
+        """Profiler annotation (reference NVTX range_push)."""
+        import jax.profiler
+        tc = jax.profiler.TraceAnnotation(name)
+        tc.__enter__()
+        self._open_ranges = getattr(self, "_open_ranges", [])
+        self._open_ranges.append(tc)
+
+    def range_pop(self):
+        if getattr(self, "_open_ranges", None):
+            self._open_ranges.pop().__exit__(None, None, None)
+
+    # -------------------------------------------------------- op builders
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops.op_builder"
+
+    def create_op_builder(self, class_name):
+        import importlib
+        mod = importlib.import_module(self.op_builder_dir())
+        cls = getattr(mod, class_name, None)
+        return cls() if cls is not None else None
+
+    def get_op_builder(self, class_name):
+        import importlib
+        mod = importlib.import_module(self.op_builder_dir())
+        return getattr(mod, class_name, None)

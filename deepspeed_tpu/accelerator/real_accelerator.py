@@ -1,0 +1,29 @@
+"""Accelerator selection.
+
+Reference: ``accelerator/real_accelerator.py:37`` (get_accelerator) — a
+process-wide singleton picked from the runtime environment, overridable
+via ``DS_ACCELERATOR``. Here the choice keys off jax's default backend.
+"""
+
+import os
+
+_accelerator = None
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        import jax
+        from deepspeed_tpu.accelerator.tpu_accelerator import (
+            CPU_Accelerator, TPU_Accelerator)
+        name = os.environ.get("DS_ACCELERATOR")
+        if name is None:
+            name = "tpu" if jax.default_backend() == "tpu" else "cpu"
+        _accelerator = TPU_Accelerator() if name == "tpu" \
+            else CPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel):
+    global _accelerator
+    _accelerator = accel

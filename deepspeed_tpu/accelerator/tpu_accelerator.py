@@ -1,0 +1,70 @@
+"""TPU (and CPU-fallback) accelerators over jax.
+
+Reference: ``accelerator/cuda_accelerator.py`` implementing
+``abstract_accelerator.py:10`` on torch.cuda; here the backing runtime is
+jax/XLA. The same class serves the virtual-CPU test platform (the device
+list just holds CPU devices), mirroring how the reference's accelerator
+abstraction lets one code path span CUDA/CPU.
+"""
+
+import jax
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    _name = "tpu"
+    _communication_backend_name = "xla"
+
+    def __init__(self):
+        self._current = 0
+        self._seed = 0
+
+    def _devices(self):
+        return jax.local_devices()
+
+    def device_count(self):
+        return len(self._devices())
+
+    def current_device(self):
+        return self._current
+
+    def set_device(self, device_index):
+        assert 0 <= device_index < self.device_count()
+        self._current = device_index
+
+    def synchronize(self, device_index=None):
+        # fence: a tiny transfer that cannot complete before queued work
+        (jax.device_put(0, self._devices()[device_index or 0]) + 0
+         ).block_until_ready()
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        return jax.random.PRNGKey(self._seed)
+
+    def memory_allocated(self, device_index=None):
+        d = self._devices()[device_index or self._current]
+        stats = d.memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        d = self._devices()[device_index or self._current]
+        stats = d.memory_stats() or {}
+        return stats.get("bytes_limit", stats.get("bytes_in_use", 0))
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # fp16 runs on TPU but bf16 is native; both advertised (the fp16
+        # loss-scaler path is tested on this platform)
+        return True
+
+    def device_kind(self):
+        return getattr(self._devices()[0], "device_kind", self._name)
+
+
+class CPU_Accelerator(TPU_Accelerator):
+    """The virtual multi-device CPU platform used by the test mesh."""
+    _name = "cpu"
+    _communication_backend_name = "xla"

@@ -1,0 +1,59 @@
+"""Environment / op-compatibility report (``ds_report``).
+
+Reference: ``deepspeed/env_report.py:1`` — the op compatibility table plus
+framework/hardware versions printed by ``bin/ds_report``.
+"""
+
+import importlib
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def op_report_rows():
+    from deepspeed_tpu.ops.op_builder import op_report
+    return op_report()
+
+
+def main(args=None):
+    import jax
+
+    import deepspeed_tpu
+
+    print("-" * 64)
+    print("DeepSpeed-TPU C++ op report")
+    print("-" * 64)
+    print(f"{'op name':20} {'compatible':12} {'built'}")
+    for name, compatible, installed in op_report_rows():
+        print(f"{name:20} {GREEN_OK if compatible else RED_NO:12} "
+              f"{GREEN_OK if installed else '[not built]'}")
+    print("-" * 64)
+    print("General environment:")
+    print(f"{'python':24} {sys.version.split()[0]}")
+    print(f"{'deepspeed_tpu':24} {deepspeed_tpu.__version__}")
+    print(f"{'jax':24} {jax.__version__}")
+    for mod in ("flax", "optax", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            print(f"{mod:24} {getattr(m, '__version__', '?')}")
+        except ImportError:
+            print(f"{mod:24} not installed")
+    try:
+        devs = jax.devices()
+        print(f"{'platform':24} {devs[0].platform}")
+        print(f"{'device kind':24} {getattr(devs[0], 'device_kind', '?')}")
+        print(f"{'device count':24} {len(devs)}")
+        from deepspeed_tpu.accelerator import get_accelerator
+        acc = get_accelerator()
+        print(f"{'accelerator':24} {acc.device_name()}")
+        print(f"{'comm backend':24} {acc.communication_backend_name()}")
+    except Exception as e:  # no backend in exotic CI
+        print(f"{'platform':24} unavailable ({e})")
+    print("-" * 64)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

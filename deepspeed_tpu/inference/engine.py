@@ -284,35 +284,51 @@ class InferenceEngine:
                                              int(top_k), float(top_p))
             host_tok = np.asarray(jax.device_get(tok))
             self._model_times.append(time.time() - t0)
-            out.append(host_tok)
             if eos_token_id is not None:
+                # rows that finished earlier emit eos fill, not garbage
+                host_tok = np.where(finished, eos_token_id, host_tok)
+                out.append(host_tok)
                 finished |= host_tok == eos_token_id
                 if finished.all():
                     break
+            else:
+                out.append(host_tok)
         gen = np.stack(out, axis=1)
         return np.concatenate([ids, gen], axis=1)
 
     def _generate_nocache(self, ids, max_new_tokens, do_sample, temperature,
                           top_k, top_p, eos_token_id):
         """Fallback for models without a KV-cache contract: full re-forward
-        per token (correct, O(n^2); the reference non-injected path)."""
+        per token (correct, O(n^2); the reference non-injected path).
+
+        The working buffer is padded to the final length once so the jitted
+        forward compiles for a single shape instead of once per emitted
+        token (causal models ignore positions past the read index)."""
         module = self.module
 
         if self._fwd is None:
             self._fwd = jax.jit(
                 lambda params, ids: module.apply({"params": params}, ids))
-        cur = jnp.asarray(ids)
-        b = cur.shape[0]
+        ids = np.asarray(ids)
+        b, l0 = ids.shape
+        total = l0 + max_new_tokens
+        buf = np.zeros((b, total), ids.dtype)
+        buf[:, :l0] = ids
         finished = np.zeros(b, bool)
+        pos = l0
         for _ in range(max_new_tokens):
             with dist.mesh_scope(self.mesh):
-                logits = self._fwd(self.params, cur)
+                logits = self._fwd(self.params, jnp.asarray(buf))
             self._rng, rng = jax.random.split(self._rng)
-            tok = _sample_tokens(logits[:, -1], rng, do_sample, temperature,
-                                 top_k, top_p)
-            cur = jnp.concatenate([cur, tok[:, None]], axis=1)
+            tok = _sample_tokens(logits[:, pos - 1], rng, do_sample,
+                                 temperature, top_k, top_p)
+            host_tok = np.asarray(jax.device_get(tok))
             if eos_token_id is not None:
-                finished |= np.asarray(jax.device_get(tok)) == eos_token_id
+                host_tok = np.where(finished, eos_token_id, host_tok)
+            buf[:, pos] = host_tok
+            pos += 1
+            if eos_token_id is not None:
+                finished |= host_tok == eos_token_id
                 if finished.all():
                     break
-        return np.asarray(jax.device_get(cur))
+        return buf[:, :pos]

@@ -1,0 +1,143 @@
+"""`deepspeed_tpu` CLI: resource parsing + multi-process launch.
+
+Reference: ``deepspeed/launcher/runner.py`` — hostfile parse (:179),
+--include/--exclude filtering (:234-331), runner selection + exec (:367).
+Single-node runs exec ``launcher/launch.py`` directly; multi-node builds a
+pdsh/gcloud/slurm command (multinode_runner.py).
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE,
+                   help="hostname slots=N per line")
+    p.add_argument("-i", "--include", default="",
+                   help='e.g. "host1,host2:0,2"')
+    p.add_argument("-e", "--exclude", default="", help="inverse of include")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--num_workers", "--num_gpus", type=int, default=-1,
+                   dest="num_workers", help="processes per node")
+    p.add_argument("--master_addr", default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--launcher", default="pdsh",
+                   choices=("pdsh", "gcloud", "slurm"))
+    p.add_argument("--tpu_name", default=os.environ.get("TPU_NAME", ""),
+                   help="gcloud launcher: TPU pod name")
+    p.add_argument("--force_cpu_devices", type=int, default=0,
+                   help="virtual CPU devices per process (CI/testing)")
+    p.add_argument("--autotuning", default="", choices=("", "tune", "run"))
+    p.add_argument("user_script", help="training script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def fetch_hostfile(path):
+    """Parse 'hostname slots=N' lines -> {hostname: N} (reference :179)."""
+    if not os.path.isfile(path):
+        return None
+    resources = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)$", line)
+            if m is None:
+                raise ValueError(f"bad hostfile line: {line!r}")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resources:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            resources[host] = slots
+    if not resources:
+        raise ValueError(f"hostfile {path} is empty")
+    return resources
+
+
+def _parse_filter(spec):
+    """'host1@host2:0,2' -> {host1: None, host2: [0, 2]}. Hosts separated
+    by '@', slot lists by ',' (reference uses the same two-level split)."""
+    out = {}
+    for part in spec.split("@"):
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = [int(s) for s in slots.split(",") if s != ""]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources, include, exclude):
+    """Filter {host: slots} by include/exclude specs (reference :234)."""
+    assert not (include and exclude), \
+        "--include and --exclude are mutually exclusive"
+    active = {}
+    if include:
+        spec = _parse_filter(include)
+        for host, idx in spec.items():
+            assert host in resources, f"unknown host {host}"
+            active[host] = len(idx) if idx else resources[host]
+    elif exclude:
+        spec = _parse_filter(exclude)
+        for host, slots in resources.items():
+            if host not in spec:
+                active[host] = slots
+            elif spec[host]:
+                remaining = slots - len(spec[host])
+                if remaining > 0:
+                    active[host] = remaining
+    else:
+        active = dict(resources)
+    return active
+
+
+def main(args=None):
+    args = parse_args(args)
+    resources = fetch_hostfile(args.hostfile)
+
+    if resources is None or len(resources) <= 1:
+        # single node: exec launch.py directly (reference :367 local path)
+        num_workers = args.num_workers if args.num_workers > 0 else 1
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               "--node_rank=0", "--num_nodes=1",
+               f"--num_workers={num_workers}",
+               f"--master_addr={args.master_addr}",
+               f"--master_port={args.master_port}"]
+        if args.force_cpu_devices:
+            cmd.append(f"--force_cpu_devices={args.force_cpu_devices}")
+        cmd += [args.user_script] + args.user_args
+        logger.info(f"cmd: {' '.join(cmd)}")
+        return subprocess.call(cmd)
+
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = dict(list(active.items())[:args.num_nodes])
+    from deepspeed_tpu.launcher.multinode_runner import (GcloudRunner,
+                                                         PDSHRunner,
+                                                         SlurmRunner)
+    cls = {"pdsh": PDSHRunner, "gcloud": GcloudRunner,
+           "slurm": SlurmRunner}[args.launcher]
+    runner = cls(args, active)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {runner.name} not available")
+    env = os.environ.copy()
+    cmd, env = runner.get_cmd(env, active)
+    logger.info(f"cmd: {' '.join(cmd)}")
+    return subprocess.call(cmd, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,11 +73,14 @@ def top1_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
 
 
 def top2_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
-                rng=None, second_policy_jitter=True):
+                rng=None, second_policy_jitter=True, used_token_mask=None):
     """Top-2 gating (reference top2gating, sharded_moe.py:277).
 
     Capacity doubles (k=2). Combine weights are the two gate values
-    renormalized to sum to 1 per token.
+    renormalized to sum to 1 per token. The second expert is chosen from
+    gumbel-perturbed logits when ``second_policy_jitter`` (the reference's
+    noisy second-expert selection); padding tokens flagged off in
+    ``used_token_mask`` are neither routed nor counted.
     """
     s, e = logits.shape
     cap = capacity(s, e, 2 * capacity_factor, min_capacity) if drop_tokens \
@@ -86,10 +89,17 @@ def top2_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     indices1 = jnp.argmax(gates, axis=-1)
     mask1 = _one_hot(indices1, e)
-    # second expert: argmax with the first masked out
-    logits_no1 = jnp.where(mask1 > 0, -jnp.inf, gates)
+    # second expert: argmax with the first masked out, optionally over
+    # gumbel-noised logits (reference's noisy second-expert policy)
+    select2 = logits.astype(jnp.float32)
+    if second_policy_jitter and rng is not None:
+        select2 = select2 + jax.random.gumbel(rng, logits.shape, jnp.float32)
+    logits_no1 = jnp.where(mask1 > 0, -jnp.inf, select2)
     indices2 = jnp.argmax(logits_no1, axis=-1)
     mask2 = _one_hot(indices2, e)
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+        mask2 = mask2 * used_token_mask[:, None]
 
     locations1 = jnp.cumsum(mask1, axis=0) - mask1
     # expert-2 tokens queue after all expert-1 tokens (reference :300)
@@ -129,7 +139,6 @@ def gate(logits, k=1, **kw):
         return top1_gating(logits, **kw)
     if k == 2:
         kw.pop("noisy_gate_policy", None)
-        kw.pop("used_token_mask", None)
         return top2_gating(logits, **kw)
     raise ValueError(f"k={k} not supported (reference supports 1 and 2)")
 

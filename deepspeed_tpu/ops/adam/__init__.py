@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam  # noqa: F401
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdagrad  # noqa: F401

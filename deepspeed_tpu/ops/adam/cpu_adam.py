@@ -1,0 +1,161 @@
+"""Host (CPU) Adam/Adagrad over numpy buffers — the ZeRO-Offload optimizer.
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py:13`` (DeepSpeedCPUAdam) backed
+by ``csrc/adam/cpu_adam.cpp``. Here the native kernel is
+``csrc/host_adam.cpp`` bound via ctypes; a pure-numpy fallback keeps the
+semantics available when no C++ toolchain exists. Unlike the torch
+version, this class owns flat fp32 master/moment buffers directly (the
+engine keeps only the bf16 compute copy on the chip).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder, OpBuilderError
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        b = CPUAdamBuilder()
+        if b.is_compatible():
+            try:
+                _lib = b.load()
+            except OpBuilderError:
+                _lib = None
+    return _lib
+
+
+def _as_f32p(a):
+    import ctypes
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _as_u16p(a):
+    import ctypes
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam/AdamW stepping fp32 master params in place and
+    emitting the bf16 device copy in the same pass."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, fp32_optimizer_states=True):
+        self.lr = float(lr)
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.adamw_mode = bool(adamw_mode)
+        self.step_count = 0
+        self.native = _native() is not None
+
+    def init_state(self, n):
+        """(m, v) zero moment buffers for a flat param of n elements."""
+        return np.zeros(n, np.float32), np.zeros(n, np.float32)
+
+    def step_flat(self, param, m, v, grad, *, lr=None, grad_scale=1.0,
+                  clip_coef=1.0, step=None, bf16_out=None):
+        """One Adam step on contiguous fp32 1-D arrays, in place.
+
+        grad is divided by grad_scale then multiplied by clip_coef (the
+        reference unscales + clips before its CPU Adam the same way,
+        stage_1_and_2.py:1636)."""
+        lr = self.lr if lr is None else float(lr)
+        step = self.step_count + 1 if step is None else int(step)
+        lib = _native()
+        if lib is not None:
+            lib.ds_adam_step(
+                _as_f32p(param), _as_f32p(m), _as_f32p(v), _as_f32p(grad),
+                param.size, lr, self.beta1, self.beta2, self.eps,
+                self.weight_decay, int(self.adamw_mode), step,
+                float(grad_scale), float(clip_coef),
+                _as_u16p(bf16_out) if bf16_out is not None else None)
+        else:
+            g = grad * (clip_coef / grad_scale)
+            if not self.adamw_mode and self.weight_decay:
+                g = g + self.weight_decay * param
+            m *= self.beta1
+            m += (1 - self.beta1) * g
+            v *= self.beta2
+            v += (1 - self.beta2) * g * g
+            bc1 = 1 - self.beta1 ** step
+            bc2 = 1 - self.beta2 ** step
+            denom = np.sqrt(v / bc2) + self.eps
+            upd = (lr / bc1) * (m / denom)
+            if self.adamw_mode and self.weight_decay:
+                upd = upd + lr * self.weight_decay * param
+            param -= upd
+            if bf16_out is not None:
+                bf16_out[:] = f32_to_bf16(param)
+        return param
+
+    def advance(self):
+        self.step_count += 1
+
+
+class DeepSpeedCPUAdagrad(DeepSpeedCPUAdam):
+    """Host Adagrad (reference deepspeed/ops/adagrad/cpu_adagrad.py)."""
+
+    def init_state(self, n):
+        return (np.zeros(n, np.float32),)
+
+    def step_flat(self, param, v, grad, *, lr=None, grad_scale=1.0,
+                  clip_coef=1.0, step=None, bf16_out=None):
+        lr = self.lr if lr is None else float(lr)
+        step = self.step_count + 1 if step is None else int(step)
+        lib = _native()
+        if lib is not None:
+            lib.ds_adagrad_step(
+                _as_f32p(param), _as_f32p(v), _as_f32p(grad), param.size,
+                lr, self.eps, self.weight_decay, step, float(grad_scale),
+                float(clip_coef),
+                _as_u16p(bf16_out) if bf16_out is not None else None)
+        else:
+            g = grad * (clip_coef / grad_scale)
+            if self.weight_decay:
+                g = g + self.weight_decay * param
+            v += g * g
+            param -= lr * g / (np.sqrt(v) + self.eps)
+            if bf16_out is not None:
+                bf16_out[:] = f32_to_bf16(param)
+        return param
+
+
+# ---------------------------------------------------------- flat helpers
+def f32_to_bf16(a):
+    """Round-to-nearest-even f32 -> bf16 bit pattern (uint16 view)."""
+    lib = _native()
+    out = np.empty(a.size, np.uint16)
+    if lib is not None:
+        lib.ds_f32_to_bf16(_as_f32p(a), _as_u16p(out), a.size)
+    else:
+        bits = a.view(np.uint32)
+        rounding = np.uint32(0x7FFF) + ((bits >> 16) & 1)
+        out[:] = ((bits + rounding) >> 16).astype(np.uint16)
+    return out
+
+
+def l2_norm_sq(a):
+    lib = _native()
+    if lib is not None:
+        return float(lib.ds_l2_norm_sq(_as_f32p(a), a.size))
+    return float(np.dot(a.astype(np.float64), a.astype(np.float64)))
+
+
+def has_inf_nan(a):
+    lib = _native()
+    if lib is not None:
+        return bool(lib.ds_has_inf_nan(_as_f32p(a), a.size))
+    return not bool(np.isfinite(a).all())
+
+
+def axpy(acc, x):
+    lib = _native()
+    if lib is not None:
+        lib.ds_axpy(_as_f32p(acc), _as_f32p(x), acc.size)
+    else:
+        acc += x

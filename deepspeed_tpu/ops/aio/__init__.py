@@ -1,0 +1,108 @@
+"""Async file-IO handle for the NVMe swap tier (ZeRO-Infinity).
+
+Reference: the ``aio_handle`` built by ``op_builder/async_io.py`` from
+``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp:1`` — sync/async pread/pwrite
+with a thread pool, queue depth and block size. Same handle API here, over
+``csrc/aio.cpp`` (pthread pool + positional IO) via ctypes.
+"""
+
+import ctypes
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, OpBuilderError
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        b = AsyncIOBuilder()
+        if b.is_compatible():
+            try:
+                _lib = b.load()
+            except OpBuilderError:
+                _lib = None
+    return _lib
+
+
+class AioHandle:
+    """Thread-pooled positional file IO over numpy buffers.
+
+    Methods mirror the reference handle: async submissions + wait(), and
+    sync convenience wrappers. Falls back to synchronous numpy IO when the
+    native lib is unavailable (so tests run anywhere).
+    """
+
+    def __init__(self, block_size=1 << 20, queue_depth=4, single_submit=False,
+                 overlap_events=True, thread_count=None, o_direct=False):
+        self.block_size = block_size
+        self.queue_depth = thread_count or queue_depth
+        lib = _native()
+        self._lib = lib
+        self._h = lib.ds_aio_new(block_size, self.queue_depth,
+                                 int(o_direct)) if lib else None
+        self._fallback_pending = []
+        self._inflight = []      # keep submitted buffers alive until wait()
+
+    def async_pread(self, buf, path, offset=0):
+        # reads land in the caller's buffer: it must already be contiguous
+        # (a copy here would silently drop the data)
+        assert buf.flags["C_CONTIGUOUS"], "read buffer must be contiguous"
+        if self._h:
+            self._inflight.append(buf)
+            self._lib.ds_aio_submit_read(
+                self._h, str(path).encode(), buf.ctypes.data,
+                buf.nbytes, offset)
+        else:
+            self._fallback_pending.append(("r", buf, str(path), offset))
+        return buf
+
+    def async_pwrite(self, buf, path, offset=0):
+        buf = np.ascontiguousarray(buf)
+        if self._h:
+            self._inflight.append(buf)
+            self._lib.ds_aio_submit_write(
+                self._h, str(path).encode(), buf.ctypes.data,
+                buf.nbytes, offset)
+        else:
+            self._fallback_pending.append(("w", buf, str(path), offset))
+        return buf
+
+    def wait(self):
+        if self._h:
+            errs = self._lib.ds_aio_wait(self._h)
+            self._inflight.clear()
+            if errs:
+                raise IOError(f"aio: {errs} request(s) failed")
+            return 0
+        for op, buf, path, offset in self._fallback_pending:
+            if op == "w":
+                with open(path, "r+b" if offset else "wb") as f:
+                    f.seek(offset)
+                    f.write(buf.tobytes())
+            else:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(buf.nbytes)
+                buf[...] = np.frombuffer(data, buf.dtype).reshape(buf.shape)
+        self._fallback_pending.clear()
+        return 0
+
+    def sync_pread(self, buf, path, offset=0):
+        self.async_pread(buf, path, offset)
+        self.wait()
+        return buf
+
+    def sync_pwrite(self, buf, path, offset=0):
+        self.async_pwrite(buf, path, offset)
+        self.wait()
+        return buf
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and self._lib:
+            self._lib.ds_aio_free(h)

@@ -1,0 +1,168 @@
+"""Native-op build system: compile C++ host ops on first use, cache by
+source hash, bind via ctypes.
+
+Reference: ``op_builder/builder.py:99,438`` (OpBuilder.is_compatible/load,
+jit_load) and the registry ``op_builder/all_ops.py:33``. The reference JIT
+builds torch CUDA extensions with ninja; here the native surface is
+host-side C++ (host optimizer for ZeRO-Offload, async file IO for
+ZeRO-Infinity — the TPU compute path is Pallas/XLA, not custom device
+code), compiled with g++ into a shared object under ``~/.cache`` and bound
+with ctypes so no pybind11 is needed.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "..", "csrc")
+_CACHE = os.environ.get(
+    "DEEPSPEED_TPU_OP_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops"))
+
+
+class OpBuilderError(RuntimeError):
+    pass
+
+
+class OpBuilder:
+    """Compile-and-load for one C++ translation unit.
+
+    Same contract as the reference builder: ``is_compatible()`` answers
+    cheaply without building, ``load()`` returns the bound module (here a
+    ctypes.CDLL) building it if needed.
+    """
+
+    NAME = None          # registry key (e.g. "cpu_adam")
+    SOURCE = None        # file under csrc/
+    EXTRA_FLAGS = ()
+
+    _loaded = {}
+
+    def source_path(self):
+        return os.path.abspath(os.path.join(_CSRC, self.SOURCE))
+
+    def compiler(self):
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self, verbose=False):
+        if not os.path.exists(self.source_path()):
+            return False
+        try:
+            subprocess.run([self.compiler(), "--version"], capture_output=True,
+                           check=True)
+            return True
+        except (OSError, subprocess.CalledProcessError):
+            return False
+
+    def base_flags(self):
+        flags = ["-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp"]
+        # AVX2 is the reference's SIMD floor (csrc/includes/simd.h); fall
+        # back transparently if the toolchain refuses the flag.
+        if self._flag_ok("-mavx2"):
+            flags.append("-mavx2")
+        return flags + list(self.EXTRA_FLAGS)
+
+    def _flag_ok(self, flag):
+        with tempfile.NamedTemporaryFile("w", suffix=".cpp") as f:
+            f.write("int main(){return 0;}")
+            f.flush()
+            r = subprocess.run(
+                [self.compiler(), flag, f.name, "-o", os.devnull],
+                capture_output=True)
+            return r.returncode == 0
+
+    def _so_path(self):
+        with open(self.source_path(), "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        return os.path.join(_CACHE, f"{self.NAME}_{tag}.so")
+
+    def load(self, verbose=False):
+        if self.NAME in OpBuilder._loaded:
+            return OpBuilder._loaded[self.NAME]
+        so = self._so_path()
+        if not os.path.exists(so):
+            os.makedirs(_CACHE, exist_ok=True)
+            cmd = [self.compiler(), *self.base_flags(),
+                   self.source_path(), "-o", so + ".tmp"]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise OpBuilderError(
+                    f"building {self.NAME} failed:\n{' '.join(cmd)}\n{r.stderr}")
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        self._annotate(lib)
+        OpBuilder._loaded[self.NAME] = lib
+        return lib
+
+    def _annotate(self, lib):
+        """Set argtypes/restype for type safety; subclasses override."""
+
+
+_i64 = ctypes.c_int64
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+    SOURCE = "host_adam.cpp"
+
+    def _annotate(self, lib):
+        lib.ds_adam_step.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, _i64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, _u16p]
+        lib.ds_adagrad_step.argtypes = [
+            _f32p, _f32p, _f32p, _i64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            _u16p]
+        lib.ds_l2_norm_sq.argtypes = [_f32p, _i64]
+        lib.ds_l2_norm_sq.restype = ctypes.c_double
+        lib.ds_has_inf_nan.argtypes = [_f32p, _i64]
+        lib.ds_has_inf_nan.restype = ctypes.c_int
+        lib.ds_axpy.argtypes = [_f32p, _f32p, _i64]
+        lib.ds_scale.argtypes = [_f32p, _i64, ctypes.c_float]
+        lib.ds_f32_to_bf16.argtypes = [_f32p, _u16p, _i64]
+        lib.ds_bf16_to_f32.argtypes = [_u16p, _f32p, _i64]
+
+
+class CPUAdagradBuilder(CPUAdamBuilder):
+    """Adagrad shares the translation unit (reference keeps separate
+    csrc/adagrad; one TU serves both here) — and therefore the .so."""
+    NAME = "cpu_adagrad"
+
+    def _so_path(self):
+        return CPUAdamBuilder()._so_path()
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+    SOURCE = "aio.cpp"
+    EXTRA_FLAGS = ("-pthread",)
+
+    def _annotate(self, lib):
+        lib.ds_aio_new.argtypes = [_i64, ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_new.restype = ctypes.c_void_p
+        lib.ds_aio_submit_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, _i64, _i64]
+        lib.ds_aio_submit_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, _i64, _i64]
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_wait.restype = ctypes.c_int
+        lib.ds_aio_free.argtypes = [ctypes.c_void_p]
+
+
+ALL_OPS = {b.NAME: b for b in (CPUAdamBuilder(), CPUAdagradBuilder(),
+                               AsyncIOBuilder())}
+
+
+def op_report():
+    """[(name, compatible, installed)] for ds_report (reference
+    deepspeed/env_report.py)."""
+    rows = []
+    for name, b in ALL_OPS.items():
+        rows.append((name, b.is_compatible(), os.path.exists(b._so_path())
+                     if b.is_compatible() else False))
+    return rows

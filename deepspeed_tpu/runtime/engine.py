@@ -100,6 +100,14 @@ class DeepSpeedEngine:
         self._config = DeepSpeedConfig(raw if raw is not None else config,
                                        dp_world_size=self.dp_world_size)
         self.zero_stage = self._config.zero_optimization_stage
+        # ZeRO-Offload / ZeRO-Infinity: host-RAM (or NVMe) optimizer state
+        # (runtime/zero/offload.py; reference stage_1_and_2.py CPU path)
+        _oc = self._config.zero_config.offload_optimizer
+        self._offload_cfg = _oc if (_oc is not None and
+                                    str(_oc.device.value
+                                        if hasattr(_oc.device, "value")
+                                        else _oc.device) != "none") else None
+        self._offload = None
         self.compute_dtype = DTYPES[self._config.precision_dtype]
         self.fp16_enabled = self._config.fp16.enabled
         self.bfloat16_enabled = self._config.bf16.enabled
@@ -181,12 +189,16 @@ class DeepSpeedEngine:
 
     @property
     def loss_scale(self):
+        if self._offload is not None:
+            return float(self._offload.scaler.loss_scale)
         if self.state is None:
             return 1.0
         return float(jax.device_get(self._live_state().scaler.loss_scale))
 
     @property
     def skipped_steps(self):
+        if self._offload is not None:
+            return self._offload.skipped_steps
         if self.state is None:
             return 0
         return int(jax.device_get(self._live_state().skipped_steps))
@@ -265,8 +277,12 @@ class DeepSpeedEngine:
                                             self.zero_stage, kind="param")
         opt_param_pspecs = shd.tree_pspecs(mesh, shapes, logical,
                                            self.zero_stage, kind="opt")
-        opt_shapes = jax.eval_shape(self.tx.init, shapes)
-        self.opt_pspecs = shd.opt_state_pspecs(opt_shapes, shapes, opt_param_pspecs)
+        if self._offload_cfg is not None:
+            self.opt_pspecs = ()   # optimizer state lives on the host
+        else:
+            opt_shapes = jax.eval_shape(self.tx.init, shapes)
+            self.opt_pspecs = shd.opt_state_pspecs(opt_shapes, shapes,
+                                                   opt_param_pspecs)
         self.grad_pspecs = opt_param_pspecs if self.zero_stage >= 2 \
             else self.param_pspecs
 
@@ -279,7 +295,30 @@ class DeepSpeedEngine:
             return shd.unbox(variables.get("params", variables))
 
         params = jax.jit(init_params, out_shardings=param_sh)(init_rng)
-        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
+        if self._offload_cfg is not None:
+            # ZeRO-Offload: pull the fp32 master to host, keep only the
+            # compute-dtype copy on the chip, moments live host/NVMe.
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            self._offload = HostOffloadOptimizer(
+                self.optimizer_name, self._config.optimizer.params,
+                gradient_clipping=self._config.gradient_clipping,
+                fp16_cfg=self._config.fp16, fp16_enabled=self.fp16_enabled,
+                offload_cfg=self._offload_cfg,
+                aio_config=self._config.aio_config)
+            host_leaves = [np.asarray(jax.device_get(l))
+                           for l in jax.tree.leaves(params)]
+            self._offload.init_master(host_leaves)
+            compute_dtype = self.compute_dtype
+            cast_fn = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: x.astype(compute_dtype), p),
+                out_shardings=param_sh, donate_argnums=(0,))
+            params = cast_fn(params)
+            self._param_treedef = jax.tree.structure(params)
+            self._param_sh_flat = jax.tree.leaves(param_sh)
+            opt_state = ()      # optimizer state lives on the host
+        else:
+            opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
 
         scaler = make_loss_scale_state(self._config.fp16, self.fp16_enabled)
         self.state = TrainState(step=jnp.int32(0), skipped_steps=jnp.int32(0),
@@ -461,6 +500,15 @@ class DeepSpeedEngine:
         dev_batch = self._put_batch(batch)
         if rng is None:
             rng, self._rng = jax.random.split(self._rng)
+        if self._offload is not None:
+            # offload mode: grads ship to host in backward(), the host
+            # optimizer applies in step() — the jit graph is fwd+bwd only
+            scale = jnp.float32(self._offload.scaler.loss_scale)
+            loss, grads = self._micro_first(
+                self.state.params, scale, dev_batch, rng)
+            self._pending = ("offload", loss, grads)
+            self.timers(FORWARD_GLOBAL_TIMER).stop()
+            return loss
         boundary = (self.micro_steps + 1) % self.gas == 0
         rest = self.state.replace(params=None, opt_state=None)
         if self.gas == 1:
@@ -496,6 +544,14 @@ class DeepSpeedEngine:
         kind = self._pending[0]
         if kind == "acc":
             self._grad_acc = self._pending[2]
+        elif kind == "offload":
+            # async D2H of the (compute-dtype) grads, then host fp32
+            # accumulation — the reference's
+            # async_accumulate_grad_in_cpu_via_gpu (stage_1_and_2.py:1031)
+            grads = self._pending[2]
+            jax.tree.map(lambda g: g.copy_to_host_async(), grads)
+            self._offload.accumulate(
+                [np.asarray(g) for g in jax.tree.leaves(grads)])
         else:
             self._next_state = self._pending[2]
             self._next_metrics = self._pending[3]
@@ -513,6 +569,8 @@ class DeepSpeedEngine:
         forward(); this publishes the new state and advances schedules."""
         if self.micro_steps % self.gas != 0:
             return  # mid-accumulation: nothing to do (reference no-ops too)
+        if self._offload is not None:
+            return self._offload_step()
         assert self._next_state is not None, \
             "step() must follow forward()+backward() at the GAS boundary"
         self.timers(STEP_GLOBAL_TIMER).start()
@@ -533,6 +591,38 @@ class DeepSpeedEngine:
             self.monitor.write_events(
                 [("Train/Samples/lr", lr, self.global_samples),
                  ("Train/Samples/loss_scale", float(m["loss_scale"]),
+                  self.global_samples)])
+        return metrics
+
+    def _offload_step(self):
+        """Boundary step in ZeRO-Offload mode: host Adam over the
+        accumulated grads, then push the new compute-dtype params back."""
+        self.timers(STEP_GLOBAL_TIMER).start()
+        lr = float(self.get_lr()[0])
+        emit_bf16 = self.compute_dtype == jnp.bfloat16
+        leaves, metrics = self._offload.step(lr)
+        if emit_bf16:
+            import ml_dtypes
+            dev_leaves = [l.view(ml_dtypes.bfloat16) for l in leaves]
+        else:
+            dt = np.dtype(self.compute_dtype)
+            dev_leaves = [m.reshape(s).astype(dt) for m, s in
+                          zip(self._offload.master, self._offload.shapes)]
+        put = jax.device_put(dev_leaves, self._param_sh_flat)
+        new_params = jax.tree_util.tree_unflatten(self._param_treedef, put)
+        self.state = self.state.replace(
+            params=new_params, step=self.state.step + 1,
+            skipped_steps=jnp.int32(self._offload.skipped_steps))
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._last_metrics = metrics
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        if self.monitor.enabled and self.global_steps % \
+                self._config.steps_per_print == 0:
+            self.monitor.write_events(
+                [("Train/Samples/lr", lr, self.global_samples),
+                 ("Train/Samples/loss_scale", float(metrics["loss_scale"]),
                   self.global_samples)])
         return metrics
 
@@ -606,6 +696,11 @@ class DeepSpeedEngine:
             if isinstance(self.lr_scheduler, LRScheduler) else None,
         })
         save_state(path, self._live_state(), client)
+        if self._offload is not None:
+            # fp32 master + moments live host-side; persisted next to the
+            # model states (reference *_optim_states.pt per rank)
+            np.savez(os.path.join(path, "host_optim_states.npz"),
+                     **self._offload.state_dict())
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
@@ -630,6 +725,16 @@ class DeepSpeedEngine:
                 "load_checkpoint before init needs example_batch"
             self._ensure_initialized(batch)
         self.state, client = load_state(path, self.state, mesh=self.mesh)
+        host_opt = os.path.join(path, "host_optim_states.npz")
+        if self._offload is not None and os.path.exists(host_opt):
+            if load_optimizer_states:
+                with np.load(host_opt) as d:
+                    self._offload.load_state_dict(dict(d))
+            else:
+                # params are authoritative: refresh the master from them
+                self._offload.init_master(
+                    [np.asarray(jax.device_get(l))
+                     for l in jax.tree.leaves(self.state.params)])
         self.global_steps = client.get("global_steps", 0)
         self.micro_steps = client.get("micro_steps", 0)
         self.global_samples = client.get("global_samples", 0)

@@ -1,0 +1,13 @@
+"""NVMe tensor swapping (ZeRO-Infinity disk tier).
+
+Reference: ``deepspeed/runtime/swap_tensor/`` — ``partitioned_param_swapper``,
+``optimizer_utils``, ``aio_config``. The TPU-native build keeps the swap
+machinery small: :class:`~deepspeed_tpu.runtime.zero.offload.NvmeMomentStore`
+streams optimizer moments through the C++ aio handle
+(``csrc/aio.cpp`` via ``deepspeed_tpu.ops.aio.AioHandle``) with
+double-buffered prefetch/writeback, and the host optimizer consumes them
+leaf by leaf (runtime/zero/offload.py).
+"""
+
+from deepspeed_tpu.ops.aio import AioHandle  # noqa: F401
+from deepspeed_tpu.runtime.zero.offload import NvmeMomentStore  # noqa: F401

@@ -1,20 +1,50 @@
-"""Checkpoint save/load of sharded train state.
+"""Sharded checkpoint save/load of distributed train state.
 
-Reference: ``runtime/checkpoint_engine/checkpoint_engine.py`` (torch.save) and
-engine ``save_checkpoint``/``load_checkpoint`` (engine.py:2818/2513). Arrays
-are addressed by pytree path, saved as a single .npz (gathered to host), and
-restored back onto whatever mesh/sharding the *current* run uses — which
-makes every checkpoint "universal" in the reference's sense
-(``deepspeed/checkpoint/universal_checkpoint.py``): a run with a different
-mesh layout or ZeRO stage can load it, because sharding is re-applied at
-restore, not baked into the file.
+Reference: engine ``save_checkpoint``/``load_checkpoint``
+(``runtime/engine.py:2818/2513``, per-rank ``*_optim_states.pt`` files),
+the ``CheckpointEngine`` abstraction
+(``runtime/checkpoint_engine/checkpoint_engine.py:9`` — Torch vs Nebula
+async tiered persistence), and the offline reshape/universal tools
+(``deepspeed/checkpoint/reshape_3d_utils.py:17``,
+``universal_checkpoint.py:12``).
+
+TPU-native shape of the idea
+----------------------------
+A checkpoint is a directory of **per-process chunk files**. Every process
+writes exactly the array shards its local devices own (deduplicated by
+``replica_id == 0``), so no host ever materializes the full state and save
+bandwidth scales with the number of hosts — the property the reference
+gets from per-rank ``*_optim_states.pt`` files. Chunks are addressed by
+*global index*, not by rank or mesh: the key is ``<leaf>|<start:stop,...>``.
+That makes every checkpoint **universal** in the reference's sense: a run
+with a different mesh, process count, or ZeRO stage rebuilds each leaf by
+assembling whatever chunk rectangles cover the slice its own devices need.
+Nothing in the file layout encodes the writer's parallelism.
+
+Layout::
+
+    <dir>/<tag>/
+      checkpoint_meta.json        # format, leaf -> {shape, dtype}, client state
+      shards_p00000.npz           # chunk files, one per writing process
+      shards_p00001.npz
+      host_optim_states.npz       # (ZeRO-Offload) fp32 master + moments
+
+Async save (the Nebula-engine capability) runs the device→host transfer
+and file write on a background thread; ``AsyncCheckpointWriter.wait()``
+joins it, and the engine exposes ``wait_checkpoint()``.
 """
 
+import io
 import json
 import os
+import threading
+import zipfile
 
 import jax
 import numpy as np
+
+_META = "checkpoint_meta.json"
+_FORMAT = 2
 
 
 def _flatten_named(tree):
@@ -24,39 +54,276 @@ def _flatten_named(tree):
     return names, leaves, treedef
 
 
-def save_state(path, state, client_state=None):
+def _index_key(index, shape):
+    """Canonical string for a global index: 'start:stop,start:stop,...'.
+    Scalar arrays use the empty string."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts)
+
+
+def _parse_index(key):
+    if not key:
+        return ()
+    return tuple(slice(int(a), int(b))
+                 for a, b in (p.split(":") for p in key.split(",")))
+
+
+def _full_index(shape):
+    return tuple(slice(0, d) for d in shape)
+
+
+def _write_npz_streaming(path, chunk_iter):
+    """Write an .npz one entry at a time (np.savez holds everything in
+    memory at once; a checkpoint writer must stay chunk-sized)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as z:
+        for key, arr in chunk_iter:
+            arr = np.ascontiguousarray(arr)
+            if arr.ndim == 0:
+                # this numpy's NpzFile reads 0-d entries back as (1,);
+                # store scalars as (1,) on purpose and reshape at read
+                arr = arr.reshape(1)
+            buf = io.BytesIO()
+            np.lib.format.write_array(buf, arr, allow_pickle=False)
+            z.writestr(key + ".npy", buf.getvalue())
+
+
+def _leaf_chunks(leaf):
+    """Yield (index_key, host_array) for the shards of `leaf` this process
+    owns, deduplicated across replicas. Non-jax leaves yield one full
+    chunk from process 0 only."""
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        seen = set()
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            key = _index_key(shard.index, leaf.shape)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield key, np.asarray(shard.data)
+    elif jax.process_index() == 0:
+        arr = np.asarray(leaf)
+        yield _index_key(_full_index(arr.shape), arr.shape), arr
+
+
+def save_state(path, state, client_state=None, async_write=False,
+               on_done=None):
+    """Save `state` (a pytree of jax/np arrays). Each process writes only
+    its addressable, replica-0 shards. Returns an AsyncCheckpointWriter
+    when async_write (caller must .wait()), else None. ``on_done`` runs on
+    process 0 after this process's shard file is durably written (the
+    engine uses it to flip the ``latest`` pointer).
+
+    Consistency: every save gets a fresh ``save_id``; shard files carry it
+    in their name and the loader only reads files matching the meta's id.
+    A crash mid-save therefore can never silently mix shard data from two
+    saves — an interrupted save of an existing tag fails *loudly* at load
+    (chunk-coverage error) instead of restoring stale weights under new
+    step counters. Shard files are written to a .tmp name and renamed, so
+    a half-written file never matches."""
     os.makedirs(path, exist_ok=True)
     names, leaves, _ = _flatten_named(state)
-    arrays = {}
+    import uuid
+    save_id = uuid.uuid4().hex[:12]
+
+    if jax.process_index() == 0:
+        meta = {
+            "format": _FORMAT,
+            "process_count": jax.process_count(),
+            "save_id": save_id,
+            "leaves": {
+                name: {"shape": list(np.shape(leaf)),
+                       "dtype": str(getattr(leaf, "dtype",
+                                            np.asarray(leaf).dtype))}
+                for name, leaf in zip(names, leaves)},
+            "client_state": client_state or {},
+        }
+        tmp_meta = os.path.join(path, _META + ".tmp")
+        with open(tmp_meta, "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        os.replace(tmp_meta, os.path.join(path, _META))
+
+    shard_file = os.path.join(
+        path, f"shards_p{jax.process_index():05d}.{save_id}.npz")
+
+    # Snapshot device -> host synchronously: the caller's very next train
+    # step donates optimizer buffers into XLA, so shard data must be read
+    # before returning; only the (slow) file write happens on the thread.
+    chunks = []
     for name, leaf in zip(names, leaves):
-        arrays[name] = np.asarray(jax.device_get(leaf))
-    np.savez(os.path.join(path, "model_states.npz"), **arrays)
-    with open(os.path.join(path, "client_state.json"), "w") as f:
-        json.dump(client_state or {}, f, indent=2, default=str)
+        for key, arr in _leaf_chunks(leaf):
+            chunks.append((f"{name}|{key}", arr))
+
+    def write():
+        _write_npz_streaming(shard_file + ".tmp", chunks)
+        os.replace(shard_file + ".tmp", shard_file)
+        # reclaim this process's shard files from earlier saves of the tag
+        me = f"shards_p{jax.process_index():05d}."
+        for fn in os.listdir(path):
+            if fn.startswith(me) and fn.endswith(".npz") and \
+                    save_id not in fn:
+                try:
+                    os.remove(os.path.join(path, fn))
+                except OSError:
+                    pass
+        if on_done is not None and jax.process_index() == 0:
+            on_done()
+
+    if async_write:
+        writer = AsyncCheckpointWriter(write)
+        writer.start()
+        return writer
+    write()
+    return None
 
 
-def load_state(path, target_state, mesh=None):
-    """Restore into the structure/shardings of `target_state`."""
-    state = load_subtree(path, target_state, prefix="")
-    client = {}
-    cs = os.path.join(path, "client_state.json")
-    if os.path.exists(cs):
-        with open(cs) as fh:
-            client = json.load(fh)
-    return state, client
+class AsyncCheckpointWriter:
+    """Background-thread writer (the Nebula-checkpoint-engine capability:
+    training resumes while the checkpoint drains to disk)."""
+
+    def __init__(self, fn):
+        self._err = None
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # surfaced on wait()
+                self._err = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def wait(self):
+        self._thread.join()
+        if self._err is not None:
+            raise self._err
 
 
-def load_subtree(path, target, prefix=""):
-    """Restore a subtree of a saved state into `target` (same structure),
-    re-applying each target leaf's sharding/dtype. `prefix` addresses the
-    subtree inside the saved pytree (e.g. ".params" for the TrainState's
-    parameter branch) — the engine-side half of the reference's
-    universal-checkpoint param-fragment loading
-    (deepspeed/checkpoint/universal_checkpoint.py:12)."""
-    f = os.path.join(path, "model_states.npz")
-    if not os.path.exists(f):
-        raise FileNotFoundError(f"checkpoint file not found: {f}")
-    data = np.load(f, allow_pickle=False)
+class _ChunkIndex:
+    """Registry of all chunk rectangles across a checkpoint's shard files,
+    with lazy (zip-entry-at-a-time) reads."""
+
+    def __init__(self, path):
+        self.path = path
+        self.by_leaf = {}      # name -> list of (index_key, file, zip_name)
+        self._files = {}
+        self.meta = None
+        meta_f = os.path.join(path, _META)
+        if os.path.exists(meta_f):
+            with open(meta_f) as fh:
+                self.meta = json.load(fh)
+        nprocs = (self.meta or {}).get("process_count")
+        save_id = (self.meta or {}).get("save_id")
+        for fn in sorted(os.listdir(path)):
+            if not (fn.startswith("shards_p") and fn.endswith(".npz")):
+                continue
+            stem = fn[len("shards_p"):-len(".npz")]
+            pidx, _, fid = stem.partition(".")
+            if save_id is not None and fid != save_id:
+                continue  # stale file from a different save of this tag
+            if nprocs is not None and int(pidx) >= nprocs:
+                continue  # stale file from an older, wider save
+            full = os.path.join(path, fn)
+            npz = np.load(full, allow_pickle=False)
+            self._files[fn] = npz
+            for zkey in npz.files:
+                name, _, idx = zkey.rpartition("|")
+                self.by_leaf.setdefault(name, []).append((idx, fn, zkey))
+
+    def saved_shape(self, name):
+        """Authoritative global shape from the meta (falls back to chunk
+        max-stops for meta-less checkpoints)."""
+        leaves = (self.meta or {}).get("leaves", {})
+        if name in leaves:
+            return tuple(leaves[name]["shape"])
+        return self.leaf_shape(name)
+
+    def names(self):
+        return list(self.by_leaf)
+
+    def leaf_shape(self, name):
+        stops = None
+        for idx, _, _ in self.by_leaf[name]:
+            sls = [p.split(":") for p in idx.split(",")] if idx else []
+            ends = [int(b) for _, b in sls]
+            stops = ends if stops is None else \
+                [max(a, b) for a, b in zip(stops, ends)]
+        return tuple(stops or ())
+
+    def read(self, fn, zkey):
+        return self._files[fn][zkey]
+
+    def assemble(self, name, out_index, shape, dtype):
+        """Build the sub-array `out_index` (tuple of concrete slices) of
+        leaf `name` from whatever chunk rectangles overlap it."""
+        out_shape = tuple(sl.stop - sl.start for sl in out_index)
+        out = np.empty(out_shape, dtype)
+        filled = 0
+        for idx_key, fn, zkey in self.by_leaf[name]:
+            cidx = _parse_index(idx_key)
+            inter = []
+            for o, c in zip(out_index, cidx):
+                lo, hi = max(o.start, c.start), min(o.stop, c.stop)
+                if lo >= hi:
+                    inter = None
+                    break
+                inter.append((lo, hi))
+            if inter is None and len(out_index) > 0:
+                continue
+            chunk = self.read(fn, zkey)
+            if not out_index:  # scalar (stored as (1,), see writer)
+                return chunk.reshape(()).astype(dtype)
+            dst = tuple(slice(lo - o.start, hi - o.start)
+                        for (lo, hi), o in zip(inter, out_index))
+            src = tuple(slice(lo - c.start, hi - c.start)
+                        for (lo, hi), c in zip(inter, cidx))
+            out[dst] = chunk[src].astype(dtype)
+            filled += int(np.prod([hi - lo for lo, hi in inter]))
+        want = int(np.prod(out_shape))
+        if filled < want:
+            raise ValueError(
+                f"checkpoint chunks cover {filled}/{want} elements of "
+                f"{name}{out_index} — missing shard files?")
+        return out
+
+    def close(self):
+        for npz in self._files.values():
+            npz.close()
+
+
+def _normalize_index(index, shape):
+    return tuple(slice(0 if sl.start is None else int(sl.start),
+                       dim if sl.stop is None else int(sl.stop))
+                 for sl, dim in zip(index, shape))
+
+
+def _restore_leaf(chunks, key, leaf):
+    """Rebuild one leaf onto the target's sharding, reading only the
+    slices the local devices need."""
+    shape = tuple(np.shape(leaf))
+    dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and shape:
+        def cb(index):
+            return chunks.assemble(key, _normalize_index(index, shape),
+                                   shape, dtype)
+        return jax.make_array_from_callback(shape, sharding, cb)
+    full = chunks.assemble(key, _full_index(shape), shape, dtype)
+    if sharding is not None:  # scalar jax array
+        return jax.device_put(full, sharding)
+    return full
+
+
+def _load_format1(path, target, prefix):
+    """Back-compat: round-1 single-file .npz checkpoints."""
+    data = np.load(os.path.join(path, "model_states.npz"),
+                   allow_pickle=False)
     flat, treedef = jax.tree_util.tree_flatten_with_path(target)
     new = []
     for path_k, leaf in flat:
@@ -68,8 +335,106 @@ def load_subtree(path, target, prefix=""):
             raise ValueError(f"shape mismatch for {key}: checkpoint "
                              f"{arr.shape} vs target {np.shape(leaf)}")
         sharding = getattr(leaf, "sharding", None)
-        if sharding is not None:
-            new.append(jax.device_put(arr.astype(leaf.dtype), sharding))
-        else:
-            new.append(arr)
+        dtype = getattr(leaf, "dtype", arr.dtype)
+        new.append(jax.device_put(arr.astype(dtype), sharding)
+                   if sharding is not None else arr)
     return jax.tree_util.tree_unflatten(treedef, new)
+
+
+def load_subtree(path, target, prefix=""):
+    """Restore a subtree of a saved state into `target` (same structure),
+    re-applying each target leaf's sharding/dtype. `prefix` addresses the
+    subtree inside the saved pytree (e.g. ".params") — the engine-side
+    half of the reference's universal-checkpoint param-fragment loading
+    (deepspeed/checkpoint/universal_checkpoint.py:12)."""
+    if not os.path.exists(os.path.join(path, _META)):
+        return _load_format1(path, target, prefix)
+    chunks = _ChunkIndex(path)
+    try:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        new = []
+        for path_k, leaf in flat:
+            key = prefix + jax.tree_util.keystr(path_k)
+            if key not in chunks.by_leaf:
+                raise KeyError(f"checkpoint missing entry {key}")
+            saved = chunks.saved_shape(key)
+            if tuple(saved) != tuple(np.shape(leaf)):
+                raise ValueError(f"shape mismatch for {key}: checkpoint "
+                                 f"{saved} vs target {np.shape(leaf)}")
+            new.append(_restore_leaf(chunks, key, leaf))
+        return jax.tree_util.tree_unflatten(treedef, new)
+    finally:
+        chunks.close()
+
+
+def load_state(path, target_state, mesh=None):
+    """Restore into the structure/shardings of `target_state`; returns
+    (state, client_state). The saving run's mesh/ZeRO layout is irrelevant
+    — chunks are globally indexed."""
+    state = load_subtree(path, target_state, prefix="")
+    client = {}
+    meta_f = os.path.join(path, _META)
+    if os.path.exists(meta_f):
+        with open(meta_f) as fh:
+            client = json.load(fh).get("client_state", {})
+    else:
+        cs = os.path.join(path, "client_state.json")
+        if os.path.exists(cs):
+            with open(cs) as fh:
+                client = json.load(fh)
+    return state, client
+
+
+def consolidate(path, out_file, prefix=".params", dtype=np.float32):
+    """zero_to_fp32 equivalent (reference utils/zero_to_fp32.py:313):
+    stream-merge a sharded checkpoint's param leaves into one fp32 .npz,
+    one leaf in memory at a time. Prefers the ZeRO-Offload fp32 master
+    copy when present (it is the authoritative high-precision state)."""
+    if not os.path.exists(os.path.join(path, _META)) and \
+            os.path.exists(os.path.join(path, "model_states.npz")):
+        # round-1 single-file checkpoints
+        with np.load(os.path.join(path, "model_states.npz"),
+                     allow_pickle=False) as d:
+            def f1_iter():
+                for k in d.files:
+                    if k.startswith(prefix):
+                        yield k, d[k].astype(dtype)
+            _write_npz_streaming(out_file, f1_iter())
+        return out_file
+    chunks = _ChunkIndex(path)
+    master_npz = None
+    try:
+        # tree order, as recorded in the meta (matches the offload
+        # optimizer's master_{i} flat-leaf numbering)
+        if chunks.meta is not None:
+            names = [n for n in chunks.meta["leaves"] if n.startswith(prefix)]
+        else:
+            names = [n for n in chunks.names() if n.startswith(prefix)]
+        if not names:
+            raise ValueError(f"no leaves under {prefix!r} in {path}")
+        master_of = {}          # name -> master_{i} key, read lazily
+        host_opt = os.path.join(path, "host_optim_states.npz")
+        if os.path.exists(host_opt):
+            master_npz = np.load(host_opt, allow_pickle=False)
+            n_master = sum(1 for k in master_npz.files
+                           if k.startswith("master_"))
+            if n_master == len(names):
+                master_of = {name: f"master_{i}"
+                             for i, name in enumerate(names)}
+
+        def leaf_iter():
+            for name in names:
+                shape = chunks.saved_shape(name)
+                if name in master_of:
+                    arr = master_npz[master_of[name]].reshape(shape) \
+                        .astype(dtype)
+                else:
+                    arr = chunks.assemble(name, _full_index(shape), shape,
+                                          dtype)
+                yield name, arr
+        _write_npz_streaming(out_file, leaf_iter())
+    finally:
+        if master_npz is not None:
+            master_npz.close()
+        chunks.close()
+    return out_file

@@ -1,0 +1,50 @@
+"""Offline consolidation of a sharded checkpoint into one fp32 .npz.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py:313,362`` — the script users
+run on a ZeRO checkpoint directory to merge per-rank partitioned fp32
+state into a single loadable state dict. Here chunks are globally indexed
+so consolidation is a streaming merge, one leaf in memory at a time.
+
+Usage::
+
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.npz>
+
+``<ckpt_dir>`` may be the run directory (the ``latest`` file is followed,
+like the reference) or a specific ``<dir>/<tag>`` directory.
+"""
+
+import argparse
+import os
+import sys
+
+from deepspeed_tpu.checkpoint.engine import _META, consolidate
+
+
+def resolve_tag_dir(path):
+    if os.path.exists(os.path.join(path, _META)) or \
+            os.path.exists(os.path.join(path, "model_states.npz")):
+        return path
+    latest = os.path.join(path, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return os.path.join(path, f.read().strip())
+    raise FileNotFoundError(f"{path} is not a checkpoint directory")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Merge a sharded deepspeed_tpu checkpoint into a "
+                    "single fp32 .npz of model weights.")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--prefix", default=".params",
+                   help="pytree path prefix of the weights subtree")
+    args = p.parse_args(argv)
+    tag_dir = resolve_tag_dir(args.checkpoint_dir)
+    out = consolidate(tag_dir, args.output_file, prefix=args.prefix)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -681,8 +681,12 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
-        """Reference layout (engine.py:2818): <dir>/<tag>/ + `latest` file."""
+                        save_latest=True, async_save=False):
+        """Reference layout (engine.py:2818): <dir>/<tag>/ + `latest` file.
+        Each process writes only its own shards (reference per-rank
+        ``*_optim_states.pt``); ``async_save`` drains to disk on a
+        background thread (the Nebula-engine capability) — call
+        ``wait_checkpoint()`` before relying on the files."""
         from deepspeed_tpu.checkpoint.engine import save_state
         assert self.state is not None, "nothing to save before first forward"
         tag = tag or f"global_step{self.global_steps}"
@@ -695,21 +699,42 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict()
             if isinstance(self.lr_scheduler, LRScheduler) else None,
         })
-        save_state(path, self._live_state(), client)
+        self.wait_checkpoint()
+
+        host_optim = None
         if self._offload is not None:
-            # fp32 master + moments live host-side; persisted next to the
-            # model states (reference *_optim_states.pt per rank)
-            np.savez(os.path.join(path, "host_optim_states.npz"),
-                     **self._offload.state_dict())
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            # fp32 master + moments live host-side (reference per-rank
+            # *_optim_states.pt). Snapshot now — the offload optimizer
+            # mutates these buffers in place on the next step — and write
+            # inside the (possibly async) job, before `latest` flips.
+            host_optim = {k: np.array(v, copy=True)
+                          for k, v in self._offload.state_dict().items()}
+
+        def finalize():
+            if host_optim is not None:
+                np.savez(os.path.join(path, "host_optim_states.npz"),
+                         **host_optim)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+
+        self._ckpt_writer = save_state(path, self._live_state(), client,
+                                       async_write=async_save,
+                                       on_done=finalize)
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
+
+    def wait_checkpoint(self):
+        """Join any in-flight async checkpoint write."""
+        writer = getattr(self, "_ckpt_writer", None)
+        if writer is not None:
+            self._ckpt_writer = None  # a failed write must not wedge retries
+            writer.wait()
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, example_batch=None):
         from deepspeed_tpu.checkpoint.engine import load_state
+        self.wait_checkpoint()
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):

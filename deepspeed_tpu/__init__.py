@@ -47,8 +47,13 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Build an inference engine (reference ``deepspeed.init_inference``)."""
-    from deepspeed_tpu.inference.engine import InferenceEngine
+    """Build an inference engine (reference ``deepspeed.init_inference``).
+
+    ``model`` may be a native flax module, a HF transformers model
+    instance, or a path to an HF checkpoint directory — the latter two
+    are ingested through the policy system
+    (``module_inject/replace_module.py:274`` capability)."""
+    from deepspeed_tpu.inference.engine import DTYPES, InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 
     params = kwargs.pop("params", None)
@@ -65,6 +70,21 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config or {})
         merged.update(kwargs)
         cfg = DeepSpeedInferenceConfig(**merged)
+
+    is_hf_instance = hasattr(model, "state_dict") and hasattr(model, "config")
+    is_hf_dir = False
+    if isinstance(model, str):
+        import os
+        is_hf_dir = os.path.isdir(model) and (
+            os.path.exists(os.path.join(model, "config.json")))
+    if is_hf_instance or is_hf_dir:
+        if cfg.dtype not in DTYPES:
+            raise ValueError(
+                f"unsupported inference dtype {cfg.dtype!r}; pick one of "
+                f"{sorted(DTYPES)} (int8 weight quantization is configured "
+                "via the quant section, not dtype)")
+        from deepspeed_tpu.module_inject import from_hf
+        model, params = from_hf(model, dtype=DTYPES[cfg.dtype])
     return InferenceEngine(model, cfg, params=params, mesh=mesh)
 
 

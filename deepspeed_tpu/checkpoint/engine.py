@@ -110,6 +110,20 @@ def _leaf_chunks(leaf):
         yield _index_key(_full_index(arr.shape), arr.shape), arr
 
 
+def _agree_save_id():
+    """One save_id shared by ALL processes: generated on process 0 and
+    broadcast. A per-process uuid would stamp every host's shard file
+    differently — the loader (which trusts the meta's id) would then drop
+    every non-process-0 shard."""
+    import uuid
+    if jax.process_count() == 1:
+        return uuid.uuid4().hex[:12]
+    from jax.experimental import multihost_utils
+    bits = np.frombuffer(uuid.uuid4().bytes[:8], dtype=np.int64).copy()
+    bits = multihost_utils.broadcast_one_to_all(bits)
+    return f"{int(bits[0]) & ((1 << 48) - 1):012x}"
+
+
 def save_state(path, state, client_state=None, async_write=False,
                on_done=None):
     """Save `state` (a pytree of jax/np arrays). Each process writes only
@@ -127,8 +141,7 @@ def save_state(path, state, client_state=None, async_write=False,
     a half-written file never matches."""
     os.makedirs(path, exist_ok=True)
     names, leaves, _ = _flatten_named(state)
-    import uuid
-    save_id = uuid.uuid4().hex[:12]
+    save_id = _agree_save_id()
 
     if jax.process_index() == 0:
         meta = {
@@ -170,6 +183,13 @@ def save_state(path, state, client_state=None, async_write=False,
                     os.remove(os.path.join(path, fn))
                 except OSError:
                     pass
+        if jax.process_count() > 1:
+            # all hosts' shard files must be durable before the `latest`
+            # pointer flips; in async mode this barrier runs on the writer
+            # thread, so it must not interleave with another collective —
+            # the engine serializes saves via wait_checkpoint()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_done:{save_id}")
         if on_done is not None and jax.process_index() == 0:
             on_done()
 

@@ -205,8 +205,15 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- generate
     def _supports_cache(self):
+        from deepspeed_tpu.models.gpt2 import GPT2
         from deepspeed_tpu.models.llama import Llama
-        return isinstance(self.module, Llama)
+        return isinstance(self.module, (Llama, GPT2))
+
+    def _init_cache(self, batch_size, max_len):
+        from deepspeed_tpu.models import gpt2, llama
+        mod = llama if isinstance(self.module, llama.Llama) else gpt2
+        return mod.init_kv_cache(self.module.cfg, batch_size,
+                                 max_len=max_len, dtype=self.kv_dtype)
 
     def _build_gen_fns(self):
         module = self.module
@@ -258,9 +265,7 @@ class InferenceEngine:
                                           temperature, top_k, top_p,
                                           eos_token_id)
 
-        from deepspeed_tpu.models.llama import init_kv_cache
-        cache = init_kv_cache(self.module.cfg, b, max_len=max_len,
-                              dtype=self.kv_dtype)
+        cache = self._init_cache(b, max_len)
         if self._prefill_fn is None:
             self._build_gen_fns()
 

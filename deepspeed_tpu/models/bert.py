@@ -34,6 +34,8 @@ class BertConfig:
     remat: bool = False
     attn_impl: str = "auto"
     pre_layer_norm: bool = True        # reference kernel supports both
+    activation: str = "gelu"           # "gelu" (tanh approx) | "gelu_exact"
+    mlm_bias: bool = False             # HF cls.predictions.bias
 
     @property
     def head_dim(self):
@@ -101,7 +103,7 @@ class BertLayer(nn.Module):
             x = ln1(x + attn(x, attention_mask, deterministic))
             h = x
         h = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "fc_in")(h)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=cfg.activation != "gelu_exact")
         h = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "fc_out")(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
@@ -150,10 +152,16 @@ class Bert(nn.Module):
 
         # MLM head: transform + tied decoder (HF BertLMPredictionHead shape)
         h = _dense(cfg, cfg.hidden_size, ("embed", "embed"), "mlm_transform")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=cfg.activation != "gelu_exact")
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="mlm_ln")(h)
         logits = jnp.einsum("ble,ve->blv", h, wte_v.astype(cfg.dtype))
+        if cfg.mlm_bias:
+            b_dec = self.param("mlm_decoder_bias", nn.with_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)),
+                (cfg.vocab_size,), cfg.param_dtype)
+            b_dec = b_dec.value if hasattr(b_dec, "value") else b_dec
+            logits = logits + b_dec.astype(cfg.dtype)
         return logits
 
 

@@ -21,6 +21,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from deepspeed_tpu.ops.attention.reference import causal_mask, mha_reference
 
@@ -40,6 +41,12 @@ class GPTConfig:
     attn_impl: str = "auto"            # "auto" | "reference" | "flash"
     use_bias: bool = True
     tie_embeddings: bool = True
+    layer_norm_eps: float = 1e-5       # HF GPT-2/OPT/BLOOM value
+    activation: str = "gelu"           # "gelu" (GPT-2/BLOOM) | "relu" (OPT)
+    pos_embed: str = "learned"         # "learned" | "none" (ALiBi models)
+    pos_offset: int = 0                # OPT stores positions at index+2
+    embed_layernorm: bool = False      # BLOOM word_embeddings_layernorm
+    use_alibi: bool = False            # BLOOM attention bias
     # MoE (reference deepspeed/moe): every `moe_every`-th block swaps its MLP
     # for a sharded MoE layer
     moe_num_experts: int = 0
@@ -66,11 +73,26 @@ def _dense(features, cfg, kernel_axes, name=None, use_bias=None):
         name=name)
 
 
+def alibi_slopes(num_heads):
+    """ALiBi per-head slopes (BLOOM attention; Press et al. closed form)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        return jnp.asarray(pow2_slopes(num_heads), jnp.float32)
+    closest = 2 ** math.floor(math.log2(num_heads))
+    extra = pow2_slopes(2 * closest)[0::2][:num_heads - closest]
+    return jnp.asarray(pow2_slopes(closest) + extra, jnp.float32)
+
+
 class SelfAttention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
         b, l, _ = x.shape
         qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv")(x)
@@ -78,29 +100,60 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, l, cfg.num_heads, cfg.head_dim)
-        impl = cfg.attn_impl
-        if impl == "auto":
-            # the Pallas kernel needs block-aligned seq lens; oracle otherwise
-            impl = "flash" if (jax.default_backend() == "tpu" and
-                               l % 128 == 0) else "reference"
-        if impl == "flash":
-            from deepspeed_tpu.ops.attention import flash_attention
-            out = flash_attention(q, k, v, causal=True)
-        elif impl in ("ring", "ulysses"):
-            # sequence/context parallelism over the `sequence` mesh axis
-            from deepspeed_tpu import comm as dist
-            from deepspeed_tpu.sequence import DistributedAttention
-            mesh = dist.get_mesh()
-            assert mesh is not None and mesh.shape.get("sequence", 1) > 1, \
-                f"attn_impl={impl} needs a mesh with a sequence axis > 1"
-            out = DistributedAttention(mesh, impl=impl)(q, k, v)
+
+        new_cache = None
+        if cache is not None:
+            # decode: append k/v at cache["index"], attend over the valid
+            # prefix with a positional mask (same scheme as models/llama.py)
+            k_cache = lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype),
+                (0, cache["index"], 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype),
+                (0, cache["index"], 0, 0))
+            new_cache = {"k": k_cache, "v": v_cache,
+                         "index": cache["index"] + l}
+            max_len = k_cache.shape[1]
+            k_pos = jnp.arange(max_len)
+            mask = k_pos[None, None, :] <= positions[:, :, None]  # [b,l,max]
+            bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)[:, None]
+            if cfg.use_alibi:
+                # softmax is shift-invariant per query row, so
+                # slopes * key_pos == slopes * (key_pos - query_pos)
+                bias = bias + (alibi_slopes(cfg.num_heads)[None, :, None, None]
+                               * k_pos[None, None, None, :])
+            from deepspeed_tpu.ops.attention import decode_attention
+            out = decode_attention(q, k_cache, v_cache, bias=bias)
+        elif cfg.use_alibi:
+            k_pos = jnp.arange(l)
+            bias = (alibi_slopes(cfg.num_heads)[None, :, None, None] *
+                    k_pos[None, None, None, :])
+            out = mha_reference(q, k, v, causal=True, bias=bias)
         else:
-            out = mha_reference(q, k, v, causal=True)
+            impl = cfg.attn_impl
+            if impl == "auto":
+                # Pallas kernel needs block-aligned seq lens; oracle otherwise
+                impl = "flash" if (jax.default_backend() == "tpu" and
+                                   l % 128 == 0) else "reference"
+            if impl == "flash":
+                from deepspeed_tpu.ops.attention import flash_attention
+                out = flash_attention(q, k, v, causal=True)
+            elif impl in ("ring", "ulysses"):
+                # sequence/context parallelism over the `sequence` mesh axis
+                from deepspeed_tpu import comm as dist
+                from deepspeed_tpu.sequence import DistributedAttention
+                mesh = dist.get_mesh()
+                assert mesh is not None and \
+                    mesh.shape.get("sequence", 1) > 1, \
+                    f"attn_impl={impl} needs a mesh with a sequence axis > 1"
+                out = DistributedAttention(mesh, impl=impl)(q, k, v)
+            else:
+                out = mha_reference(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.hidden_size)
         out = _dense(cfg.hidden_size, cfg, ("heads", "embed"), name="proj")(out)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
-        return out
+        return out, new_cache
 
 
 class MLP(nn.Module):
@@ -111,7 +164,8 @@ class MLP(nn.Module):
         cfg = self.cfg
         h = _dense(cfg.mlp_ratio * cfg.hidden_size, cfg, ("embed", "mlp"),
                    name="fc_in")(x)
-        h = nn.gelu(h)
+        h = nn.relu(h) if cfg.activation == "relu" else \
+            nn.gelu(h, approximate=cfg.activation != "gelu_exact")
         h = _dense(cfg.hidden_size, cfg, ("mlp", "embed"), name="fc_out")(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
@@ -123,11 +177,15 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
-        x = x + SelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        attn_out, new_cache = SelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_1")(x),
+            deterministic, cache, positions)
+        x = x + attn_out
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_2")(x)
         if self.use_moe:
             from deepspeed_tpu.moe import MoE
             h, _, _ = MoE(hidden_size=cfg.hidden_size,
@@ -141,7 +199,7 @@ class Block(nn.Module):
                           name="moe")(h, deterministic)
         else:
             h = MLP(cfg, name="mlp")(h, deterministic)
-        return x + h
+        return x + h, new_cache
 
 
 def _make_embed_tables(mdl, cfg):
@@ -151,26 +209,33 @@ def _make_embed_tables(mdl, cfg):
         "wte",
         nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+    wte_v = wte.value if hasattr(wte, "value") else wte
+    if cfg.pos_embed == "none":
+        return wte_v, None
     wpe = mdl.param(
         "wpe",
         nn.with_partitioning(nn.initializers.normal(0.01), ("seq", "embed")),
-        (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-    wte_v = wte.value if hasattr(wte, "value") else wte
+        (cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size), cfg.param_dtype)
     wpe_v = wpe.value if hasattr(wpe, "value") else wpe
     return wte_v, wpe_v
 
 
-def _embed_tokens(wte_v, wpe_v, input_ids, cfg):
-    l = input_ids.shape[1]
-    return wte_v.astype(cfg.dtype)[input_ids] + \
-        wpe_v.astype(cfg.dtype)[jnp.arange(l)][None]
+def _embed_tokens(wte_v, wpe_v, input_ids, cfg, positions=None):
+    b, l = input_ids.shape
+    x = wte_v.astype(cfg.dtype)[input_ids]
+    if wpe_v is not None:
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(l)[None], (b, l))
+        x = x + wpe_v.astype(cfg.dtype)[positions + cfg.pos_offset]
+    return x
 
 
 def _head_logits(x, cfg, *, wte_v=None, dense_ctor=None):
     """ln_f + LM projection; tied path multiplies by wte, untied builds a
     lm_head Dense (caller supplies the constructors so params land on the
     calling module)."""
-    x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+    x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                     name="ln_f")(x)
     if cfg.tie_embeddings:
         assert wte_v is not None, "tied head needs the embedding table"
         return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype))
@@ -179,24 +244,41 @@ def _head_logits(x, cfg, *, wte_v=None, dense_ctor=None):
 
 
 class GPT2(nn.Module):
-    """Returns logits [batch, len, vocab]."""
+    """Returns logits [batch, len, vocab]; with ``cache`` returns
+    (logits, new_cache) — same decode contract as models/llama.py."""
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True):
+    def __call__(self, input_ids, deterministic=True, positions=None,
+                 cache=None):
         cfg = self.cfg
+        b, l = input_ids.shape
+        if positions is None:
+            start = cache["layers"][0]["index"] if cache is not None else 0
+            positions = jnp.broadcast_to(start + jnp.arange(l)[None], (b, l))
+
         wte_v, wpe_v = _make_embed_tables(self, cfg)
-        x = _embed_tokens(wte_v, wpe_v, input_ids, cfg)
+        x = _embed_tokens(wte_v, wpe_v, input_ids, cfg, positions)
+        if cfg.embed_layernorm:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="ln_embed")(x)
 
         block = Block
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(Block, prevent_cse=False)
+        new_layer_caches = []
         for i in range(cfg.num_layers):
             use_moe = (cfg.moe_num_experts > 1 and
                        i % cfg.moe_every == cfg.moe_every - 1)
-            x = block(cfg, use_moe, name=f"h_{i}")(x, deterministic)
+            layer_cache = cache["layers"][i] if cache is not None else None
+            x, new_c = block(cfg, use_moe, name=f"h_{i}")(
+                x, deterministic, layer_cache, positions)
+            new_layer_caches.append(new_c)
 
-        return _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
+        logits = _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
+        if cache is not None:
+            return logits, {"layers": new_layer_caches}
+        return logits
 
 
 def gpt2_loss_fn(logits, batch):
@@ -254,6 +336,21 @@ def gpt2_pipeline(cfg, num_stages, num_microbatches=None):
                           embed=GPT2Embed(cfg), head=GPT2Head(cfg),
                           num_microbatches=num_microbatches,
                           tied_head=cfg.tie_embeddings)
+
+
+def init_kv_cache(cfg: GPTConfig, batch_size, max_len=None,
+                  dtype=jnp.bfloat16):
+    """Empty KV cache pytree (reference inference_context.h workspace);
+    same contract as models/llama.py init_kv_cache."""
+    max_len = max_len or cfg.max_seq_len
+    layer = lambda: {
+        "k": jnp.zeros((batch_size, max_len, cfg.num_heads, cfg.head_dim),
+                       dtype),
+        "v": jnp.zeros((batch_size, max_len, cfg.num_heads, cfg.head_dim),
+                       dtype),
+        "index": jnp.int32(0),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}
 
 
 # canonical "HF GPT-2 small" hyperparameters

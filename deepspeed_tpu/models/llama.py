@@ -71,13 +71,7 @@ def _proj(cfg, features, axes, name):
                     name=name)
 
 
-def _repeat_kv(x, n_rep):
-    """[b, l, kv_heads, d] -> [b, l, kv_heads*n_rep, d] (GQA expansion)."""
-    if n_rep == 1:
-        return x
-    b, l, h, d = x.shape
-    return jnp.broadcast_to(x[:, :, :, None], (b, l, h, n_rep, d)) \
-        .reshape(b, l, h * n_rep, d)
+from deepspeed_tpu.ops.attention.decode import _repeat_kv  # GQA expansion
 
 
 class LlamaAttention(nn.Module):
@@ -106,17 +100,17 @@ class LlamaAttention(nn.Module):
                 cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0))
             new_cache = {"k": k_cache, "v": v_cache,
                          "index": cache["index"] + l}
-            k_full = _repeat_kv(k_cache, h // kv_h)
-            v_full = _repeat_kv(v_cache, h // kv_h)
             # attend over the whole cache buffer with a positional mask:
             # slot j is visible to query at absolute position p iff j <= p
-            # (cache["index"] is traced, so no dynamic slicing)
+            # (cache["index"] is traced, so no dynamic slicing). Single-token
+            # steps hit the Pallas softmax_context kernel; GQA caches are
+            # consumed grouped, never expanded.
             max_len = k_cache.shape[1]
             k_pos = jnp.arange(max_len)
             mask = k_pos[None, None, :] <= positions[:, :, None]  # [b,l,max]
             bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)
-            out = mha_reference(q, k_full, v_full, causal=False,
-                                bias=bias[:, None])
+            from deepspeed_tpu.ops.attention import decode_attention
+            out = decode_attention(q, k_cache, v_cache, bias=bias[:, None])
 
         else:
             k_full = _repeat_kv(k, h // kv_h)

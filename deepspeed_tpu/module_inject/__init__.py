@@ -1,0 +1,20 @@
+"""HF-model ingestion: policy system + checkpoint conversion.
+
+Reference: ``deepspeed/module_inject/`` — ``TransformerPolicy``
+(policy.py:42), the ``replace_policy`` registry, per-architecture weight
+containers (containers/*.py), and ``replace_transformer_layer``
+(replace_module.py:274) which swaps HF modules for kernel-injected ones
+with TP-sliced weights.
+
+TPU redesign: instead of swapping submodules inside a live torch model,
+the policy maps a whole HF architecture (config + state dict) onto the
+equivalent *native* flax module and converts the weights once. TP slicing
+disappears — converted params carry logical-axis metadata, so `pjit`
+shards them over the `model` mesh axis at `set_params`
+(the `ReplaceWithTensorSlicing`/`AutoTP` capability as sharding specs).
+"""
+
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
+    from_hf, load_hf_state_dict, replace_transformer_layer)
+from deepspeed_tpu.module_inject.replace_policy import (  # noqa: F401
+    POLICIES, policy_for)

@@ -1,0 +1,335 @@
+"""Per-architecture ingestion policies.
+
+Reference: ``deepspeed/module_inject/policy.py:42`` (``TransformerPolicy``
+— knows where an architecture keeps qkv/o/mlp weights) and the container
+classes under ``module_inject/containers/`` (one per HF family). Here a
+policy is: HF config -> native config + flax module, and HF state dict ->
+native param tree. All arrays are numpy; transposes happen here so the
+native modules stay layout-clean ([in, out] kernels everywhere).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _t(w):
+    """HF nn.Linear stores [out, in]; flax Dense kernels are [in, out]."""
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _np(w):
+    return np.asarray(w)
+
+
+class InjectionPolicy:
+    """Base policy: subclass per HF model_type."""
+
+    model_type = None          # HF config.model_type this policy matches
+
+    @classmethod
+    def matches(cls, hf_config):
+        return getattr(hf_config, "model_type", None) == cls.model_type
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        """Native flax module equivalent to the HF architecture."""
+        raise NotImplementedError
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        """HF state dict (name -> numpy) -> native param tree (nested
+        dicts of numpy arrays matching build_module's param structure)."""
+        raise NotImplementedError
+
+
+class GPT2Policy(InjectionPolicy):
+    """HF GPT2LMHeadModel (reference containers/gpt2.py: HFGPT2LayerPolicy).
+    GPT-2's Conv1D already stores [in, out]; no transposes needed."""
+
+    model_type = "gpt2"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.n_embd,
+            num_layers=c.n_layer, num_heads=c.n_head,
+            max_seq_len=c.n_positions,
+            layer_norm_eps=c.layer_norm_epsilon,
+            activation="gelu",            # HF gelu_new == tanh approximation
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        p = {"wte": _np(sd["transformer.wte.weight"]),
+             "wpe": _np(sd["transformer.wpe.weight"]),
+             "ln_f": {"scale": _np(sd["transformer.ln_f.weight"]),
+                      "bias": _np(sd["transformer.ln_f.bias"])}}
+        for i in range(hf_config.n_layer):
+            h = f"transformer.h.{i}."
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "ln_1.weight"]),
+                         "bias": _np(sd[h + "ln_1.bias"])},
+                "ln_2": {"scale": _np(sd[h + "ln_2.weight"]),
+                         "bias": _np(sd[h + "ln_2.bias"])},
+                "attn": {
+                    "qkv": {"kernel": _np(sd[h + "attn.c_attn.weight"]),
+                            "bias": _np(sd[h + "attn.c_attn.bias"])},
+                    "proj": {"kernel": _np(sd[h + "attn.c_proj.weight"]),
+                             "bias": _np(sd[h + "attn.c_proj.bias"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _np(sd[h + "mlp.c_fc.weight"]),
+                              "bias": _np(sd[h + "mlp.c_fc.bias"])},
+                    "fc_out": {"kernel": _np(sd[h + "mlp.c_proj.weight"]),
+                               "bias": _np(sd[h + "mlp.c_proj.bias"])}},
+            }
+        return p
+
+
+class OPTPolicy(InjectionPolicy):
+    """HF OPTForCausalLM (reference containers/opt.py: HFOPTLayerPolicy).
+    Separate q/k/v Linears fuse into the native qkv kernel; learned
+    positions keep OPT's +2 storage offset."""
+
+    model_type = "opt"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        if getattr(c, "word_embed_proj_dim", c.hidden_size) != c.hidden_size:
+            raise ValueError("OPT variants with word_embed_proj_dim != "
+                             "hidden_size (350m) are not supported")
+        if not getattr(c, "do_layer_norm_before", True):
+            raise ValueError("post-layernorm OPT variants (350m) are not "
+                             "supported")
+        assert c.ffn_dim % c.hidden_size == 0
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_hidden_layers, num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            mlp_ratio=c.ffn_dim // c.hidden_size,
+            layer_norm_eps=1e-5, activation="relu", pos_offset=2,
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        d = "model.decoder."
+        if d + "final_layer_norm.weight" not in sd:
+            d2 = "decoder." if "decoder.embed_tokens.weight" in sd else d
+            d = d2
+        p = {"wte": _np(sd[d + "embed_tokens.weight"]),
+             "wpe": _np(sd[d + "embed_positions.weight"]),
+             "ln_f": {"scale": _np(sd[d + "final_layer_norm.weight"]),
+                      "bias": _np(sd[d + "final_layer_norm.bias"])}}
+        for i in range(hf_config.num_hidden_layers):
+            h = f"{d}layers.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[h + f"self_attn.{n}_proj.weight"])
+                 for n in ("q", "k", "v")], axis=1)
+            qkv_b = np.concatenate(
+                [_np(sd[h + f"self_attn.{n}_proj.bias"])
+                 for n in ("q", "k", "v")])
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "self_attn_layer_norm.weight"]),
+                         "bias": _np(sd[h + "self_attn_layer_norm.bias"])},
+                "ln_2": {"scale": _np(sd[h + "final_layer_norm.weight"]),
+                         "bias": _np(sd[h + "final_layer_norm.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {"kernel": _t(sd[h + "self_attn.out_proj.weight"]),
+                             "bias": _np(sd[h + "self_attn.out_proj.bias"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _t(sd[h + "fc1.weight"]),
+                              "bias": _np(sd[h + "fc1.bias"])},
+                    "fc_out": {"kernel": _t(sd[h + "fc2.weight"]),
+                               "bias": _np(sd[h + "fc2.bias"])}},
+            }
+        return p
+
+
+class BloomPolicy(InjectionPolicy):
+    """HF BloomForCausalLM (reference containers/bloom.py: BLOOMLayerPolicy).
+    ALiBi attention, no positional table, embedding layernorm; the fused
+    query_key_value weight is stored head-interleaved [(h, 3, d), in] and is
+    reordered to the native contiguous-q|k|v layout."""
+
+    model_type = "bloom"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.n_layer, num_heads=c.n_head,
+            max_seq_len=getattr(c, "seq_length", 2048),
+            layer_norm_eps=c.layer_norm_epsilon,
+            activation="gelu",            # BloomGelu is the tanh approximation
+            pos_embed="none", use_alibi=True, embed_layernorm=True,
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def _split_qkv(cls, w, b, n_head):
+        """[3h, in] head-interleaved -> [in, 3h] contiguous q|k|v."""
+        three_h, h_in = w.shape
+        d = three_h // (3 * n_head)
+        w = w.reshape(n_head, 3, d, h_in).transpose(1, 0, 2, 3) \
+             .reshape(3 * n_head * d, h_in)
+        b = b.reshape(n_head, 3, d).transpose(1, 0, 2).reshape(-1)
+        return _t(w), np.ascontiguousarray(b)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        t = "transformer." if "transformer.word_embeddings.weight" in sd \
+            else ""
+        p = {"wte": _np(sd[t + "word_embeddings.weight"]),
+             "ln_embed": {
+                 "scale": _np(sd[t + "word_embeddings_layernorm.weight"]),
+                 "bias": _np(sd[t + "word_embeddings_layernorm.bias"])},
+             "ln_f": {"scale": _np(sd[t + "ln_f.weight"]),
+                      "bias": _np(sd[t + "ln_f.bias"])}}
+        for i in range(hf_config.n_layer):
+            h = f"{t}h.{i}."
+            qkv_w, qkv_b = cls._split_qkv(
+                _np(sd[h + "self_attention.query_key_value.weight"]),
+                _np(sd[h + "self_attention.query_key_value.bias"]),
+                hf_config.n_head)
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "input_layernorm.weight"]),
+                         "bias": _np(sd[h + "input_layernorm.bias"])},
+                "ln_2": {
+                    "scale": _np(sd[h + "post_attention_layernorm.weight"]),
+                    "bias": _np(sd[h + "post_attention_layernorm.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {"kernel": _t(sd[h + "self_attention.dense.weight"]),
+                             "bias": _np(sd[h + "self_attention.dense.bias"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _t(sd[h + "mlp.dense_h_to_4h.weight"]),
+                              "bias": _np(sd[h + "mlp.dense_h_to_4h.bias"])},
+                    "fc_out": {"kernel": _t(sd[h + "mlp.dense_4h_to_h.weight"]),
+                               "bias": _np(sd[h + "mlp.dense_4h_to_h.bias"])}},
+            }
+        return p
+
+
+class LlamaPolicy(InjectionPolicy):
+    """HF LlamaForCausalLM (the reference gained containers/llama.py in
+    later snapshots; built natively here). Rotary convention (rotate-half,
+    theta = base^(-i/half)) matches models/llama.py exactly, so q/k copy
+    straight through."""
+
+    model_type = "llama"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        c = hf_config
+        cfg = LlamaConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_hidden_layers, num_heads=c.num_attention_heads,
+            num_kv_heads=getattr(c, "num_key_value_heads",
+                                 c.num_attention_heads),
+            intermediate_size=c.intermediate_size,
+            max_seq_len=c.max_position_embeddings,
+            rope_base=getattr(c, "rope_theta", 10000.0),
+            rms_eps=c.rms_norm_eps,
+            tie_embeddings=getattr(c, "tie_word_embeddings", False),
+            dtype=dtype, param_dtype=dtype)
+        return Llama(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        p = {"embed_tokens": _np(sd["model.embed_tokens.weight"]),
+             "norm": {"scale": _np(sd["model.norm.weight"])}}
+        if not getattr(hf_config, "tie_word_embeddings", False):
+            p["lm_head"] = {"kernel": _t(sd["lm_head.weight"])}
+        for i in range(hf_config.num_hidden_layers):
+            h = f"model.layers.{i}."
+            p[f"layers_{i}"] = {
+                "input_norm": {"scale": _np(sd[h + "input_layernorm.weight"])},
+                "post_attn_norm": {
+                    "scale": _np(sd[h + "post_attention_layernorm.weight"])},
+                "attn": {
+                    "wq": {"kernel": _t(sd[h + "self_attn.q_proj.weight"])},
+                    "wk": {"kernel": _t(sd[h + "self_attn.k_proj.weight"])},
+                    "wv": {"kernel": _t(sd[h + "self_attn.v_proj.weight"])},
+                    "wo": {"kernel": _t(sd[h + "self_attn.o_proj.weight"])}},
+                "mlp": {
+                    "w_gate": {"kernel": _t(sd[h + "mlp.gate_proj.weight"])},
+                    "w_up": {"kernel": _t(sd[h + "mlp.up_proj.weight"])},
+                    "w_down": {"kernel": _t(sd[h + "mlp.down_proj.weight"])}},
+            }
+        return p
+
+
+class BertPolicy(InjectionPolicy):
+    """HF BertForMaskedLM (reference containers/bert.py: HFBertLayerPolicy).
+    Post-layernorm encoder; separate q/k/v fuse into the native qkv."""
+
+    model_type = "bert"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.bert import Bert, BertConfig
+        c = hf_config
+        cfg = BertConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_hidden_layers, num_heads=c.num_attention_heads,
+            intermediate_size=c.intermediate_size,
+            max_seq_len=c.max_position_embeddings,
+            type_vocab_size=c.type_vocab_size,
+            layer_norm_eps=c.layer_norm_eps,
+            pre_layer_norm=False,
+            activation="gelu_exact" if c.hidden_act == "gelu" else "gelu",
+            mlm_bias=True, dtype=dtype, param_dtype=dtype)
+        return Bert(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        e = "bert.embeddings."
+        p = {"word_embeddings": _np(sd[e + "word_embeddings.weight"]),
+             "position_embeddings": _np(sd[e + "position_embeddings.weight"]),
+             "token_type_embeddings":
+                 _np(sd[e + "token_type_embeddings.weight"]),
+             "ln_embed": {"scale": _np(sd[e + "LayerNorm.weight"]),
+                          "bias": _np(sd[e + "LayerNorm.bias"])},
+             "mlm_transform": {
+                 "kernel": _t(sd["cls.predictions.transform.dense.weight"]),
+                 "bias": _np(sd["cls.predictions.transform.dense.bias"])},
+             "mlm_ln": {
+                 "scale":
+                     _np(sd["cls.predictions.transform.LayerNorm.weight"]),
+                 "bias": _np(sd["cls.predictions.transform.LayerNorm.bias"])},
+             "mlm_decoder_bias": _np(sd["cls.predictions.bias"])}
+        for i in range(hf_config.num_hidden_layers):
+            h = f"bert.encoder.layer.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[h + f"attention.self.{n}.weight"])
+                 for n in ("query", "key", "value")], axis=1)
+            qkv_b = np.concatenate(
+                [_np(sd[h + f"attention.self.{n}.bias"])
+                 for n in ("query", "key", "value")])
+            p[f"layer_{i}"] = {
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {
+                        "kernel": _t(sd[h + "attention.output.dense.weight"]),
+                        "bias": _np(sd[h + "attention.output.dense.bias"])}},
+                "ln_attn": {
+                    "scale": _np(sd[h + "attention.output.LayerNorm.weight"]),
+                    "bias": _np(sd[h + "attention.output.LayerNorm.bias"])},
+                "ln_mlp": {"scale": _np(sd[h + "output.LayerNorm.weight"]),
+                           "bias": _np(sd[h + "output.LayerNorm.bias"])},
+                "fc_in": {"kernel": _t(sd[h + "intermediate.dense.weight"]),
+                          "bias": _np(sd[h + "intermediate.dense.bias"])},
+                "fc_out": {"kernel": _t(sd[h + "output.dense.weight"]),
+                           "bias": _np(sd[h + "output.dense.bias"])},
+            }
+        return p

@@ -1,0 +1,18 @@
+"""Policy registry (reference ``module_inject/replace_policy.py`` —
+``replace_policies``/``generic_policies`` lists)."""
+
+from deepspeed_tpu.module_inject.policy import (BertPolicy, BloomPolicy,
+                                                GPT2Policy, LlamaPolicy,
+                                                OPTPolicy)
+
+POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, LlamaPolicy, BertPolicy]
+
+
+def policy_for(hf_config):
+    for pol in POLICIES:
+        if pol.matches(hf_config):
+            return pol
+    raise ValueError(
+        f"no ingestion policy for model_type="
+        f"{getattr(hf_config, 'model_type', None)!r}; supported: "
+        f"{[p.model_type for p in POLICIES]}")

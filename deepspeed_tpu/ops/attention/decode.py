@@ -1,0 +1,144 @@
+"""Pallas TPU KV-cache decode attention (the `softmax_context` kernel).
+
+TPU-native replacement for the reference's inference attention kernel
+(csrc/transformer/inference/csrc/pt_binding.cpp `softmax_context`,
+`inference_context.h` KV workspace): single-token queries attend over a
+device-resident cache buffer without materializing [heads, max_len]
+score tensors in HBM, with additive bias (position mask, ALiBi).
+
+Design:
+  * caches stay in their storage layout [batch, max_len, kv_heads, dim] —
+    BlockSpecs index directly into it, no transpose copies per token.
+  * grid = (batch, kv_heads, k_blocks); the k axis is innermost so the
+    online-softmax state lives in VMEM scratch across grid steps
+    (same scheme as ops/attention/flash.py).
+  * GQA is native: each kv head's grid step loads its whole group of
+    query heads ([group, dim] block), so grouped caches are never
+    expanded to num_heads (the `_repeat_kv` copy disappears).
+  * bias [batch, heads, 1, max_len] carries the validity mask (slots past
+    the write index) and any ALiBi term; fp32 statistics throughout.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from deepspeed_tpu.ops.attention.flash import NEG_INF, _pick_block
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, nk):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    grp = q_ref.shape[2]
+    q = q_ref[0, 0, :, :]                      # [grp, d]
+    k = k_ref[0, :, 0, :]                      # [bk, d]
+    v = v_ref[0, :, 0, :]                      # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [grp, bk]
+    s = s + bias_ref[0, :, 0, :]
+    s = jnp.maximum(s, NEG_INF)  # keep masked slots finite (see flash.py)
+
+    m_prev = m_scr[:grp, :1]
+    l_prev = l_scr[:grp, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    row_live = m_new > NEG_INF / 2
+    alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
+    p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [grp, d]
+    acc_scr[:grp] = acc_scr[:grp] * alpha + pv
+    m_scr[:grp] = jnp.broadcast_to(m_new, (grp, m_scr.shape[1]))
+    l_scr[:grp] = jnp.broadcast_to(l_new, (grp, l_scr.shape[1]))
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:grp, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:grp] / l).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k_cache, v_cache, bias, *, scale, block_k, interpret):
+    b, one, h, d = q.shape
+    max_len, kv_h = k_cache.shape[1], k_cache.shape[2]
+    grp = h // kv_h
+    nk = max_len // block_k
+    scr_rows = max(grp, 8)   # TPU sublane tile
+
+    kernel = functools.partial(_decode_kernel, scale=scale, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, kv_h, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, grp, d), lambda ib, ih, j: (ib, 0, ih, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, j: (ib, j, ih, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, j: (ib, j, ih, 0)),
+            pl.BlockSpec((1, grp, 1, block_k), lambda ib, ih, j: (ib, ih, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, grp, d), lambda ib, ih, j: (ib, 0, ih, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        scratch_shapes=[
+            pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, 128), jnp.float32),
+            pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, 128), jnp.float32),
+            pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k_cache, v_cache, bias)
+    return out
+
+
+def _repeat_kv(x, n_rep):
+    if n_rep == 1:
+        return x
+    b, l, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None], (b, l, h, n_rep, d)) \
+        .reshape(b, l, h * n_rep, d)
+
+
+def decode_attention(q, k_cache, v_cache, *, bias, scale=None,
+                     interpret=None, block_k=None):
+    """Attention of `q` [b, l, heads, d] over a cache buffer
+    [b, max_len, kv_heads, d] with additive `bias` (broadcastable to
+    [b, heads, l, max_len]) carrying the validity mask.
+
+    Single-token decode (l == 1) runs the Pallas kernel; multi-token
+    (prefill into a cache) falls back to the jnp oracle. GQA caches
+    (kv_heads < heads) are consumed directly by the kernel.
+    """
+    from deepspeed_tpu.ops.attention.reference import mha_reference
+
+    b, l, h, d = q.shape
+    kv_h = k_cache.shape[2]
+    max_len = k_cache.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if l == 1 and h % kv_h == 0 and max_len % (block_k or 128) == 0:
+        block_k = block_k or _pick_block(max_len)
+        bias_full = jnp.broadcast_to(
+            bias.astype(jnp.float32), (b, h, 1, max_len))
+        return _decode_pallas(q, k_cache, v_cache, bias_full, scale=scale,
+                              block_k=block_k, interpret=interpret)
+
+    k_full = _repeat_kv(k_cache, h // kv_h)
+    v_full = _repeat_kv(v_cache, h // kv_h)
+    return mha_reference(q, k_full, v_full, causal=False, bias=bias,
+                         scale=scale)

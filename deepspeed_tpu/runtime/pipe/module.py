@@ -285,6 +285,8 @@ class PipelineModule:
                     kw["rngs"] = {"dropout": jax.random.fold_in(step_rng, i)}
                 y = block.apply({"params": layer_params}, h,
                                 deterministic, **kw)
+                if isinstance(y, tuple):  # blocks with a (x, cache) contract
+                    y = y[0]
                 return (y, i + 1), None
             (h, _), _ = lax.scan(one, (h, jnp.int32(0)), kparams)
             return h

@@ -49,7 +49,7 @@ def test_pipeline_forward_matches_sequential():
     for s in range(4):
         for k in range(1):
             layer_p = jax.tree.map(lambda a: a[s, k], p["stages"])
-            x = block.apply({"params": layer_p}, x)
+            x, _ = block.apply({"params": layer_p}, x)
     ref = GPT2Head(cfg).apply({"params": p["head"]}, x,
                               embed_params=p["embed"])
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),

@@ -1,0 +1,140 @@
+"""HF-model ingestion oracle tests.
+
+Reference analogue: tests/unit/inference/test_inference.py — DS output
+compared against the vanilla HF pipeline per architecture. Models are
+built from config (no hub downloads) with random weights; the oracle is
+the torch forward on the same weights.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.module_inject import from_hf
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOL = dict(rtol=2e-4, atol=2e-4)
+
+
+def hf_logits(model, ids, **kw):
+    model.eval()
+    with torch.no_grad():
+        return model(torch.tensor(ids), **kw).logits.float().numpy()
+
+
+def our_logits(model_hf, ids, **kw):
+    engine = deepspeed_tpu.init_inference(model_hf, dtype="float32")
+    return np.asarray(jax.device_get(engine.forward(ids, **kw)))
+
+
+@pytest.fixture(scope="module")
+def ids():
+    return np.random.default_rng(0).integers(3, 120, (2, 12)).astype("i4")
+
+
+def test_gpt2_ingestion(ids):
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        activation_function="gelu_new", attn_pdrop=0.0, embd_pdrop=0.0,
+        resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_opt_ingestion(ids):
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, ffn_dim=192, max_position_embeddings=64,
+        dropout=0.0, word_embed_proj_dim=48, do_layer_norm_before=True)
+    hf = transformers.OPTForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_bloom_ingestion(ids):
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=48, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    hf = transformers.BloomForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_llama_ingestion(ids):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=64, attention_dropout=0.0)
+    hf = transformers.LlamaForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_bert_ingestion(ids):
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, hidden_act="gelu")
+    hf = transformers.BertForMaskedLM(cfg)
+    mask = np.ones_like(ids)
+    ours = our_logits(hf, ids, attention_mask=mask)
+    theirs = hf_logits(hf, ids, attention_mask=torch.tensor(mask))
+    np.testing.assert_allclose(ours, theirs, **TOL)
+
+
+def test_from_checkpoint_dir(tmp_path, ids):
+    """save_pretrained layout round trip (safetensors on disk)."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    hf.save_pretrained(str(tmp_path))
+    module, params = from_hf(str(tmp_path))
+    engine = deepspeed_tpu.init_inference(module, params=params,
+                                          dtype="float32")
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(engine.forward(ids))),
+        hf_logits(hf, ids), **TOL)
+
+
+def test_ingested_generation_with_cache(ids):
+    """Generation through the ingested module's KV cache matches the
+    no-cache greedy path."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    engine = deepspeed_tpu.init_inference(hf, dtype="float32")
+    out = engine.generate(ids[:, :6], max_new_tokens=6)
+    assert out.shape == (2, 12)
+    # oracle: HF greedy generation on the same weights
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids[:, :6]), max_new_tokens=6,
+                          do_sample=False,
+                          pad_token_id=0).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_unknown_architecture_raises():
+    class FakeCfg:
+        model_type = "mamba"
+    from deepspeed_tpu.module_inject import policy_for
+    with pytest.raises(ValueError, match="no ingestion policy"):
+        policy_for(FakeCfg())
+
+
+def test_tp_sharded_ingestion_matches_tp1(ids):
+    """Auto-TP: the same ingested model under a model-axis mesh produces
+    identical logits (reference AutoTP capability as sharding)."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    ref = our_logits(hf, ids)
+    engine = deepspeed_tpu.init_inference(
+        hf, dtype="float32", tensor_parallel={"tp_size": 4})
+    tp = np.asarray(jax.device_get(engine.forward(ids)))
+    np.testing.assert_allclose(tp, ref, **TOL)

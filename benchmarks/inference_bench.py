@@ -1,0 +1,78 @@
+"""Inference latency benchmark (reference benchmarks/inference/gpt-bench.py).
+
+Measures prefill latency and per-token decode latency (p50/p90) through
+the KV-cache generation path, optionally with int8 weight quantization.
+Prints one bench.py-style JSON line per configuration.
+
+Usage: python benchmarks/inference_bench.py [--model gpt2-small]
+       [--batch 1] [--prompt 128] [--tokens 64] [--dtypes bfloat16,int8]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(model_name, batch, prompt_len, new_tokens, dtype):
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_small
+    from deepspeed_tpu.models.llama import Llama, llama_tiny
+
+    if model_name == "gpt2-small":
+        import jax.numpy as jnp
+        module = GPT2(gpt2_small(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16))
+        vocab = module.cfg.vocab_size
+    else:
+        raise ValueError(model_name)
+
+    engine = deepspeed_tpu.init_inference(
+        module, dtype=dtype, max_out_tokens=prompt_len + new_tokens + 8)
+    engine.init_params()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, prompt_len)).astype("i4")
+
+    # warmup (compile prefill + fused decode loop at the measured shape)
+    engine.generate(ids, max_new_tokens=new_tokens)
+    engine.model_times()
+
+    out = engine.generate(ids, max_new_tokens=new_tokens)
+    times = engine.model_times()
+    assert out.shape[1] == prompt_len + new_tokens
+    prefill_ms = times[0] * 1e3
+    decode_ms = np.asarray(times[1:]) * 1e3
+    return {
+        "prefill_ms": round(float(prefill_ms), 3),
+        "token_p50_ms": round(float(np.percentile(decode_ms, 50)), 3),
+        "token_p90_ms": round(float(np.percentile(decode_ms, 90)), 3),
+        "decode_tokens_per_sec":
+            round(batch * len(decode_ms) / (decode_ms.sum() / 1e3), 1),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2-small")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--tokens", type=int, default=64)
+    p.add_argument("--dtypes", default="bfloat16,int8")
+    args = p.parse_args()
+
+    for dtype in args.dtypes.split(","):
+        r = run(args.model, args.batch, args.prompt, args.tokens, dtype)
+        print(json.dumps({
+            "metric": f"{args.model}_{dtype}_decode_p50_latency",
+            "value": r["token_p50_ms"], "unit": "ms",
+            "extra": {**r, "batch": args.batch, "prompt": args.prompt,
+                      "new_tokens": args.tokens, "dtype": dtype},
+        }))
+
+
+if __name__ == "__main__":
+    main()

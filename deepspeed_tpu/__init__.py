@@ -81,8 +81,7 @@ def init_inference(model=None, config=None, **kwargs):
         if cfg.dtype not in DTYPES:
             raise ValueError(
                 f"unsupported inference dtype {cfg.dtype!r}; pick one of "
-                f"{sorted(DTYPES)} (int8 weight quantization is configured "
-                "via the quant section, not dtype)")
+                f"{sorted(DTYPES)} or 'int8' (weight-only quantization)")
         from deepspeed_tpu.module_inject import from_hf
         model, params = from_hf(model, dtype=DTYPES[cfg.dtype])
     return InferenceEngine(model, cfg, params=params, mesh=mesh)

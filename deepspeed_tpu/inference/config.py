@@ -72,4 +72,10 @@ class DeepSpeedInferenceConfig(BaseModel):
         name = str(self.dtype).lower().replace("torch.", "")
         aliases = {"half": "float16", "fp16": "float16", "bf16": "bfloat16",
                    "float": "float32", "fp32": "float32", "int8": "int8"}
-        object.__setattr__(self, "dtype", aliases.get(name, name))
+        name = aliases.get(name, name)
+        if name == "int8":
+            # reference semantics (inference/config.py): dtype=torch.int8
+            # means int8 weight quantization with half-precision compute
+            self.quant.enabled = True
+            name = "bfloat16"
+        object.__setattr__(self, "dtype", name)

@@ -78,10 +78,12 @@ class InferenceEngine:
         for field, val in (("dtype", config.dtype),
                            ("kv_cache_dtype", config.kv_cache_dtype)):
             if val not in DTYPES:
+                hint = "; dtype='int8' (weight-only quantization) is " \
+                    "accepted via init_inference/DeepSpeedInferenceConfig" \
+                    if field == "dtype" else ""
                 raise ValueError(
                     f"unsupported inference {field} {val!r}; pick one of "
-                    f"{sorted(DTYPES)} (int8 weight quantization is "
-                    "configured via the quant section, not dtype)")
+                    f"{sorted(DTYPES)}{hint}")
         self.dtype = DTYPES[config.dtype]
         self.kv_dtype = DTYPES[config.kv_cache_dtype]
         self._rng = jax.random.PRNGKey(seed)
@@ -126,9 +128,12 @@ class InferenceEngine:
                                  kind="param")
         return shd.tree_shardings(self.mesh, pspecs)
 
-    def set_params(self, params):
+    def set_params(self, params, quantize=None):
         """Cast to inference dtype and shard over the mesh (the reference's
-        _convert_to_dtype + ReplaceWithTensorSlicing combined)."""
+        _convert_to_dtype + ReplaceWithTensorSlicing combined); with
+        quant.enabled, Dense kernels then quantize to int8 groups
+        (reference GroupQuantizer sweep, replace_module.py:138).
+        `quantize=False` keeps floats (checkpoint-restore target trees)."""
         sh = self._param_shardings(params)     # needs Partitioned metadata
         params = shd.unbox(params)
         cast = jax.jit(
@@ -138,18 +143,38 @@ class InferenceEngine:
                 p),
             out_shardings=sh)
         self.params = cast(params)
+        quantize = self._config.quant.enabled if quantize is None else quantize
+        if quantize:
+            self.params = self._quantize(self.params)
         n = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(self.params))
-        log_dist(f"inference params ready: {n/1e6:.1f}M, dtype={self._config.dtype}, "
+        log_dist(f"inference params ready: {n/1e6:.1f}M, dtype={self._config.dtype}"
+                 f"{' +int8' if quantize else ''}, "
                  f"tp={self.mp_world_size}", ranks=[0])
         return self
 
-    def init_params(self, example_ids=None, seed=0):
+    def _quantize(self, params):
+        """The one place the quant leaf predicate/parameters live."""
+        from deepspeed_tpu.ops.quant import quantize_tree
+        qcfg = self._config.quant
+        return quantize_tree(params, bits=qcfg.num_bits,
+                             group_size=qcfg.group_size,
+                             predicate=lambda path, leaf: "kernel" in path)
+
+    def _materialize(self, params):
+        """Dequantize QTensor leaves inside a jitted computation."""
+        if not self._config.quant.enabled:
+            return params
+        from deepspeed_tpu.ops.quant import dequantize_tree
+        return dequantize_tree(params)
+
+    def init_params(self, example_ids=None, seed=0, quantize=None):
         """Random init (benchmarks / smoke tests)."""
         ids = example_ids if example_ids is not None \
             else jnp.zeros((1, 8), jnp.int32)
         variables = self.module.init(jax.random.PRNGKey(seed),
                                      jnp.asarray(ids))
-        return self.set_params(variables.get("params", variables))
+        return self.set_params(variables.get("params", variables),
+                               quantize=quantize)
 
     def load_checkpoint(self, path, tag=None):
         """Load params saved by the training engine's save_checkpoint."""
@@ -161,10 +186,14 @@ class InferenceEngine:
                 with open(latest) as f:
                     tag = f.read().strip()
         full = os.path.join(path, tag) if tag else path
-        if self.params is None:
-            self.init_params()
+        quant = self._config.quant.enabled
+        if self.params is None or quant:
+            # restore needs a float target tree (shapes + shardings);
+            # quantization re-applies after the load
+            self.init_params(quantize=False)
         # restore only the params subtree of the saved TrainState
-        self.params = load_subtree(full, self.params, prefix=".params")
+        params = load_subtree(full, self.params, prefix=".params")
+        self.params = self._quantize(params) if quant else params
         log_dist(f"inference checkpoint loaded from {full}", ranks=[0])
         return self
 
@@ -183,9 +212,11 @@ class InferenceEngine:
             self._fwd_cache = {}
         if key not in self._fwd_cache:
             module = self.module
+            materialize = self._materialize
 
             def fwd(params, ids, **kw):
-                return module.apply({"params": params}, ids, **static, **kw)
+                return module.apply({"params": materialize(params)}, ids,
+                                    **static, **kw)
 
             self._fwd_cache[key] = jax.jit(fwd)
         t0 = time.time()
@@ -217,34 +248,65 @@ class InferenceEngine:
 
     def _build_gen_fns(self):
         module = self.module
+        materialize = self._materialize
 
         def prefill(params, ids, cache):
-            logits, cache = module.apply({"params": params}, ids, cache=cache)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         ids, cache=cache)
             return logits[:, -1], cache
 
         def decode(params, tok, cache, rng, do_sample, temperature, top_k,
                    top_p):
-            logits, cache = module.apply({"params": params}, tok[:, None],
-                                         cache=cache)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         tok[:, None], cache=cache)
             nxt = _sample_tokens(logits[:, 0], rng, do_sample, temperature,
                                  top_k, top_p)
             return nxt, cache
+
+        def decode_loop(params, tok, cache, finished, rng, n_steps,
+                        do_sample, temperature, top_k, top_p, eos, fill):
+            """The whole decode loop as ONE dispatch (lax.scan over steps).
+            The per-token Python loop pays a host round-trip per token —
+            ruinous over the TPU relay; this is the CUDA-graph-replay
+            equivalent of the reference (inference/engine.py:437-456),
+            expressed as a traced loop."""
+            def body(carry, i):
+                tok, cache, finished = carry
+                logits, cache = module.apply(
+                    {"params": materialize(params)}, tok[:, None],
+                    cache=cache)
+                nxt = _sample_tokens(logits[:, 0], jax.random.fold_in(rng, i),
+                                     do_sample, temperature, top_k, top_p)
+                if eos is not None:
+                    nxt = jnp.where(finished, fill, nxt.astype(jnp.int32))
+                    finished = finished | (nxt == eos)
+                return (nxt.astype(tok.dtype), cache, finished), nxt
+            (tok, cache, finished), toks = jax.lax.scan(
+                body, (tok, cache, finished), jnp.arange(n_steps))
+            return toks.T, cache, finished  # [b, n_steps]
 
         self._prefill_fn = jax.jit(prefill, donate_argnums=(2,))
         # sampling params static: new compile per (do_sample, temp, k, p) combo
         self._decode_fn = jax.jit(decode, donate_argnums=(2,),
                                   static_argnums=(4, 5, 6, 7))
+        self._decode_loop_fn = jax.jit(decode_loop, donate_argnums=(2,),
+                                       static_argnums=(5, 6, 7, 8, 9, 10, 11))
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 max_length=None, **kwargs):
-        """Autoregressive generation with device-resident KV cache."""
+                 max_length=None, stream=False, **kwargs):
+        """Autoregressive generation with device-resident KV cache.
+
+        Default path runs the whole decode loop as a single fused dispatch
+        (lax.scan) — the per-token host round-trip of a Python loop
+        dominates latency on TPU. ``stream=True`` keeps the token-at-a-time
+        loop (early eos exit, per-token latencies in model_times())."""
         assert self.params is not None, "set_params/init_params first"
         if kwargs:
             raise TypeError(
                 f"generate() got unsupported arguments {sorted(kwargs)}; "
                 "supported: max_new_tokens, do_sample, temperature, top_k, "
-                "top_p, eos_token_id, max_length")
+                "top_p, eos_token_id, max_length, stream")
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
@@ -265,7 +327,14 @@ class InferenceEngine:
                                           temperature, top_k, top_p,
                                           eos_token_id)
 
-        cache = self._init_cache(b, max_len)
+        # bucket the cache length so calls with nearby lengths share one
+        # compiled prefill/decode (the reference sizes its workspace to
+        # max_out_tokens once, inference_context.h)
+        bucket = 128
+        cache_len = min(-(-max_len // bucket) * bucket,
+                        self._config.max_out_tokens)
+        cache_len = max(cache_len, max_len)
+        cache = self._init_cache(b, cache_len)
         if self._prefill_fn is None:
             self._build_gen_fns()
 
@@ -275,11 +344,43 @@ class InferenceEngine:
                                              cache)
         self._rng, rng = jax.random.split(self._rng)
         tok = _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p)
-        out = [np.asarray(jax.device_get(tok))]
+        first = np.asarray(jax.device_get(tok))
         self._model_times.append(time.time() - t0)
+        n_rest = max_new_tokens - 1
 
+        if not stream and n_rest > 0:
+            # bucket the step count too: scan a rounded-up length and slice,
+            # so varying max_new_tokens shares one compiled loop (extra
+            # steps only write cache slots past the returned tokens)
+            n_bucket = min(-(-n_rest // 32) * 32, cache_len - prompt_len - 1)
+            n_bucket = max(n_bucket, n_rest)
+            t0 = time.time()
+            self._rng, rng = jax.random.split(self._rng)
+            finished = jnp.asarray(first == eos_token_id) \
+                if eos_token_id is not None else jnp.zeros(b, bool)
+            with dist.mesh_scope(self.mesh):
+                toks, cache, _ = self._decode_loop_fn(
+                    self.params, jnp.asarray(first), cache, finished, rng,
+                    int(n_bucket), bool(do_sample), float(temperature),
+                    int(top_k), float(top_p),
+                    None if eos_token_id is None else int(eos_token_id),
+                    0 if eos_token_id is None else int(eos_token_id))
+            rest = np.asarray(jax.device_get(toks))[:, :n_rest]
+            dt = time.time() - t0
+            # aggregate dispatch: spread the loop time over its tokens so
+            # model_times() percentiles stay meaningful
+            self._model_times.extend([dt / n_bucket] * n_rest)
+            gen = np.concatenate([first[:, None], rest], axis=1)
+            return np.concatenate([ids, gen], axis=1)
+
+        out = [first]
         finished = np.zeros(b, bool)
-        for _ in range(max_new_tokens - 1):
+        if eos_token_id is not None:
+            finished |= first == eos_token_id
+        tok = jnp.asarray(first)
+        for _ in range(n_rest):
+            if eos_token_id is not None and finished.all():
+                break
             t0 = time.time()
             self._rng, rng = jax.random.split(self._rng)
             with dist.mesh_scope(self.mesh):
@@ -294,8 +395,6 @@ class InferenceEngine:
                 host_tok = np.where(finished, eos_token_id, host_tok)
                 out.append(host_tok)
                 finished |= host_tok == eos_token_id
-                if finished.all():
-                    break
             else:
                 out.append(host_tok)
         gen = np.stack(out, axis=1)
@@ -312,8 +411,10 @@ class InferenceEngine:
         module = self.module
 
         if self._fwd is None:
+            materialize = self._materialize
             self._fwd = jax.jit(
-                lambda params, ids: module.apply({"params": params}, ids))
+                lambda params, ids: module.apply(
+                    {"params": materialize(params)}, ids))
         ids = np.asarray(ids)
         b, l0 = ids.shape
         total = l0 + max_new_tokens

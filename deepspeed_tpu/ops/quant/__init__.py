@@ -1,0 +1,6 @@
+"""Quantization ops (reference ``csrc/quantization/`` — quantize.cu,
+dequantize.cu, pt_binding.cpp — and ``deepspeed/ops/quantizer/``)."""
+
+from deepspeed_tpu.ops.quant.quantizer import (  # noqa: F401
+    QTensor, dequantize, dequantize_tree, quantize, quantize_tree)
+from deepspeed_tpu.ops.quant.kernels import int8_matmul  # noqa: F401

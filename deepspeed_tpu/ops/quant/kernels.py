@@ -1,0 +1,91 @@
+"""Pallas TPU int8 dequant-matmul (weight-only quantized serving matmul).
+
+Reference: the int8 GEMM + dequantize path of the inference kernels
+(``csrc/transformer/inference/csrc/dequantize.cu``,
+``csrc/quantization/pt_binding.cpp``). The weight stays int8 in HBM and
+is dequantized tile-by-tile in VMEM right before the MXU contraction, so
+HBM traffic is halved vs bf16 weights — the property that matters for
+memory-bandwidth-bound decode.
+
+The serving engine reaches the same property through XLA: QTensor leaves
+dequantize inside the jitted forward (quantizer.dequantize_tree) and XLA
+fuses the int8 convert+scale into the matmul's operand read, so the HBM
+stream stays int8 (measured: int8 decode beats bf16 in
+benchmarks/inference_bench.py). This kernel is the explicit-control
+Pallas equivalent — the oracle-tested building block for custom serving
+paths where fusion decisions must not be left to the compiler.
+
+Tiling: grid (m_blocks, n_blocks, k_blocks), k innermost with an fp32
+accumulator in VMEM scratch. block_k equals the quantization group size
+so each weight tile owns exactly one scale row.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_scr, *, nk):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    x = x_ref[...]                       # [bm, bk]
+    w = q_ref[...].astype(jnp.float32) * s_ref[0][None, :]  # [bk, bn] dequant
+    acc_scr[:] += jax.lax.dot_general(
+        x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[...] = acc_scr[:].astype(o_ref.dtype)
+
+
+def int8_matmul(x, q, scale, *, block_m=None, block_n=256, interpret=None):
+    """x [m, k] float @ dequant(q [k, n] int8, scale [k/G, n]) -> [m, n].
+
+    The k block size is the quantization group size G (one scale row per
+    weight tile). Oracle: ``x @ dequantize(q, scale)``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = x.shape
+    k2, n = q.shape
+    groups = scale.shape[0]
+    assert k == k2 and k % groups == 0
+    block_k = k // groups
+    if block_m is None:
+        block_m = min(256, m) if m % 8 == 0 or m >= 8 else m
+    while m % block_m != 0:
+        block_m //= 2
+        block_m = max(block_m, 1)
+    block_n = min(block_n, n)
+    while n % block_n != 0:
+        block_n //= 2
+    nm, nn, nk = m // block_m, n // block_n, k // block_k
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, block_n), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[
+            pl.ANY if pltpu is None
+            else pltpu.VMEM((block_m, block_n), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale.astype(jnp.float32))
+    return out

@@ -1,0 +1,111 @@
+"""Symmetric group quantization for weight-only int8/int4 serving.
+
+Reference: the group-quantization CUDA kernels
+(``csrc/quantization/quantize.cu``, ``dequantize.cu``,
+``pt_binding.cpp:1``) behind ``GroupQuantizer``
+(``module_inject/replace_module.py:138``) and the MoQ path. The TPU
+version is pure jax (XLA fuses the dequant convert+multiply into the
+consuming matmul) plus a Pallas dequant-matmul kernel (kernels.py) for
+the serving hot path.
+
+Weights quantize per group along the contraction (input) axis: a kernel
+[in, out] with group size G stores q int8 [in, out] and scales
+[in/G, out] — each group of G input rows shares one scale per output
+column. Symmetric: q = round(x / s), s = max|x| / qmax.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Quantized weight leaf: (q int8, scale) with the original dtype.
+    Lives inside a params pytree; jit/flatten treat q and scale as
+    children so the tree passes straight into jitted functions."""
+
+    def __init__(self, q, scale, dtype=jnp.bfloat16, bits=8):
+        self.q = q
+        self.scale = scale
+        self.dtype = dtype
+        self.bits = bits
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.size * self.q.dtype.itemsize + \
+            self.scale.size * self.scale.dtype.itemsize
+
+    def dequant(self):
+        return dequantize(self.q, self.scale, self.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.dtype, self.bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    def __repr__(self):
+        return (f"QTensor(shape={tuple(jnp.shape(self.q))}, "
+                f"bits={self.bits})")
+
+
+def quantize(x, *, bits=8, group_size=128):
+    """[in, out] float -> (q int8 [in, out], scale f32 [in/G, out]).
+    `in` must divide by group_size (callers pick eligible leaves)."""
+    assert bits in (8, 4), f"bits={bits} (int8 / int4 symmetric)"
+    n_in, n_out = x.shape
+    assert n_in % group_size == 0, (n_in, group_size)
+    qmax = 2.0 ** (bits - 1) - 1
+    g = x.reshape(n_in // group_size, group_size, n_out).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)      # [G, 1, out]
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(n_in, n_out), scale[:, 0, :]
+
+
+def dequantize(q, scale, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize`."""
+    n_in, n_out = q.shape
+    groups = scale.shape[0]
+    g = q.reshape(groups, n_in // groups, n_out).astype(jnp.float32)
+    return (g * scale[:, None, :]).reshape(n_in, n_out).astype(dtype)
+
+
+def _eligible(leaf, group_size):
+    shape = jnp.shape(leaf)
+    return (len(shape) == 2 and shape[0] % group_size == 0 and
+            shape[0] >= group_size and
+            jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating))
+
+
+def quantize_tree(params, *, bits=8, group_size=128, predicate=None):
+    """Quantize every eligible 2-D kernel in a param tree; other leaves
+    pass through. Returns a tree with QTensor leaves (the reference's
+    GroupQuantizer sweep over injected containers)."""
+    pred = predicate or (lambda path, leaf: True)
+
+    def per_leaf(path, leaf):
+        if _eligible(leaf, group_size) and pred(path, leaf):
+            dtype = jnp.asarray(leaf).dtype
+            q, s = quantize(jnp.asarray(leaf), bits=bits,
+                            group_size=group_size)
+            return QTensor(q, s, dtype, bits)
+        return leaf
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [per_leaf(jax.tree_util.keystr(p), l) for p, l in flat])
+
+
+def dequantize_tree(params):
+    """Materialize QTensor leaves back to floats (used inside jit: XLA
+    schedules each dequant next to its consumer, so peak memory stays
+    int8-tree + one layer's floats, not a full float copy)."""
+    return jax.tree.map(
+        lambda l: l.dequant() if isinstance(l, QTensor) else l,
+        params, is_leaf=lambda l: isinstance(l, QTensor))

@@ -326,16 +326,20 @@ class GPT2Head(nn.Module):
         return _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
 
 
-def gpt2_pipeline(cfg, num_stages, num_microbatches=None):
+def gpt2_pipeline(cfg, num_stages, num_microbatches=None, layer_weights=None,
+                  schedule="1f1b"):
     """GPT-2 as a pipeline-parallel model (reference PipelineModule usage,
     e.g. Megatron GPT on DeepSpeed PP). Honors cfg.tie_embeddings via the
-    PipelineModule tied-head path (reference TiedLayerSpec)."""
+    PipelineModule tied-head path (reference TiedLayerSpec);
+    `layer_weights` gives non-uniform stage partitioning
+    (reference partition_balanced)."""
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
     return PipelineModule(block=Block(cfg), num_blocks=cfg.num_layers,
                           num_stages=num_stages,
                           embed=GPT2Embed(cfg), head=GPT2Head(cfg),
                           num_microbatches=num_microbatches,
-                          tied_head=cfg.tie_embeddings)
+                          tied_head=cfg.tie_embeddings,
+                          layer_weights=layer_weights, schedule=schedule)
 
 
 def init_kv_cache(cfg: GPTConfig, batch_size, max_len=None,

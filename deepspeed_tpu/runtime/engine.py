@@ -115,6 +115,11 @@ class DeepSpeedEngine:
                           self._config.matmul_precision) \
             if self._config.matmul_precision != "default" else None
 
+        if loss_fn is None:
+            from deepspeed_tpu.runtime.pipe.module import PipelineModule
+            if isinstance(model, PipelineModule) and \
+                    model.schedule == "1f1b":
+                loss_fn = model.make_loss_fn()
         self.loss_fn = loss_fn or self._default_loss_fn()
         self._rng = jax.random.PRNGKey(seed)
         self._example_batch = example_batch
@@ -384,7 +389,18 @@ class DeepSpeedEngine:
                 lambda x: x.astype(compute_dtype)
                 if x.dtype == jnp.float32 and compute_dtype != jnp.float32 else x, p)
 
+        # pipeline loss_fns hand back (loss, grads) from one interleaved
+        # 1F1B scan — cheaper than value_and_grad, which would run the
+        # forward-only pipeline AND the backward's forward slots
+        loss_and_grads = getattr(loss_fn, "loss_and_grads", None)
+
         def fwd_bwd(params, scale, batch, rng):
+            if loss_and_grads is not None:
+                loss, grads = loss_and_grads(cast(params), batch)
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) * (scale / gas), grads)
+                return loss, grads
+
             def scaled_loss(p):
                 loss = loss_fn(cast(p), batch, rng)
                 return loss.astype(jnp.float32) * scale / gas, loss

@@ -90,10 +90,14 @@ def partition_balanced(weights, num_parts):
             hi = mid
         else:
             lo = mid
-    # build boundaries greedily at weight hi
+    # build boundaries greedily at weight hi; a stage must also break when
+    # the remaining items are only enough to give each remaining stage one
+    # (otherwise trailing stages end up empty, e.g. 4 blocks / 3 stages)
     bounds, cur = [0], 0.0
     for i, w in enumerate(weights):
-        if cur + w > hi and len(bounds) < num_parts:
+        parts_left = num_parts - (len(bounds) - 1)
+        must_break = (n - i) <= (parts_left - 1) and i > bounds[-1]
+        if (cur + w > hi or must_break) and len(bounds) < num_parts:
             bounds.append(i)
             cur = w
         else:
@@ -202,7 +206,8 @@ class PipelineModule:
     def __init__(self, layers=None, *, block=None, num_blocks=None,
                  num_stages=None, embed=None, head=None,
                  num_microbatches=None, partition_method="parameters",
-                 loss_fn=None, tied_head=False):
+                 loss_fn=None, tied_head=False, schedule="1f1b",
+                 layer_weights=None):
         self.layers = layers
         self.block = block
         self.num_blocks = num_blocks
@@ -216,11 +221,81 @@ class PipelineModule:
         # TiedLayerSpec — embeddings shared between first and last stage;
         # here both live outside the pipelined region, so tying is direct)
         self.tied_head = tied_head
+        # "1f1b": training runs the bounded-memory interleaved schedule
+        # (one_f_one_b.py); "gpipe": autodiff through the fill-drain scan
+        assert schedule in ("1f1b", "gpipe"), schedule
+        self.schedule = schedule
         if block is not None:
             assert num_blocks is not None and num_stages is not None
-            assert num_blocks % num_stages == 0, \
-                f"{num_blocks} blocks over {num_stages} stages must be even"
-            self.layers_per_stage = num_blocks // num_stages
+            # non-uniform stages (reference LayerSpec weights +
+            # partition_balanced, runtime/utils.py:599): each stage's
+            # stack is padded to the max and padded slots are skipped
+            w = list(layer_weights) if layer_weights is not None \
+                else [1] * num_blocks
+            assert len(w) == num_blocks, (len(w), num_blocks)
+            bounds = partition_balanced(w, num_stages)
+            self.k_per_stage = tuple(bounds[i + 1] - bounds[i]
+                                     for i in range(num_stages))
+            assert min(self.k_per_stage) >= 1, \
+                f"empty pipeline stage: {self.k_per_stage}"
+            self.layers_per_stage = max(self.k_per_stage)
+            self.uniform = len(set(self.k_per_stage)) == 1
+
+    # --------------------------------------------------------- 1F1B loss
+    def make_loss_fn(self, per_token_loss=None):
+        """Engine-compatible ``loss_fn(params, batch, rng)`` running the
+        1F1B schedule (runtime/pipe/one_f_one_b.py). The default
+        per-token loss is next-token CE with -100 ignore (the reference
+        PipelineEngine's loss_fn contract, pipe/engine.py:285)."""
+        from deepspeed_tpu.runtime.pipe.one_f_one_b import (
+            make_pipeline_loss_fn)
+
+        if per_token_loss is None:
+            from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+
+            def per_token_loss(logits, labels):
+                return gpt2_loss_fn(logits, {"labels": labels})
+
+        cache = {}
+
+        def resolve(batch):
+            from deepspeed_tpu import comm as dist
+            mesh = dist.get_mesh()
+            assert mesh is not None and \
+                mesh.shape.get("pipe") == self.num_stages, \
+                "active mesh must carry the pipe axis sized num_stages"
+            key = id(mesh)
+            if key not in cache:
+                cache[key] = make_pipeline_loss_fn(
+                    self, per_token_loss, mesh=mesh,
+                    num_microbatches=self.num_microbatches)
+            ids = batch["input_ids"]
+            labels = batch.get("labels")
+            if labels is None:
+                labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)),
+                                 constant_values=-100)
+            return cache[key], ids, labels
+
+        def split(params):
+            return {"stages": params["stages"],
+                    "embed": params.get("embed", {}),
+                    "head": params.get("head", {})}
+
+        def loss_fn(params, batch, rng):
+            fn, ids, labels = resolve(batch)
+            return fn(split(params), ids, labels)
+
+        def loss_and_grads(params, batch):
+            """One interleaved scan for (loss, grads) — the engine's
+            training fast path. Going through value_and_grad would run
+            the forward-only pipeline AND the interleaved scan (3x
+            forward FLOPs); this is the reference's 2x (forward +
+            activation-checkpoint recompute)."""
+            fn, ids, labels = resolve(batch)
+            return fn.pipeline_bwd_grads(split(params), ids, labels)
+
+        loss_fn.loss_and_grads = loss_and_grads
+        return loss_fn
 
     # ---------------------------------------------------- reference parity
     def stage_ranges(self, weights=None):
@@ -276,7 +351,13 @@ class PipelineModule:
         block = self.block
         drop_rng = (rngs or {}).get("dropout")
 
+        uniform = self.uniform
+        k_per_stage = self.k_per_stage
+
         def block_apply(kparams, h, step_rng):
+            k_local = None if uniform else \
+                jnp.asarray(k_per_stage)[lax.axis_index("pipe")]
+
             def one(carry, xs):
                 h, i = carry
                 layer_params = xs
@@ -287,6 +368,8 @@ class PipelineModule:
                                 deterministic, **kw)
                 if isinstance(y, tuple):  # blocks with a (x, cache) contract
                     y = y[0]
+                if k_local is not None:   # padded slot on a short stage
+                    y = jnp.where(i < k_local, y, h)
                 return (y, i + 1), None
             (h, _), _ = lax.scan(one, (h, jnp.int32(0)), kparams)
             return h

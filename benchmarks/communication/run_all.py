@@ -1,0 +1,107 @@
+"""Collective benchmark sweep (reference benchmarks/communication/run_all.py
++ bin/ds_bench): psum / all_gather / reduce_scatter / all_to_all /
+ppermute over the active mesh, across message sizes, reporting latency
+and algorithmic/bus bandwidth via the comms logger's formulas.
+
+Usage:
+    python benchmarks/communication/run_all.py [--axis data]
+        [--maxsize 26] [--trials 5] [--dtype float32] [--json out.json]
+
+Runs on whatever devices are visible (one TPU chip -> trivial loopback;
+the 8-device virtual CPU mesh exercises real collectives; a TPU pod
+exercises ICI).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--axis", default="data")
+    p.add_argument("--maxsize", type=int, default=24,
+                   help="log2 of the largest message in bytes")
+    p.add_argument("--minsize", type=int, default=16)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--warmups", type=int, default=2)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--ops", default="all_reduce,all_gather,reduce_scatter,"
+                                    "all_to_all,ppermute")
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+
+    import jax
+    if os.environ.get("DSTPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices",
+                          int(os.environ.get("DSTPU_BENCH_CPU")))
+    import jax.numpy as jnp
+    from jax import lax
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.parallel.topology import make_mesh
+    from deepspeed_tpu.utils.comms_logging import calc_bw_log
+
+    if dist.get_mesh() is None:
+        dist.set_mesh(make_mesh())
+    mesh = dist.get_mesh()
+    ax = args.axis
+    n = mesh.shape[ax]
+    dtype = jnp.dtype(args.dtype)
+    print(f"# mesh={dict(mesh.shape)} axis={ax} n={n} "
+          f"platform={jax.default_backend()}", file=sys.stderr)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    OPS = {
+        "all_reduce": lambda x: lax.psum(x, ax),
+        "all_gather": lambda x: lax.all_gather(x, ax, tiled=True),
+        "reduce_scatter": lambda x: lax.psum_scatter(x, ax, tiled=True),
+        "all_to_all": lambda x: lax.all_to_all(
+            x.reshape(n, -1), ax, 0, 0, tiled=False).reshape(-1),
+        "ppermute": lambda x: lax.ppermute(x, ax, perm),
+    }
+    results = []
+    for op_name in args.ops.split(","):
+        fn = OPS[op_name]
+        size = 1 << args.minsize
+        while size <= (1 << args.maxsize):
+            elems = max(size // dtype.itemsize, n * n)
+            elems -= elems % (n * n)      # per-shard length must also
+                                          # divide by n (scatter/all2all)
+            x = jnp.asarray(np.random.default_rng(0)
+                            .standard_normal(elems), dtype)
+            times = []
+            for t in range(args.warmups + args.trials):
+                t0 = time.time()
+                out = dist.eager_collective(fn, x, group=ax,
+                                            op_name=op_name)
+                jax.block_until_ready(out)
+                dt = time.time() - t0
+                if t >= args.warmups:
+                    times.append(dt)
+            lat = float(np.median(times))
+            # calc_bw_log expects the per-rank message size
+            _, algbw, busbw = calc_bw_log(op_name, size // max(n, 1),
+                                          lat, n=n)
+            row = {"op": op_name, "bytes": size, "latency_ms":
+                   round(lat * 1e3, 4), "algbw_gbps": round(algbw, 3),
+                   "busbw_gbps": round(busbw, 3), "n": n}
+            results.append(row)
+            print(json.dumps(row))
+            size <<= 2
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"mesh": dict(mesh.shape), "axis": ax,
+                       "results": results}, f, indent=2)
+        print(f"# wrote {args.json}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -487,6 +487,76 @@ class DeepSpeedEngine:
             step_last, donate_argnums=(1, 3),
             out_shardings=(None, self._state_sh, None))
 
+    # -------------------------------------------------------------- profiling
+    def flops_profile(self, batch=None):
+        """Exact flops/bytes of one optimizer step from the compiled XLA
+        executables (reference FlopsProfiler.get_total_flops — but from
+        the optimizer's own post-fusion HLO, so remat and fusion are
+        accounted). Returns a dict; gas>1 sums the micro dispatches."""
+        from deepspeed_tpu.profiling.flops_profiler.profiler import (
+            cost_analysis, params_count)
+        if batch is None:
+            batch = getattr(self, "_last_batch", None)
+        if batch is None:
+            batch = self._example_batch
+        assert batch is not None, "flops_profile needs a batch before init"
+        cached = getattr(self, "_flops_profile_cache", None)
+        if cached is not None:
+            return cached
+        self._ensure_initialized(batch)
+        dev_batch = self._put_batch(batch)
+        rng = jax.random.PRNGKey(0)
+        lr = float(self.get_lr()[0])
+        state = self._live_state()
+        rest = state.replace(params=None, opt_state=None)
+        if self._offload is not None:
+            micro = cost_analysis(self._micro_first, state.params,
+                                  jnp.float32(1.0), dev_batch, rng)
+            flops = micro["flops"] * self.gas
+            bytes_ = micro["bytes_accessed"] * self.gas
+        elif self.gas == 1:
+            c = cost_analysis(self._step_gas1, state.params,
+                              state.opt_state, rest, dev_batch, rng, lr)
+            flops, bytes_ = c["flops"], c["bytes_accessed"]
+        else:
+            first = cost_analysis(self._micro_first, state.params,
+                                  state.scaler.loss_scale, dev_batch, rng)
+            grads_sds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                state.params)
+            last = cost_analysis(self._step_last, state.params,
+                                 state.opt_state, rest, grads_sds,
+                                 dev_batch, rng, lr)
+            nxt = cost_analysis(self._micro_next, state.params,
+                                state.scaler.loss_scale, grads_sds,
+                                dev_batch, rng)
+            flops = first["flops"] + (self.gas - 2) * nxt["flops"] + \
+                last["flops"]
+            bytes_ = first["bytes_accessed"] + \
+                (self.gas - 2) * nxt["bytes_accessed"] + \
+                last["bytes_accessed"]
+        n_params = params_count(state.params)
+        tokens_per_step = self.gas * max(
+            int(np.prod(np.shape(self._model_input(batch)))), 1)
+        out = {"flops_per_step": flops, "bytes_accessed": bytes_,
+               "params": n_params,
+               "flops_per_token": flops / tokens_per_step}
+        self._flops_profile_cache = out   # shapes are fixed per engine
+        return out
+
+    def _maybe_log_flops(self):
+        cfg = self._config.flops_profiler
+        if not cfg.enabled or self.global_steps != cfg.profile_step:
+            return
+        prof = self.flops_profile()
+        tflops = prof["flops_per_step"] / 1e12
+        log_dist(
+            f"flops_profiler @ step {self.global_steps}: "
+            f"{tflops:.3f} TFLOPs/step, "
+            f"{prof['params'] / 1e6:.1f}M params, "
+            f"{prof['bytes_accessed'] / 1e9:.2f} GB accessed/step",
+            ranks=[0])
+
     # ------------------------------------------------------------------ train
     def _live_state(self):
         """The most recent state tree with live (non-donated) buffers.
@@ -513,6 +583,7 @@ class DeepSpeedEngine:
             "buffers that only backward() re-homes (for a loss-only pass " \
             "use eval_batch)"
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._last_batch = batch   # for flops_profile / diagnostics
         dev_batch = self._put_batch(batch)
         if rng is None:
             rng, self._rng = jax.random.split(self._rng)
@@ -600,6 +671,7 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         self._last_metrics = metrics
         self.timers(STEP_GLOBAL_TIMER).stop()
+        self._maybe_log_flops()
 
         if self.monitor.enabled and self.global_steps % \
                 self._config.steps_per_print == 0:
@@ -634,6 +706,7 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         self._last_metrics = metrics
         self.timers(STEP_GLOBAL_TIMER).stop()
+        self._maybe_log_flops()
         if self.monitor.enabled and self.global_steps % \
                 self._config.steps_per_print == 0:
             self.monitor.write_events(

@@ -1,0 +1,26 @@
+"""Comm benchmark suite smoke test (reference
+benchmarks/communication/run_all.py is the comm backend's perf test)."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_comm_bench_runs_and_emits_json(tmp_path):
+    out = tmp_path / "bench.json"
+    env = dict(os.environ, DSTPU_BENCH_CPU="8", JAX_PLATFORMS="")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    r = subprocess.run(
+        [sys.executable, "benchmarks/communication/run_all.py",
+         "--minsize", "12", "--maxsize", "14", "--trials", "1",
+         "--warmups", "1", "--json", str(out)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    data = json.loads(out.read_text())
+    ops = {row["op"] for row in data["results"]}
+    assert {"all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+            "ppermute"} <= ops
+    assert all(row["latency_ms"] > 0 for row in data["results"])
+    assert data["results"][0]["n"] == 8

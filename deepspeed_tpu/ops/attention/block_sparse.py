@@ -1,0 +1,382 @@
+"""Pallas TPU block-sparse flash attention (fwd + bwd).
+
+Reference: the Triton block-sparse attention kernels
+(``deepspeed/ops/sparse_attention/matmul.py`` SDD/DSD/DDS :196-628,
+``softmax.py`` :123) driven by SparsityConfig layouts — the reference's
+long-sequence story (10x longer sequences, ~6x faster; BASELINE.md).
+
+Design — compacted look-up tables with scalar prefetch:
+  * the [heads, nq, nk] block layout is compiled (at trace time, on host)
+    into a LUT of active column blocks per query row: ``lut[h, qi, t]``
+    and ``count[h, qi]``. The grid is ``(b*h, nq, max_active)`` — grid
+    steps exist ONLY for (padded) active blocks, so both the MXU work
+    AND the k/v block DMA scale with the layout density. This is the
+    Pallas equivalent of the Triton kernels' ``make_lut``.
+  * the LUT rides as *scalar prefetch* operands (SMEM), so BlockSpec
+    index maps can read it — the pipeline knows the next block's address
+    ahead of time and keeps prefetching (a data-dependent ``pl.when``
+    skip would serialize Mosaic's double buffering; measured 5x slower).
+  * padding steps (t >= count) re-point the DMA at the row's last active
+    block (no new traffic) and skip compute.
+  * causal masking stays in-kernel for diagonal blocks; callers pass
+    layouts already lower-triangular for unidirectional patterns
+    (flash_attention ANDs tril in).
+  * backward follows flash-attention-2: dq over the same row LUT; dk/dv
+    over the transposed (column -> active rows) LUT.
+
+Measured (1 v5e chip via the dev relay, seq 8k, 4 heads, d=64, block
+512, in-dispatch chained timing, 3 runs): window+global layout at ~12%
+density runs ~1.35x faster than the dense layout through the same
+kernel (3.4ms vs 4.5ms/iter). Both share a ~3ms fixed per-invocation
+floor in this environment; subtracting it, the marginal per-block cost
+scales with density as designed (~1.3us/step). The floor is an
+environment/dispatch artifact of the small-batch d=64 regime, not the
+kernel loop — re-profile on directly-attached chips at production
+head counts.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from deepspeed_tpu.ops.attention.flash import (NEG_INF, _bwd_p_ds,
+                                               _causal_block_mask,
+                                               _finalize_softmax,
+                                               _online_softmax_step)
+
+
+def build_luts(layout):
+    """layout [H, nq, nk] int -> row LUT + transposed (column) LUT.
+
+    Returns (lut [H, nq, A], count [H, nq], lut_t [H, nk, At],
+    count_t [H, nk]); padding entries repeat the last active index so
+    padded grid steps re-fetch an already-resident block."""
+    layout = np.asarray(layout) != 0
+    H, nq, nk = layout.shape
+
+    def compact(mat, n_rows, n_cols):
+        counts = mat.sum(axis=-1).astype(np.int32)        # [H, rows]
+        A = max(int(counts.max()), 1)
+        lut = np.zeros((H, n_rows, A), np.int32)
+        for h in range(H):
+            for r in range(n_rows):
+                idx = np.nonzero(mat[h, r])[0]
+                if len(idx) == 0:
+                    continue
+                lut[h, r, :len(idx)] = idx
+                lut[h, r, len(idx):] = idx[-1]
+        return lut, counts
+
+    lut, count = compact(layout, nq, nk)
+    lut_t, count_t = compact(layout.transpose(0, 2, 1), nk, nq)
+    return lut, count, lut_t, count_t
+
+
+def _head(i, num_heads, layout_heads):
+    return jnp.mod(i, num_heads) if layout_heads > 1 else 0
+
+
+# --------------------------------------------------------------------- fwd
+def _fwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, block, causal, num_heads,
+                layout_heads, n_active):
+    qi = pl.program_id(1)
+    t = pl.program_id(2)
+    h = _head(pl.program_id(0), num_heads, layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    ki = lut_ref[h, qi, t]
+    run = t < cnt_ref[h, qi]
+    if causal:
+        run = jnp.logical_and(run, ki <= qi)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_block_mask(s, qi, ki, block, block, 0)
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
+
+    @pl.when(t == n_active - 1)
+    def _finalize():
+        _finalize_softmax(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _sparse_fwd(q3, k3, v3, lut, cnt, *, scale, block, causal, num_heads,
+                interpret):
+    bh, q_len, d = q3.shape
+    nq = q_len // block
+    A = lut.shape[2]
+    H = lut.shape[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nq, A),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt: (i, j, 0)),
+            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt:
+                         (i, lut[_head(i, num_heads, H), j, t], 0)),
+            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt:
+                         (i, lut[_head(i, num_heads, H), j, t], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt: (i, j, 0)),
+            pl.BlockSpec((1, block, 1), lambda i, j, t, lut, cnt: (i, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, 128), jnp.float32),
+            pltpu.VMEM((block, 128), jnp.float32),
+            pltpu.VMEM((block, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block=block, causal=causal,
+        num_heads=num_heads, layout_heads=H, n_active=A)
+    o, lse = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lut, cnt, q3, k3, v3)
+    return o, lse
+
+
+# --------------------------------------------------------------------- bwd
+def _bwd_dq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr, *, scale, block, causal,
+                   num_heads, layout_heads, n_active):
+    qi = pl.program_id(1)
+    t = pl.program_id(2)
+    h = _head(pl.program_id(0), num_heads, layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    ki = lut_ref[h, qi, t]
+    run = t < cnt_ref[h, qi]
+    if causal:
+        run = jnp.logical_and(run, ki <= qi)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], scale,
+                          causal, qi, ki, block, block, 0)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_active - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                    block, causal, num_heads, layout_heads, n_active):
+    ki = pl.program_id(1)
+    t = pl.program_id(2)
+    h = _head(pl.program_id(0), num_heads, layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    qi = lut_ref[h, ki, t]
+    run = t < cnt_ref[h, ki]
+    if causal:
+        run = jnp.logical_and(run, ki <= qi)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], scale,
+                          causal, qi, ki, block, block, 0)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_active - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _sparse_bwd(q3, k3, v3, o3, lse, do3, lut, cnt, lut_t, cnt_t, *, scale,
+                block, causal, num_heads, interpret):
+    bh, q_len, d = q3.shape
+    nq = q_len // block
+    A, At = lut.shape[2], lut_t.shape[2]
+    H = lut.shape[0]
+
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def row(i, j, t, lut, cnt):
+        return (i, j, 0)
+
+    def col_from_lut(i, j, t, lut, cnt):
+        return (i, lut[_head(i, num_heads, H), j, t], 0)
+
+    grid_dq = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nq, A),
+        in_specs=[
+            pl.BlockSpec((1, block, d), row),
+            pl.BlockSpec((1, block, d), col_from_lut),
+            pl.BlockSpec((1, block, d), col_from_lut),
+            pl.BlockSpec((1, block, d), row),
+            pl.BlockSpec((1, block, 1), row),
+            pl.BlockSpec((1, block, 1), row),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), row),
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block=block,
+                          causal=causal, num_heads=num_heads,
+                          layout_heads=H, n_active=A),
+        grid_spec=grid_dq,
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+        interpret=interpret,
+    )(lut, cnt, q3, k3, v3, do3, lse, delta)
+
+    grid_dkv = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, k3.shape[1] // block, At),
+        in_specs=[
+            pl.BlockSpec((1, block, d), col_from_lut),   # q rows via lut_t
+            pl.BlockSpec((1, block, d), row),            # k fixed column
+            pl.BlockSpec((1, block, d), row),
+            pl.BlockSpec((1, block, d), col_from_lut),   # do rows
+            pl.BlockSpec((1, block, 1), col_from_lut),
+            pl.BlockSpec((1, block, 1), col_from_lut),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), row),
+            pl.BlockSpec((1, block, d), row),
+        ],
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                        pltpu.VMEM((block, d), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block=block,
+                          causal=causal, num_heads=num_heads,
+                          layout_heads=H, n_active=At),
+        grid_spec=grid_dkv,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, q_len, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(lut_t, cnt_t, q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------- entry
+def make_sparse_op(layout, *, causal, scale, block, num_heads, interpret):
+    """custom_vjp closing over the (static) layout's LUTs."""
+    lut, cnt, lut_t, cnt_t = build_luts(layout)
+    lut, cnt = jnp.asarray(lut), jnp.asarray(cnt)
+    lut_t, cnt_t = jnp.asarray(lut_t), jnp.asarray(cnt_t)
+    kw = dict(scale=scale, block=block, causal=causal, num_heads=num_heads,
+              interpret=interpret)
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        o, _ = _sparse_fwd(q3, k3, v3, lut, cnt, **kw)
+        return o
+
+    def fwd(q3, k3, v3):
+        o, lse = _sparse_fwd(q3, k3, v3, lut, cnt, **kw)
+        return o, (q3, k3, v3, o, lse)
+
+    def bwd(res, do):
+        q3, k3, v3, o, lse = res
+        return _sparse_bwd(q3, k3, v3, o, lse, do, lut, cnt, lut_t, cnt_t,
+                           **kw)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+_OP_CACHE = {}
+_OP_CACHE_MAX = 64
+
+
+def _config_key(cfg):
+    def freeze(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else v
+    return (type(cfg).__name__,) + tuple(
+        (k, freeze(v)) for k, v in sorted(cfg.__dict__.items()))
+
+
+def sparse_flash_attention(q, k, v, sparsity_config, *, causal=True,
+                           scale=None, interpret=None):
+    """Block-sparse attention on [batch, len, heads, head_dim] inputs,
+    pattern from a SparsityConfig (ops/sparse_attention). Ops (and their
+    host-built LUTs) are cached per (config, seq, heads, ...) so repeated
+    calls/retraces skip the O(heads * blocks^2) layout compaction."""
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "block-sparse attention needs the Pallas TPU backend "
+            "(jax.experimental.pallas.tpu); use mha_reference with "
+            "layout_to_bias as the fallback")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, q_len, h, d = q.shape
+    assert q.shape[1] == k.shape[1], "sparse layouts are square"
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+
+    key = (_config_key(sparsity_config), q_len, h, bool(causal), scale,
+           bool(interpret))
+    op = _OP_CACHE.get(key)
+    if op is None:
+        layout = np.asarray(sparsity_config.make_layout(q_len))
+        if causal:
+            layout = np.tril(layout)
+        assert layout.shape[0] in (1, h), (layout.shape, h)
+        if len(_OP_CACHE) >= _OP_CACHE_MAX:
+            _OP_CACHE.pop(next(iter(_OP_CACHE)))
+        op = make_sparse_op(layout, causal=causal, scale=scale,
+                            block=int(sparsity_config.block), num_heads=h,
+                            interpret=interpret)
+        _OP_CACHE[key] = op
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o3 = op(to3(q), to3(k), to3(v))
+    return o3.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)

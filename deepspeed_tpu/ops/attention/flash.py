@@ -38,6 +38,63 @@ NEG_INF = float(-1e30)  # large-negative instead of -inf: keeps exp() exact-0
                         # without nan from (-inf) - (-inf)
 
 
+def _causal_block_mask(s, qi, ki, block_q, block_k, offset):
+    """Apply the in-block causal mask to a score tile."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + offset
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _online_softmax_step(s, v, m_scr, l_scr, acc_scr):
+    """One flash-attention online-softmax update of the (m, l, acc)
+    scratch state with a new score tile `s` and value block `v`.
+    Shared by the dense and block-sparse kernels — numerics fixes land
+    in exactly one place."""
+    m_prev = m_scr[:][:, :1]
+    l_prev = l_scr[:][:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # fully-masked rows: m_new stays at NEG_INF and exp(NEG_INF - NEG_INF)
+    # would be 1 - force p/alpha to 0
+    row_live = m_new > NEG_INF / 2
+    alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
+    p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
+def _finalize_softmax(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    l = l_scr[:][:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)       # fully-masked row -> zeros
+    o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+    lse_ref[0] = m_scr[:][:, :1] + jnp.log(l)
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, scale, causal, qi, ki, block_q,
+              block_k, offset):
+    """Recompute p from the saved logsumexp and form ds (flash-2 style);
+    shared by the dense and sparse backward kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_block_mask(s, qi, ki, block_q, block_k, offset)
+    # fully-masked rows carry lse = NEG_INF; their p must be 0
+    p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
 def _causal_valid(qi, ki, block_q, block_k, offset):
     """Whether block (qi, ki) has any unmasked entry under causal+offset."""
     max_q = qi * block_q + block_q - 1 + offset
@@ -67,35 +124,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-
-        m_prev = m_scr[:][:, :1]
-        l_prev = l_scr[:][:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # fully-masked rows (q_len > k_len prefill shapes): m_new stays at
-        # NEG_INF and exp(NEG_INF - NEG_INF) would be 1 — force p/alpha to 0
-        row_live = m_new > NEG_INF / 2
-        alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
-        p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+            s = _causal_block_mask(s, qi, ki, block_q, block_k, offset)
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_scr[:][:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)       # fully-masked row -> zeros
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:][:, :1] + jnp.log(l)
+        _finalize_softmax(o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
 def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
@@ -161,22 +195,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0]          # (block_q, 1)
         delta = delta_ref[0]      # (block_q, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        # fully-masked rows carry lse = NEG_INF; their p must be 0, not
-        # exp(s - NEG_INF)
-        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        p, ds = _bwd_p_ds(q, k, v, do, lse, delta, scale, causal, qi, ki,
+                          block_q, block_k, offset)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -207,23 +227,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]          # (block_q, 1)
         delta = delta_ref[0]      # (block_q, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        p, ds = _bwd_p_ds(q, k, v, do, lse, delta, scale, causal, qi, ki,
+                          block_q, block_k, offset)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # (bq, bk)
-        ds = p * (dp - delta) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
@@ -324,14 +332,24 @@ def _pick_block(seq_len, target=512):
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
-                    block_k=None, interpret=None):
+                    block_k=None, interpret=None, sparsity_config=None):
     """Flash attention on [batch, len, heads, head_dim] inputs.
 
     Drop-in for :func:`ops.attention.reference.mha_reference` (the oracle).
     `interpret=None` auto-selects interpret mode off-TPU so CPU tests run
     the same kernel. Block sizes default to the largest divisor of the seq
     len up to 512 (see :func:`_pick_block`).
+
+    ``sparsity_config`` (ops/sparse_attention SparsityConfig) routes to
+    the block-sparse kernel (block_sparse.py): grid steps exist only for
+    active blocks, so compute AND k/v traffic scale with layout density.
     """
+    if sparsity_config is not None:
+        from deepspeed_tpu.ops.attention.block_sparse import (
+            sparse_flash_attention)
+        return sparse_flash_attention(q, k, v, sparsity_config,
+                                      causal=causal, scale=scale,
+                                      interpret=interpret)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, q_len, h, d = q.shape

@@ -1,0 +1,40 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``:
+Triton SDD/DSD/DDS matmuls + sparse softmax + SparsityConfig patterns;
+here the patterns drive the Pallas flash kernel's block-skip predicate).
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (  # noqa: F401
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)
+
+
+class SparseSelfAttention:
+    """Functional counterpart of the reference ``SparseSelfAttention``
+    module (sparse_self_attention.py): q/k/v [b, l, h, d] -> context, with
+    the pattern from `sparsity_config`."""
+
+    def __init__(self, sparsity_config, attn_mask_mode="add", scale=None):
+        self.sparsity_config = sparsity_config
+        self.attn_mask_mode = attn_mask_mode
+        self.scale = scale
+
+    def __call__(self, q, k, v, causal=None):
+        from deepspeed_tpu.ops.attention.flash import flash_attention
+        if causal is None:
+            causal = self.sparsity_config.__dict__.get(
+                "attention", "bidirectional") == "unidirectional"
+        return flash_attention(q, k, v, causal=causal, scale=self.scale,
+                               sparsity_config=self.sparsity_config)
+
+
+def layout_to_bias(layout, seq_len, block, dtype=jnp.float32):
+    """Dense additive bias from a block layout (the jnp oracle used by
+    tests): [H, n, n] blocks -> [1, H, L, L] with -inf on inactive."""
+    import numpy as np
+    H, nq, nk = layout.shape
+    mask = np.repeat(np.repeat(np.asarray(layout), block, 1), block, 2)
+    bias = np.where(mask > 0, 0.0, float(np.finfo(np.float32).min))
+    return jnp.asarray(bias[None], dtype)

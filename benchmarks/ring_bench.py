@@ -1,0 +1,103 @@
+"""Ring-attention long-context benchmark (the capability claim of
+SURVEY.md §5.7: context length scales with the `sequence` mesh axis).
+
+Compares, at a given total sequence length:
+  * full flash attention on one device (memory O(L), compute O(L^2));
+  * ring attention with L sharded over the sequence axis (per-device
+    memory O(L/P); k/v chunks hop the ring in input dtype).
+
+On the 1-chip TPU env the ring degenerates (P=1), so the headline row is
+the single-chip flash at 32k — the ring rows need a multi-device mesh
+(CI runs the 8-device virtual CPU mesh at reduced size; a pod runs the
+real thing over ICI).
+
+Usage: python benchmarks/ring_bench.py [--seq 32768] [--heads 4]
+       [--dim 64] [--cpu-devices 0] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fence(x):
+    import jax
+    import jax.numpy as jnp
+    return float(jax.device_get(jnp.sum(x.astype(jnp.float32))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=32768)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="force an N-device virtual CPU mesh")
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+
+    import jax
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    import jax.numpy as jnp
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.ops.attention import flash_attention
+    from deepspeed_tpu.ops.attention.ring import ring_attention_sharded
+    from deepspeed_tpu.parallel.topology import make_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    n_dev = len(jax.devices())
+    L, h, d = args.seq, args.heads, args.dim
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(1, L, h, d)) * 0.3, dtype)
+    q, k, v = mk(), mk(), mk()
+    results = []
+
+    def bench(f, *xs):
+        fence(f(*xs))
+        t0 = time.time()
+        out = None
+        for _ in range(args.trials):
+            out = f(*xs)
+        fence(out)
+        return (time.time() - t0) / args.trials * 1e3
+
+    full = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    t_full = bench(full, q, k, v)
+    row = {"metric": "full_flash_attention", "seq": L, "heads": h,
+           "latency_ms": round(t_full, 2), "n_devices": 1,
+           "platform": jax.default_backend()}
+    results.append(row)
+    print(json.dumps(row))
+
+    if n_dev > 1:
+        mesh = make_mesh(MeshConfig(sequence=n_dev))
+        dist.set_mesh(mesh)
+        ring = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=True))
+        t_ring = bench(ring, q, k, v)
+        err = float(jnp.max(jnp.abs(
+            (ring(q, k, v) - full(q, k, v)).astype(jnp.float32))))
+        row = {"metric": "ring_attention", "seq": L, "heads": h,
+               "latency_ms": round(t_ring, 2), "n_devices": n_dev,
+               "chunk": L // n_dev, "max_err_vs_full": round(err, 5),
+               "platform": jax.default_backend()}
+        results.append(row)
+        print(json.dumps(row))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,19 @@ NEG_INF = float(-1e30)  # large-negative instead of -inf: keeps exp() exact-0
                         # without nan from (-inf) - (-inf)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes of `like`, so
+    pallas_call works under shard_map with check_vma=True (ring/Ulysses
+    call the kernel per shard)."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _causal_block_mask(s, qi, ki, block_q, block_k, offset):
     """Apply the in-block causal mask to a score tile."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -162,8 +175,8 @@ def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
             pl.BlockSpec((1, block_q, 1), lambda i, j, k: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+            _sds((bh, q_len, d), q3.dtype, q3),
+            _sds((bh, q_len, 1), jnp.float32, q3),
         ],
         scratch_shapes=[
             pl.ANY if pltpu is None else pltpu.VMEM((block_q, 128), jnp.float32),
@@ -243,7 +256,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
-               interpret):
+               interpret, dlse=None):
     bh, q_len, d = q3.shape
     k_len = k3.shape[1]
     block_q = min(block_q, q_len)
@@ -253,6 +266,10 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
 
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
                     keepdims=True)  # (bh, q_len, 1) to match lse layout
+    if dlse is not None:
+        # cotangent of the logsumexp output: d lse / d s = p, so it folds
+        # into ds = p*(dp - delta + dlse)*scale, i.e. delta -= dlse
+        delta = delta - dlse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, k: (i, k, 0))
@@ -263,7 +280,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
         grid=(bh, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+        out_shape=_sds((bh, q_len, d), q3.dtype, q3),
         scratch_shapes=[
             pl.ANY if pltpu is None else pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
@@ -283,8 +300,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
             pl.BlockSpec((1, block_k, d), lambda i, k, j: (i, k, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, k_len, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype),
+            _sds((bh, k_len, d), k3.dtype, k3),
+            _sds((bh, k_len, d), v3.dtype, v3),
         ],
         scratch_shapes=[
             pl.ANY if pltpu is None else pltpu.VMEM((block_k, d), jnp.float32),
@@ -297,28 +314,45 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
 
 # ---------------------------------------------------------------- public entry
 @functools.lru_cache(maxsize=None)
-def _make_op(causal, scale, block_q, block_k, interpret):
+def _make_op_with_lse(causal, scale, block_q, block_k, interpret):
+    """Like _make_op but returns (o, lse) with gradients flowing through
+    BOTH (the ring-attention hop contract: downstream log-sum-exp merges
+    consume lse)."""
 
     @jax.custom_vjp
     def op(q3, k3, v3):
-        o, _ = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal, interpret=interpret)
-        return o
+        return _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal,
+                          interpret=interpret)
 
     def fwd(q3, k3, v3):
         o, lse = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
                             block_k=block_k, causal=causal,
                             interpret=interpret)
-        return o, (q3, k3, v3, o, lse)
+        return (o, lse), (q3, k3, v3, o, lse)
 
-    def bwd(res, do):
+    def bwd(res, cots):
+        do, dlse = cots
         q3, k3, v3, o, lse = res
         return _flash_bwd(q3, k3, v3, o, lse, do, scale=scale,
                           block_q=block_q, block_k=block_k, causal=causal,
-                          interpret=interpret)
+                          interpret=interpret, dlse=dlse)
 
     op.defvjp(fwd, bwd)
     return op
+
+
+def flash_attention_with_lse(q3, k3, v3, *, causal, scale, block,
+                             interpret=None):
+    """[bh, len, d] flash attention returning (o, lse [bh, len, 1]),
+    differentiable in both outputs (the lse cotangent folds into the
+    backward's delta term). The o-only public entry routes through the
+    same op — one factory, one numerics implementation."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    op = _make_op_with_lse(bool(causal), float(scale), int(block),
+                           int(block), bool(interpret))
+    return op(q3, k3, v3)
 
 
 def _pick_block(seq_len, target=512):
@@ -332,7 +366,8 @@ def _pick_block(seq_len, target=512):
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
-                    block_k=None, interpret=None, sparsity_config=None):
+                    block_k=None, interpret=None, sparsity_config=None,
+                    with_lse=False):
     """Flash attention on [batch, len, heads, head_dim] inputs.
 
     Drop-in for :func:`ops.attention.reference.mha_reference` (the oracle).
@@ -345,6 +380,7 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
     active blocks, so compute AND k/v traffic scale with layout density.
     """
     if sparsity_config is not None:
+        assert not with_lse, "with_lse is not supported on the sparse path"
         from deepspeed_tpu.ops.attention.block_sparse import (
             sparse_flash_attention)
         return sparse_flash_attention(q, k, v, sparsity_config,
@@ -362,7 +398,10 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    op = _make_op(bool(causal), scale, int(block_q), int(block_k),
-                  bool(interpret))
-    o3 = op(to3(q), to3(k), to3(v))
-    return o3.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)
+    op = _make_op_with_lse(bool(causal), scale, int(block_q), int(block_k),
+                           bool(interpret))
+    o3, lse3 = op(to3(q), to3(k), to3(v))
+    o = o3.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)
+    if with_lse:
+        return o, lse3.reshape(b, h, q_len)
+    return o

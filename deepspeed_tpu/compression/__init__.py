@@ -1,0 +1,8 @@
+"""Compression suite (reference ``deepspeed/compression/``: quantize-aware
+training, activation quantization, sparse/row/head pruning, driven by a
+step-scheduled config)."""
+
+from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
+    QuantizedLinear, activation_quant_ste, head_prune_mask, prune_mask,
+    row_prune_mask, weight_quant_ste)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler  # noqa: F401

@@ -1,0 +1,88 @@
+"""Compression primitives (reference ``compression/basic_layer.py`` —
+LinearLayer_Compress with weight/activation quantization and
+sparse/row/head pruning — and the CUDA fake_quantizer kernels).
+
+TPU form: straight-through-estimator (STE) fake quantization and pruning
+masks as pure jax ops; ``QuantizedLinear`` is a flax Dense drop-in used
+by quantize-aware training (the MoQ capability)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x, quantized):
+    """Straight-through estimator: forward quantized, gradient identity."""
+    return x + jax.lax.stop_gradient(quantized - x)
+
+
+def weight_quant_ste(w, bits=8, symmetric=True):
+    """Fake-quantize weights for QAT (reference fake_quantizer.cu)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(w)) / qmax
+        scale = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.round(w / scale) * scale
+    else:
+        lo, hi = jnp.min(w), jnp.max(w)
+        scale = jnp.where(hi > lo, (hi - lo) / (2.0 ** bits - 1), 1.0)
+        q = jnp.round((w - lo) / scale) * scale + lo
+    return _ste(w, q)
+
+
+def activation_quant_ste(x, bits=8, stat="dynamic"):
+    """Activation fake-quantization (per-tensor dynamic range)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(x)) / qmax
+    scale = jnp.where(scale > 0, scale, 1.0)
+    return _ste(x, jnp.round(x / scale) * scale)
+
+
+def prune_mask(w, ratio):
+    """Unstructured magnitude pruning mask keeping the top (1-ratio)
+    fraction (reference sparse_pruning_enabled)."""
+    k = max(int(w.size * (1.0 - ratio)), 1)
+    thresh = jnp.sort(jnp.abs(w).ravel())[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_prune_mask(w, ratio):
+    """Row-structured pruning by row l2 norm (reference row_pruning)."""
+    norms = jnp.linalg.norm(w, axis=1)
+    k = max(int(w.shape[0] * (1.0 - ratio)), 1)
+    thresh = jnp.sort(norms)[-k]
+    return (norms >= thresh).astype(w.dtype)[:, None]
+
+
+def head_prune_mask(w, ratio, num_heads):
+    """Attention-head pruning: rank heads by the norm of their slice of
+    the output projection (reference head_pruning on attn.dense)."""
+    head_dim = w.shape[0] // num_heads
+    norms = jnp.linalg.norm(w.reshape(num_heads, head_dim * w.shape[1]),
+                            axis=1)
+    k = max(int(num_heads * (1.0 - ratio)), 1)
+    thresh = jnp.sort(norms)[-k]
+    head_mask = (norms >= thresh).astype(w.dtype)
+    return jnp.repeat(head_mask, head_dim)[:, None]
+
+
+class QuantizedLinear(nn.Module):
+    """Dense with QAT weight (and optional activation) quantization
+    (reference LinearLayer_Compress)."""
+    features: int
+    weight_bits: int = 8
+    act_bits: int = 0          # 0 = no activation quantization
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features))
+        kernel = weight_quant_ste(kernel, self.weight_bits)
+        if self.act_bits:
+            x = activation_quant_ste(x, self.act_bits)
+        y = x @ kernel
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros_init(),
+                               (self.features,))
+        return y

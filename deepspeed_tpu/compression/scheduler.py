@@ -1,0 +1,60 @@
+"""Compression scheduling (reference ``compression/scheduler.py`` +
+``compression/config.py``: each compression method has an offset step and
+a periodic schedule; the scheduler answers "which methods are active at
+step t and at what strength")."""
+
+
+class CompressionScheduler:
+    """config: {"weight_quantization": {"enabled", "start_bits",
+    "target_bits", "quantize_period", "schedule_offset"},
+    "activation_quantization": {...}, "sparse_pruning": {"enabled",
+    "dense_ratio", "schedule_offset"}, ...}. Strengths anneal from the
+    start value to the target between offset and offset+period."""
+
+    def __init__(self, config):
+        self.config = dict(config or {})
+
+    def _section(self, name):
+        return dict(self.config.get(name, {}))
+
+    def weight_bits(self, step):
+        sc = self._section("weight_quantization")
+        if not sc.get("enabled"):
+            return None
+        start = int(sc.get("start_bits", 16))
+        target = int(sc.get("target_bits", 8))
+        offset = int(sc.get("schedule_offset", 0))
+        period = max(int(sc.get("quantize_period", 1)), 1)
+        if step < offset:
+            return None
+        # halve the bit width every period until target (reference MoQ)
+        bits = start
+        t = step - offset
+        while bits > target and t >= period:
+            bits = max(bits // 2, target)
+            t -= period
+        return bits
+
+    def activation_bits(self, step):
+        sc = self._section("activation_quantization")
+        if not sc.get("enabled") or step < int(sc.get("schedule_offset", 0)):
+            return None
+        return int(sc.get("bits", 8))
+
+    def sparse_ratio(self, step):
+        sc = self._section("sparse_pruning")
+        if not sc.get("enabled") or step < int(sc.get("schedule_offset", 0)):
+            return 0.0
+        return 1.0 - float(sc.get("dense_ratio", 1.0))
+
+    def row_ratio(self, step):
+        sc = self._section("row_pruning")
+        if not sc.get("enabled") or step < int(sc.get("schedule_offset", 0)):
+            return 0.0
+        return 1.0 - float(sc.get("dense_ratio", 1.0))
+
+    def head_ratio(self, step):
+        sc = self._section("head_pruning")
+        if not sc.get("enabled") or step < int(sc.get("schedule_offset", 0)):
+            return 0.0
+        return 1.0 - float(sc.get("dense_ratio", 1.0))

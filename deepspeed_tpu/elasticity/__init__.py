@@ -1,0 +1,15 @@
+"""Elastic training: batch-size math compatible with many device counts.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config``
+:233, candidate generation :27-125) and ``elasticity/config.py``. The math
+is framework-agnostic (SURVEY.md §5.3 "ports for free"): choose a global
+batch size — built from the allowed micro-batch sizes scaled by
+highly-composite multipliers — that is divisible across as many device
+counts as possible, so a preempted/regrown TPU slice can resume without
+changing the effective batch.
+"""
+
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    ElasticityConfig, ElasticityConfigError, ElasticityError,
+    ElasticityIncompatibleWorldSize, compute_elastic_config,
+    get_compatible_device_counts)

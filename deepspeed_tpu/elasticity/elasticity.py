@@ -1,0 +1,158 @@
+"""Elastic batch-size computation (reference elasticity.py:27-290)."""
+
+import math
+
+# Highly-composite numbers: scaling a base micro-batch by one of these
+# maximizes the number of divisors (= compatible device counts)
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+            45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200,
+            332640, 498960, 554400, 665280]
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Typed view of the config's "elasticity" section (reference
+    elasticity/config.py)."""
+
+    def __init__(self, param_dict):
+        self.enabled = bool(param_dict.get("enabled", False))
+        if not self.enabled:
+            return
+        if "max_train_batch_size" not in param_dict:
+            raise ElasticityConfigError(
+                "elasticity needs max_train_batch_size")
+        if "micro_batch_sizes" not in param_dict:
+            raise ElasticityConfigError("elasticity needs micro_batch_sizes")
+        self.max_acceptable_batch_size = int(
+            param_dict["max_train_batch_size"])
+        self.micro_batches = [int(m) for m in param_dict["micro_batch_sizes"]]
+        if any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive: {self.micro_batches}")
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", -1))
+        if self.min_gpus < 1 or (self.max_gpus != -1 and
+                                 self.max_gpus < self.min_gpus):
+            raise ElasticityConfigError(
+                f"bad device range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = int(param_dict.get("min_time", 0))
+        self.version = float(param_dict.get("version", 0.2))
+        self.prefer_larger_batch_size = bool(
+            param_dict.get("prefer_larger_batch", True))
+        self.ignore_non_elastic_batch_info = bool(
+            param_dict.get("ignore_non_elastic_batch_info", False))
+        self.model_parallel_size = int(
+            param_dict.get("model_parallel_size", 1))
+        self.num_gpus_per_node = int(param_dict.get("num_gpus_per_node", 1))
+
+
+def _candidate_batch_sizes(micro_batches, max_batch):
+    """Each micro-batch (and their lcm) scaled by the largest HCN that
+    keeps the product under max_batch."""
+    bases = sorted(set(micro_batches) | {math.lcm(*micro_batches)})
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+            continue
+        limit = max_batch // base
+        hcn = max(h for h in HCN_LIST if h <= limit)
+        out.add(hcn * base)
+    return sorted(out)
+
+
+def get_compatible_device_counts(batch_size, micro_batches, min_devs,
+                                 max_devs):
+    """All device counts n such that some micro-batch m gives
+    batch_size == m * gas * n for integer gas (reference get_valid_gpus)."""
+    valid = set()
+    for m in micro_batches:
+        if batch_size % m:
+            continue
+        slots = batch_size // m   # n * gas
+        for n in range(1, slots + 1):
+            if slots % n == 0 and min_devs <= n <= max_devs:
+                valid.add(n)
+    return sorted(valid)
+
+
+def _best_candidate(candidates, micro_batches, min_devs, max_devs,
+                    prefer_larger):
+    best = (len(micro_batches) and min(micro_batches)) or 1
+    best_valid = []
+    for bs in candidates:
+        valid = get_compatible_device_counts(bs, micro_batches, min_devs,
+                                             max_devs)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid) and
+            ((prefer_larger and bs > best) or
+             (not prefer_larger and bs < best)))
+        if better:
+            best, best_valid = bs, valid
+    return best, best_valid
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=None,
+                           world_size=0, return_microbatch=False):
+    """(final_batch_size, valid_device_counts[, micro_batch]) for the
+    config's elasticity section (reference compute_elastic_config :233).
+
+    With ``world_size`` given, also checks compatibility and computes the
+    per-device micro batch (largest allowed micro-batch whose
+    micro*gas*world == final_batch)."""
+    cfg = ds_config if isinstance(ds_config, ElasticityConfig) else \
+        ElasticityConfig(ds_config.get("elasticity", ds_config))
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity section not enabled")
+    max_devs = cfg.max_gpus if cfg.max_gpus != -1 else \
+        cfg.max_acceptable_batch_size // min(cfg.micro_batches)
+    if any(m > cfg.max_acceptable_batch_size for m in cfg.micro_batches):
+        raise ElasticityConfigError(
+            "every micro batch must be <= max_train_batch_size")
+
+    candidates = _candidate_batch_sizes(cfg.micro_batches,
+                                        cfg.max_acceptable_batch_size)
+    final_batch, valid = _best_candidate(
+        candidates, cfg.micro_batches, cfg.min_gpus, max_devs,
+        cfg.prefer_larger_batch_size)
+
+    # valid counts are DATA-PARALLEL replica counts: with model
+    # parallelism, the device world divides into world/mp replicas
+    # (reference v0.2 semantics)
+    dp_size = world_size
+    if world_size > 0 and cfg.model_parallel_size > 1:
+        if world_size % cfg.model_parallel_size:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not divisible by "
+                f"model_parallel_size {cfg.model_parallel_size}")
+        dp_size = world_size // cfg.model_parallel_size
+    if world_size > 0 and dp_size not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} (data-parallel {dp_size}) is not "
+            f"compatible with batch {final_batch} (valid counts: {valid})")
+
+    if not return_microbatch:
+        return final_batch, valid
+    assert world_size > 0, "return_microbatch needs world_size"
+    micro = None
+    for m in sorted(cfg.micro_batches,
+                    reverse=cfg.prefer_larger_batch_size):
+        if final_batch % (m * dp_size) == 0:
+            micro = m
+            break
+    return final_batch, valid, micro

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.comm.compressed import (  # noqa: F401
+    compressed_allreduce, onebit_quantize)

@@ -1,0 +1,8 @@
+"""Data-efficiency pipeline (reference ``deepspeed/runtime/data_pipeline/``:
+curriculum learning on sequence length + random layerwise token dropping).
+"""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (  # noqa: F401
+    RandomLTDScheduler, random_ltd_gather, random_ltd_scatter)

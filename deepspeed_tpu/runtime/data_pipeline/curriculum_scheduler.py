@@ -1,0 +1,77 @@
+"""Curriculum difficulty scheduler (reference
+``runtime/data_pipeline/curriculum_scheduler.py`` — fixed_linear /
+fixed_root / fixed_discrete / custom schedules of e.g. sequence length,
+consumed by the legacy engine hook ``curriculum_seqlen``
+(engine.py:1692-1696)). Pure host-side math."""
+
+import math
+
+
+class CurriculumScheduler:
+    """config: {"curriculum_type": "seqlen", "min_difficulty": M,
+    "max_difficulty": N, "schedule_type": "fixed_linear" | "fixed_root" |
+    "fixed_discrete" | "custom", "schedule_config": {...}}"""
+
+    def __init__(self, config):
+        self.config = dict(config)
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert key in self.config, f"curriculum config needs '{key}'"
+        self.min_difficulty = int(self.config["min_difficulty"])
+        self.max_difficulty = int(self.config["max_difficulty"])
+        self.schedule_type = self.config["schedule_type"]
+        sc = dict(self.config.get("schedule_config", {}))
+        self.schedule = sc
+        self.custom_get_difficulty = None
+        if self.schedule_type == "fixed_linear":
+            assert "total_curriculum_step" in sc and "difficulty_step" in sc
+        elif self.schedule_type == "fixed_root":
+            assert "total_curriculum_step" in sc and "difficulty_step" in sc \
+                and "root_degree" in sc
+        elif self.schedule_type == "fixed_discrete":
+            assert "difficulty" in sc and "max_step" in sc
+            assert len(sc["difficulty"]) == len(sc["max_step"]) + 1
+        elif self.schedule_type == "custom":
+            pass
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type}")
+        self.state = {"current_difficulty": self.min_difficulty}
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_get_difficulty = fn
+
+    def _root(self, step, degree):
+        sc = self.schedule
+        frac = min(1.0, step / sc["total_curriculum_step"]) ** (1.0 / degree)
+        d = self.min_difficulty + frac * (self.max_difficulty -
+                                          self.min_difficulty)
+        # round UP to the difficulty_step grid, capped at max
+        q = sc["difficulty_step"]
+        return int(min(self.max_difficulty, math.ceil(d / q) * q))
+
+    def get_difficulty(self, global_steps):
+        if self.schedule_type == "fixed_linear":
+            return self._root(global_steps, 1)
+        if self.schedule_type == "fixed_root":
+            return self._root(global_steps, self.schedule["root_degree"])
+        if self.schedule_type == "fixed_discrete":
+            sc = self.schedule
+            for difficulty, max_step in zip(sc["difficulty"], sc["max_step"]):
+                if global_steps <= max_step:
+                    return difficulty
+            return sc["difficulty"][-1]
+        assert self.custom_get_difficulty is not None, \
+            "custom schedule needs set_custom_get_difficulty"
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps):
+        self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]
+
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, sd):
+        self.state.update(sd)

@@ -1,0 +1,59 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py:14``
+(RandomLayerTokenDrop wraps each transformer layer: a random subset of
+tokens passes through the layer, the rest skip it) with CUDA
+token_sort/gather kernels (``csrc/random_ltd/``). On TPU the
+gather/scatter is ``jnp.take``/``.at[].set`` — XLA emits efficient
+dynamic-gather; no custom kernel needed (SURVEY §2.4 random-LTD row).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_indices(rng, seq_len, keep, batch):
+    """[batch, keep] sorted indices of the tokens that pass through the
+    layer (reference token_sort_: random selection, order-preserving)."""
+    scores = jax.random.uniform(rng, (batch, seq_len))
+    _, idx = jax.lax.top_k(scores, keep)
+    return jnp.sort(idx, axis=1)
+
+
+def random_ltd_gather(x, indices):
+    """[b, l, d] -> [b, keep, d] (reference gather_tokens)."""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def random_ltd_scatter(sub, indices, full):
+    """Scatter layer outputs back into the full sequence: dropped tokens
+    keep their pre-layer values (reference scatter_tokens)."""
+    b = jnp.arange(full.shape[0])[:, None]
+    return full.at[b, indices].set(sub)
+
+
+class RandomLTDScheduler:
+    """Linear schedule of the kept-token count (reference
+    data_routing/scheduler.py): from ``start_ratio*seq`` up to the full
+    sequence over ``schedule_steps``."""
+
+    def __init__(self, seq_len, start_tokens=None, schedule_steps=1000,
+                 step_size=16):
+        self.seq_len = seq_len
+        self.start = start_tokens or max(seq_len // 4, step_size)
+        self.steps = schedule_steps
+        self.step_size = step_size
+        self.current = self.start
+
+    def keep_tokens(self, global_step):
+        frac = min(1.0, global_step / self.steps)
+        raw = self.start + frac * (self.seq_len - self.start)
+        kept = int(raw // self.step_size * self.step_size)
+        self.current = max(self.start, min(self.seq_len, kept))
+        return self.current
+
+    def state_dict(self):
+        return {"current": self.current}
+
+    def load_state_dict(self, sd):
+        self.current = sd["current"]

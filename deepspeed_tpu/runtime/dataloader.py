@@ -70,6 +70,77 @@ class DeepSpeedDataLoader:
             yield from iter(ds)
 
 
+class DistributedSampler:
+    """Per-process index shard (reference torch DistributedSampler used by
+    ``deepspeed_io``, engine.py:1561): on multi-host JAX each process
+    feeds only its addressable slice of the global batch, so the sampler
+    partitions the dataset by (num_replicas, rank) with per-epoch
+    shuffling and padding to equal length."""
+
+    def __init__(self, dataset_len, num_replicas=None, rank=None,
+                 shuffle=True, seed=0, drop_last=False):
+        import jax
+        self.n = int(dataset_len)
+        self.num_replicas = num_replicas if num_replicas is not None \
+            else jax.process_count()
+        self.rank = rank if rank is not None else jax.process_index()
+        assert 0 <= self.rank < self.num_replicas
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.num_replicas
+        return (self.n + self.num_replicas - 1) // self.num_replicas
+
+    def __iter__(self):
+        idx = np.arange(self.n)
+        if self.shuffle:
+            idx = np.random.default_rng(
+                self.seed + self.epoch).permutation(self.n)
+        if self.drop_last:
+            idx = idx[:len(self) * self.num_replicas]
+        else:  # pad by wrapping (possibly several times: tiny datasets
+            # with many replicas) so every replica sees equal length
+            target = len(self) * self.num_replicas
+            if target > self.n:
+                reps = -(-target // self.n)
+                idx = np.tile(idx, reps)[:target]
+        return iter(idx[self.rank::self.num_replicas].tolist())
+
+
+class CurriculumDataLoader:
+    """Wraps a loader, truncating token batches to the curriculum
+    scheduler's current difficulty (reference DeepSpeedDataSampler /
+    legacy ``curriculum_seqlen`` engine hook, engine.py:1692-1696)."""
+
+    def __init__(self, loader, scheduler, step_fn=None,
+                 keys=("input_ids", "labels", "attention_mask")):
+        self.loader = loader
+        self.scheduler = scheduler
+        self.step_fn = step_fn or (lambda: self._step)
+        self.keys = keys
+        self._step = 0
+
+    def __iter__(self):
+        for batch in self.loader:
+            seqlen = self.scheduler.update_difficulty(int(self.step_fn()))
+            if isinstance(batch, dict):
+                batch = {k: (v[:, :seqlen]
+                             if k in self.keys and np.ndim(v) >= 2 else v)
+                         for k, v in batch.items()}
+            self._step += 1
+            yield batch
+
+    def __len__(self):
+        return len(self.loader)
+
+
 class RepeatingLoader:
     """Wraps an iterator to restart on StopIteration (reference
     ``runtime/dataloader.py`` namesake, used by pipeline tests)."""

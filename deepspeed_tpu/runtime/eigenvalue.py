@@ -1,0 +1,53 @@
+"""Hessian max-eigenvalue estimation by power iteration (reference
+``runtime/eigenvalue.py`` — used to schedule MoQ quantization by layer
+curvature). The torch version power-iterates with autograd v-products;
+jax makes the Hessian-vector product a one-liner (jvp over grad), so the
+whole estimator jits."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose=False, max_iter=100, tol=1e-2,
+                 stability=1e-6, gas_boundary_resolution=1):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.verbose = verbose
+        self.gas_boundary_resolution = gas_boundary_resolution
+
+    def compute_eigenvalue(self, loss_fn, params, rng=None):
+        """Largest |eigenvalue| of d2 loss / d params2 by power iteration.
+        loss_fn: params -> scalar. Returns (eigenvalue, eigenvector)."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        def norm(t):
+            return jnp.sqrt(sum(jnp.vdot(l, l)
+                                for l in jax.tree.leaves(t))).real
+
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree_util.tree_unflatten(
+            treedef, [jax.random.normal(k, l.shape, jnp.float32)
+                      for k, l in zip(keys, leaves)])
+        n = norm(v)
+        v = jax.tree.map(lambda x: x / (n + self.stability), v)
+
+        eig = jnp.float32(0.0)
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            new_eig = sum(jnp.vdot(a, b).real for a, b in zip(
+                jax.tree.leaves(v), jax.tree.leaves(hv)))
+            hn = norm(hv)
+            v = jax.tree.map(lambda x: x / (hn + self.stability), hv)
+            if abs(float(new_eig) - float(eig)) < self.tol * max(
+                    abs(float(new_eig)), 1e-12):
+                eig = new_eig
+                break
+            eig = new_eig
+        return float(eig), v

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam  # noqa: F401
+from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb  # noqa: F401

@@ -1,0 +1,85 @@
+"""1-bit Adam (reference ``runtime/fp16/onebit/adam.py:13``).
+
+Algorithm (Tang et al.): a warmup phase runs plain Adam while the
+variance estimate stabilizes; after ``freeze_step`` the variance is
+FROZEN and only the momentum is communicated — compressed to one bit per
+element with error feedback. Here as an optax transformation:
+
+  * warmup (step < freeze_step): standard Adam m/v updates;
+  * post-warmup: ``m = b1*m + (1-b1)*g``; the update uses the 1-bit
+    quantized momentum (sign * l2-preserving scale) with the
+    quantization residual carried in an error buffer; ``v`` stays
+    frozen (the reference's compressed momentum exchange).
+
+On TPU meshes the gradient all-reduce is emitted by XLA from shardings,
+so the quantization here provides the *algorithm* (frozen variance +
+error-compensated 1-bit momentum); the explicit compressed collective
+for DCN-scale bandwidth savings is ``runtime/comm/compressed.py``.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.runtime.comm.compressed import onebit_quantize
+
+
+class OnebitAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+    error: optax.Updates
+
+
+def onebit_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, freeze_step=100):
+    """optax transformation implementing 1-bit Adam."""
+
+    def init(params):
+        z = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32),
+                                 params)
+        return OnebitAdamState(count=jnp.zeros((), jnp.int32),
+                               mu=z(), nu=z(), error=z())
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        frozen = count > freeze_step
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, grads)
+        # warmup keeps updating v; post-freeze keeps the old v
+        nu = jax.tree.map(
+            lambda v, g: jnp.where(
+                frozen, v, b2 * v + (1 - b2) *
+                jnp.square(g.astype(jnp.float32))),
+            state.nu, grads)
+
+        # two passes (not one tree of pairs: tuple-containing param
+        # pytrees would make pair-vs-container ambiguous)
+        def q_value(m, e):
+            signs, scale, _ = onebit_quantize(m, e)
+            return jnp.where(frozen, jnp.where(signs, scale, -scale), m)
+
+        def q_error(m, e):
+            _, _, new_e = onebit_quantize(m, e)
+            return jnp.where(frozen, new_e, e)
+
+        m_used = jax.tree.map(q_value, mu, state.error)
+        error = jax.tree.map(q_error, mu, state.error)
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** jnp.minimum(count,
+                                    freeze_step).astype(jnp.float32)
+        def step(m, v, p):
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return (-learning_rate * upd).astype(p.dtype)
+
+        updates = jax.tree.map(step, m_used, nu,
+                               params if params is not None else mu)
+        return updates, OnebitAdamState(count, mu, nu, error)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,42 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py:14``): the 1-bit
+Adam scheme with LAMB's layerwise trust-ratio scaling of the update."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
+
+
+class OnebitLambState(NamedTuple):
+    inner: object
+
+
+def onebit_lamb(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, freeze_step=100, max_coeff=10.0,
+                min_coeff=0.01):
+    inner = onebit_adam(1.0, b1=b1, b2=b2, eps=eps,
+                        weight_decay=weight_decay, freeze_step=freeze_step)
+
+    def init(params):
+        return OnebitLambState(inner=inner.init(params))
+
+    def update(grads, state, params=None):
+        raw, inner_state = inner.update(grads, state.inner, params)
+
+        def trust(u, p):
+            pn = jnp.linalg.norm(p.astype(jnp.float32).ravel())
+            un = jnp.linalg.norm(u.astype(jnp.float32).ravel())
+            ratio = jnp.where(un > 0, pn / jnp.maximum(un, 1e-12), 1.0)
+            ratio = jnp.clip(jnp.where(pn > 0, ratio, 1.0),
+                             min_coeff, max_coeff)
+            return (learning_rate * ratio * u.astype(jnp.float32)) \
+                .astype(p.dtype)
+
+        updates = jax.tree.map(trust, raw,
+                               params if params is not None else raw)
+        return updates, OnebitLambState(inner=inner_state)
+
+    return optax.GradientTransformation(init, update)

@@ -43,24 +43,29 @@ def build_optimizer(name, params=None, gradient_clipping=0.0):
     adam_w_mode = params.pop("adam_w_mode", True)
     momentum = params.pop("momentum", 0.0)
     bias_correction = params.pop("bias_correction", True)
+    freeze_step = params.pop("freeze_step", 100)
     params.pop("torch_adam", None)
     for k in list(params):
         logger.warning(f"Optimizer param '{k}' ignored on TPU backend")
 
     def make(learning_rate):
         lr_ = learning_rate
-        if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.ONEBIT_ADAM_OPTIMIZER,
-                    C.ZERO_ONE_ADAM_OPTIMIZER):
-            if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
-                logger.warning(f"{name}: using uncompressed Adam update "
-                               "(1-bit compression not applied)")
+        if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+            from deepspeed_tpu.runtime.fp16.onebit import onebit_adam
+            return onebit_adam(lr_, b1=betas[0], b2=betas[1], eps=eps,
+                               weight_decay=weight_decay,
+                               freeze_step=freeze_step)
+        if name == C.ONEBIT_LAMB_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit import onebit_lamb
+            return onebit_lamb(lr_, b1=betas[0], b2=betas[1], eps=eps,
+                               weight_decay=weight_decay,
+                               freeze_step=freeze_step)
+        if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
             if name == C.ADAM_OPTIMIZER and not adam_w_mode:
                 return optax.adam(lr_, b1=betas[0], b2=betas[1], eps=eps)
             return optax.adamw(lr_, b1=betas[0], b2=betas[1], eps=eps,
                                weight_decay=weight_decay)
-        if name in (C.LAMB_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
-            if name == C.ONEBIT_LAMB_OPTIMIZER:
-                logger.warning("onebitlamb: using uncompressed LAMB update")
+        if name == C.LAMB_OPTIMIZER:
             return optax.lamb(lr_, b1=betas[0], b2=betas[1], eps=eps,
                               weight_decay=weight_decay)
         if name == C.SGD_OPTIMIZER:

@@ -1,0 +1,24 @@
+"""Progressive layer drop (reference
+``runtime/progressive_layer_drop.py:40``): per-step keep probability
+theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar, consumed by
+stochastic-depth transformer blocks."""
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}

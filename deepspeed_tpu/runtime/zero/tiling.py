@@ -1,0 +1,52 @@
+"""Tiled linear layers (reference ``runtime/zero/tiling.py`` —
+``TiledLinear`` splits a large linear into a grid of smaller linears so
+ZeRO-3 can gather/release one tile at a time instead of the whole
+matrix).
+
+TPU form: a flax module computing the same function as Dense through an
+[in_splits x out_splits] grid of tile kernels. Each tile is its own
+param, so fsdp sharding (and any future per-tile gather policy) applies
+tile-by-tile; output is mathematically identical to the monolithic
+Dense with the concatenated kernel."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def _splits(total, parts):
+    base = total // parts
+    rem = total % parts
+    sizes = [base + (1 if i < rem else 0) for i in range(parts)]
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    return sizes, bounds
+
+
+class TiledLinear(nn.Module):
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        in_sizes, in_bounds = _splits(in_dim, self.in_splits)
+        out_sizes, out_bounds = _splits(self.features, self.out_splits)
+
+        outs = []
+        for j, out_n in enumerate(out_sizes):
+            acc = None
+            for i, in_n in enumerate(in_sizes):
+                kernel = self.param(
+                    f"tile_{i}_{j}", nn.initializers.lecun_normal(),
+                    (in_n, out_n))
+                xi = x[..., in_bounds[i]:in_bounds[i + 1]]
+                part = xi @ kernel
+                acc = part if acc is None else acc + part
+            outs.append(acc)
+        y = jnp.concatenate(outs, axis=-1)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros_init(),
+                               (self.features,))
+        return y

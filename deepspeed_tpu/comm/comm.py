@@ -75,14 +75,25 @@ def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
     global _INITIALIZED, _WORLD_MESH
     coord = os.environ.get("COORDINATOR_ADDRESS")
     nproc = int(os.environ.get("NUM_PROCESSES", "1"))
-    if coord and nproc > 1 and jax.process_count() == 1:
+    if coord and nproc > 1:
+        # NOTE: must run before anything touches the backend —
+        # jax.process_count()/jax.devices() would instantiate a
+        # single-process backend and make the rendezvous impossible
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=nproc,
                 process_id=int(os.environ.get("PROCESS_ID", "0")))
-        except Exception as e:  # already initialized or single-host
+        except RuntimeError as e:
+            # idempotent re-init is fine; anything else must NOT degrade
+            # to a silent world-of-1 (N independent copies of the job)
+            if "already" not in str(e).lower():
+                raise
             logger.warning(f"jax.distributed.initialize skipped: {e}")
+        if jax.process_count() != nproc:
+            raise RuntimeError(
+                f"distributed rendezvous failed: NUM_PROCESSES={nproc} but "
+                f"jax.process_count()={jax.process_count()}")
     if mesh is not None:
         _WORLD_MESH = mesh
     elif _WORLD_MESH is None:

@@ -43,10 +43,17 @@ class GPTConfig:
     tie_embeddings: bool = True
     layer_norm_eps: float = 1e-5       # HF GPT-2/OPT/BLOOM value
     activation: str = "gelu"           # "gelu" (GPT-2/BLOOM) | "relu" (OPT)
-    pos_embed: str = "learned"         # "learned" | "none" (ALiBi models)
+    pos_embed: str = "learned"         # "learned" | "none" (rotary/ALiBi)
     pos_offset: int = 0                # OPT stores positions at index+2
     embed_layernorm: bool = False      # BLOOM word_embeddings_layernorm
     use_alibi: bool = False            # BLOOM attention bias
+    rotary_dim: int = 0                # >0: rotary on first dims (GPT-J/NeoX)
+    rotary_interleaved: bool = False   # GPT-J rotate-every-two convention
+    rope_base: float = 10000.0
+    parallel_residual: bool = False    # x + attn(ln1 x) + mlp(...) (J/NeoX)
+    single_ln: bool = False            # GPT-J: mlp reads ln_1's output
+    attn_bias: Optional[bool] = None   # GPT-J: no attn biases; default use_bias
+    lm_head_bias: bool = False         # GPT-J lm_head carries a bias
     # MoE (reference deepspeed/moe): every `moe_every`-th block swaps its MLP
     # for a sharded MoE layer
     moe_num_experts: int = 0
@@ -95,11 +102,24 @@ class SelfAttention(nn.Module):
     def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
         b, l, _ = x.shape
-        qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv")(x)
+        attn_bias = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
+        qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv",
+                     use_bias=attn_bias)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        if cfg.rotary_dim:
+            from deepspeed_tpu.ops.attention.reference import (
+                apply_partial_rotary)
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(l)[None], (b, l))
+            q = apply_partial_rotary(q, positions, cfg.rotary_dim,
+                                     base=cfg.rope_base,
+                                     interleaved=cfg.rotary_interleaved)
+            k = apply_partial_rotary(k, positions, cfg.rotary_dim,
+                                     base=cfg.rope_base,
+                                     interleaved=cfg.rotary_interleaved)
 
         new_cache = None
         if cache is not None:
@@ -150,7 +170,8 @@ class SelfAttention(nn.Module):
             else:
                 out = mha_reference(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.hidden_size)
-        out = _dense(cfg.hidden_size, cfg, ("heads", "embed"), name="proj")(out)
+        out = _dense(cfg.hidden_size, cfg, ("heads", "embed"), name="proj",
+                     use_bias=attn_bias)(out)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
         return out, new_cache
@@ -179,10 +200,18 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           name="ln_1")(x)
         attn_out, new_cache = SelfAttention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         name="ln_1")(x),
-            deterministic, cache, positions)
+            ln1, deterministic, cache, positions)
+        if cfg.parallel_residual:
+            # GPT-J / GPT-NeoX: attn and mlp branch from the same input;
+            # GPT-J (single_ln) feeds the mlp ln_1's output directly
+            h = ln1 if cfg.single_ln else nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_2")(x)
+            assert not self.use_moe, "parallel residual + MoE unsupported"
+            mlp_out = MLP(cfg, name="mlp")(h, deterministic)
+            return x + attn_out + mlp_out, new_cache
         x = x + attn_out
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_2")(x)
@@ -240,7 +269,7 @@ def _head_logits(x, cfg, *, wte_v=None, dense_ctor=None):
         assert wte_v is not None, "tied head needs the embedding table"
         return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype))
     return dense_ctor(cfg.vocab_size, cfg, ("embed", "vocab"),
-                      name="lm_head", use_bias=False)(x)
+                      name="lm_head", use_bias=cfg.lm_head_bias)(x)
 
 
 class GPT2(nn.Module):

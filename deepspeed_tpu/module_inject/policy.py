@@ -219,6 +219,125 @@ class BloomPolicy(InjectionPolicy):
         return p
 
 
+class GPTJPolicy(InjectionPolicy):
+    """HF GPTJForCausalLM (reference containers/gptj.py: HFGPTJLayerPolicy).
+    Interleaved partial rotary, parallel residual with a single layernorm,
+    bias-free attention projections, untied lm_head WITH a bias."""
+
+    model_type = "gptj"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        n_inner = getattr(c, "n_inner", None) or 4 * c.n_embd
+        if n_inner % c.n_embd:
+            raise ValueError(f"GPT-J n_inner {n_inner} must be a multiple "
+                             f"of n_embd {c.n_embd}")
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.n_embd,
+            num_layers=c.n_layer, num_heads=c.n_head,
+            max_seq_len=c.n_positions,
+            mlp_ratio=n_inner // c.n_embd,
+            layer_norm_eps=c.layer_norm_epsilon,
+            activation="gelu",            # gelu_new
+            pos_embed="none", rotary_dim=c.rotary_dim,
+            rotary_interleaved=True, parallel_residual=True, single_ln=True,
+            attn_bias=False, tie_embeddings=False, lm_head_bias=True,
+            dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        p = {"wte": _np(sd["transformer.wte.weight"]),
+             "ln_f": {"scale": _np(sd["transformer.ln_f.weight"]),
+                      "bias": _np(sd["transformer.ln_f.bias"])},
+             "lm_head": {"kernel": _t(sd["lm_head.weight"]),
+                         "bias": _np(sd["lm_head.bias"])}}
+        for i in range(hf_config.n_layer):
+            h = f"transformer.h.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[h + f"attn.{n}_proj.weight"])
+                 for n in ("q", "k", "v")], axis=1)
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "ln_1.weight"]),
+                         "bias": _np(sd[h + "ln_1.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w},
+                    "proj": {"kernel": _t(sd[h + "attn.out_proj.weight"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _t(sd[h + "mlp.fc_in.weight"]),
+                              "bias": _np(sd[h + "mlp.fc_in.bias"])},
+                    "fc_out": {"kernel": _t(sd[h + "mlp.fc_out.weight"]),
+                               "bias": _np(sd[h + "mlp.fc_out.bias"])}},
+            }
+        return p
+
+
+class GPTNeoXPolicy(InjectionPolicy):
+    """HF GPTNeoXForCausalLM (reference containers/gptneox.py). Partial
+    rotate-half rotary (rotary_pct), parallel residual with two
+    layernorms, head-interleaved fused qkv (BLOOM layout), untied
+    embed_out."""
+
+    model_type = "gpt_neox"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        head_dim = c.hidden_size // c.num_attention_heads
+        assert c.intermediate_size % c.hidden_size == 0
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            mlp_ratio=c.intermediate_size // c.hidden_size,
+            layer_norm_eps=c.layer_norm_eps,
+            # HF NeoX hidden_act "gelu" is the exact erf gelu
+            activation="gelu_exact" if c.hidden_act == "gelu" else "gelu",
+            pos_embed="none",
+            rotary_dim=int(head_dim * c.rotary_pct),
+            rope_base=getattr(c, "rotary_emb_base", 10000.0),
+            parallel_residual=bool(getattr(c, "use_parallel_residual",
+                                           True)),
+            tie_embeddings=bool(getattr(c, "tie_word_embeddings", False)),
+            dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        p = {"wte": _np(sd["gpt_neox.embed_in.weight"]),
+             "ln_f": {"scale": _np(sd["gpt_neox.final_layer_norm.weight"]),
+                      "bias": _np(sd["gpt_neox.final_layer_norm.bias"])}}
+        if not getattr(hf_config, "tie_word_embeddings", False):
+            p["lm_head"] = {"kernel": _t(sd["embed_out.weight"])}
+        for i in range(hf_config.num_hidden_layers):
+            h = f"gpt_neox.layers.{i}."
+            qkv_w, qkv_b = BloomPolicy._split_qkv(
+                _np(sd[h + "attention.query_key_value.weight"]),
+                _np(sd[h + "attention.query_key_value.bias"]),
+                hf_config.num_attention_heads)
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "input_layernorm.weight"]),
+                         "bias": _np(sd[h + "input_layernorm.bias"])},
+                "ln_2": {
+                    "scale": _np(sd[h + "post_attention_layernorm.weight"]),
+                    "bias": _np(sd[h + "post_attention_layernorm.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {"kernel": _t(sd[h + "attention.dense.weight"]),
+                             "bias": _np(sd[h + "attention.dense.bias"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _t(sd[h + "mlp.dense_h_to_4h.weight"]),
+                              "bias": _np(sd[h + "mlp.dense_h_to_4h.bias"])},
+                    "fc_out": {"kernel": _t(sd[h + "mlp.dense_4h_to_h.weight"]),
+                               "bias": _np(sd[h + "mlp.dense_4h_to_h.bias"])}},
+            }
+        return p
+
+
 class LlamaPolicy(InjectionPolicy):
     """HF LlamaForCausalLM (the reference gained containers/llama.py in
     later snapshots; built natively here). Rotary convention (rotate-half,

@@ -2,10 +2,12 @@
 ``replace_policies``/``generic_policies`` lists)."""
 
 from deepspeed_tpu.module_inject.policy import (BertPolicy, BloomPolicy,
-                                                GPT2Policy, LlamaPolicy,
+                                                GPT2Policy, GPTJPolicy,
+                                                GPTNeoXPolicy, LlamaPolicy,
                                                 OPTPolicy)
 
-POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, LlamaPolicy, BertPolicy]
+POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, GPTJPolicy, GPTNeoXPolicy,
+            LlamaPolicy, BertPolicy]
 
 
 def policy_for(hf_config):

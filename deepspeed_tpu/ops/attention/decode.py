@@ -34,8 +34,13 @@ from deepspeed_tpu.ops.attention.flash import NEG_INF, _pick_block
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, nk):
-    ki = pl.program_id(2)
+                   m_scr, l_scr, acc_scr, *, scale, nk, kv_h, grp):
+    """One grid step: ALL heads against one kv block. Blocks span the
+    full head dimensions (equal-to-array, so any head count satisfies
+    the TPU (8,128) tiling rule — per-head blocks of a small GQA group
+    do not)."""
+    ki = pl.program_id(1)
+    h = kv_h * grp
 
     @pl.when(ki == 0)
     def _init():
@@ -43,18 +48,20 @@ def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    grp = q_ref.shape[2]
-    q = q_ref[0, 0, :, :]                      # [grp, d]
-    k = k_ref[0, :, 0, :]                      # [bk, d]
-    v = v_ref[0, :, 0, :]                      # [bk, d]
+    d = q_ref.shape[3]
+    bk = k_ref.shape[1]
+    q = q_ref[0, 0, :, :].reshape(kv_h, grp, d)           # [kv_h, grp, d]
+    k = k_ref[0].transpose(1, 0, 2)                       # [kv_h, bk, d]
+    v = v_ref[0].transpose(1, 0, 2)                       # [kv_h, bk, d]
+    # batched over kv heads: q groups hit their own head's cache
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale      # [grp, bk]
-    s = s + bias_ref[0, :, 0, :]
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale       # [kv_h, grp, bk]
+    s = s.reshape(h, bk) + bias_ref[0, :, 0, :]
     s = jnp.maximum(s, NEG_INF)  # keep masked slots finite (see flash.py)
 
-    m_prev = m_scr[:grp, :1]
-    l_prev = l_scr[:grp, :1]
+    m_prev = m_scr[:h, :1]
+    l_prev = l_scr[:h, :1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     row_live = m_new > NEG_INF / 2
@@ -62,17 +69,18 @@ def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
     p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
     l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
     pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [grp, d]
-    acc_scr[:grp] = acc_scr[:grp] * alpha + pv
-    m_scr[:grp] = jnp.broadcast_to(m_new, (grp, m_scr.shape[1]))
-    l_scr[:grp] = jnp.broadcast_to(l_new, (grp, l_scr.shape[1]))
+        p.reshape(kv_h, grp, bk).astype(v.dtype), v,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).reshape(h, d)
+    acc_scr[:h] = acc_scr[:h] * alpha + pv
+    m_scr[:h] = jnp.broadcast_to(m_new, (h, m_scr.shape[1]))
+    l_scr[:h] = jnp.broadcast_to(l_new, (h, l_scr.shape[1]))
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_scr[:grp, :1]
+        l = l_scr[:h, :1]
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0, :, :] = (acc_scr[:grp] / l).astype(o_ref.dtype)
+        o_ref[0, 0, :, :] = (acc_scr[:h] / l).astype(o_ref.dtype)
 
 
 def _decode_pallas(q, k_cache, v_cache, bias, *, scale, block_k, interpret):
@@ -80,19 +88,20 @@ def _decode_pallas(q, k_cache, v_cache, bias, *, scale, block_k, interpret):
     max_len, kv_h = k_cache.shape[1], k_cache.shape[2]
     grp = h // kv_h
     nk = max_len // block_k
-    scr_rows = max(grp, 8)   # TPU sublane tile
+    scr_rows = max(h, 8)   # TPU sublane tile
 
-    kernel = functools.partial(_decode_kernel, scale=scale, nk=nk)
+    kernel = functools.partial(_decode_kernel, scale=scale, nk=nk,
+                               kv_h=kv_h, grp=grp)
     out = pl.pallas_call(
         kernel,
-        grid=(b, kv_h, nk),
+        grid=(b, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, grp, d), lambda ib, ih, j: (ib, 0, ih, 0)),
-            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, j: (ib, j, ih, 0)),
-            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, j: (ib, j, ih, 0)),
-            pl.BlockSpec((1, grp, 1, block_k), lambda ib, ih, j: (ib, ih, 0, j)),
+            pl.BlockSpec((1, 1, h, d), lambda ib, j: (ib, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, kv_h, d), lambda ib, j: (ib, j, 0, 0)),
+            pl.BlockSpec((1, block_k, kv_h, d), lambda ib, j: (ib, j, 0, 0)),
+            pl.BlockSpec((1, h, 1, block_k), lambda ib, j: (ib, 0, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, 1, grp, d), lambda ib, ih, j: (ib, 0, ih, 0)),
+        out_specs=pl.BlockSpec((1, 1, h, d), lambda ib, j: (ib, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
         scratch_shapes=[
             pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, 128), jnp.float32),

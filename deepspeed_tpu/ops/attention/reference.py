@@ -66,8 +66,9 @@ def decode_attention_reference(q, k_cache, v_cache, cache_len, *, scale=None):
 
 
 def apply_rotary_emb(x, positions, *, base=10000.0):
-    """Rotary position embeddings, [batch, len, heads, dim] layout
-    (reference kernel: csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu)."""
+    """Rotary position embeddings, [batch, len, heads, dim] layout,
+    rotate-half convention (Llama/GPT-NeoX; reference kernel:
+    csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu)."""
     d = x.shape[-1]
     half = d // 2
     freq = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
@@ -77,3 +78,29 @@ def apply_rotary_emb(x, positions, *, base=10000.0):
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return rotated.astype(x.dtype)
+
+
+def apply_rotary_emb_interleaved(x, positions, *, base=10000.0):
+    """GPT-J's rotate-every-two convention: pairs are (x[2i], x[2i+1])
+    instead of (x[i], x[i+half])."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freq
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def apply_partial_rotary(x, positions, rotary_dim, *, base=10000.0,
+                         interleaved=False):
+    """Rotary on the first `rotary_dim` features only (GPT-J rotary_dim,
+    GPT-NeoX rotary_pct); the rest pass through."""
+    rot = x[..., :rotary_dim]
+    rest = x[..., rotary_dim:]
+    fn = apply_rotary_emb_interleaved if interleaved else apply_rotary_emb
+    return jnp.concatenate([fn(rot, positions, base=base), rest], axis=-1)

@@ -1,0 +1,62 @@
+"""Memory diagnostics (reference ``runtime/utils.py:770``
+``see_memory_usage`` / ``:721`` ``memory_status`` — CUDA
+allocated/reserved prints). TPU form: per-device HBM stats from the
+runtime's ``memory_stats()`` plus host RSS."""
+
+import os
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _gb(n):
+    return f"{n / (1024 ** 3):.2f} GB"
+
+
+def device_memory_stats(device=None):
+    """{bytes_in_use, peak_bytes_in_use, bytes_limit} for one device
+    (zeros when the backend reports nothing, e.g. CPU)."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }
+
+
+def host_memory_rss():
+    """Resident set size of this process in bytes (no psutil needed)."""
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def see_memory_usage(message, force=False, ranks=(0,)):
+    """Log HBM + host memory (reference see_memory_usage contract: called
+    at phase boundaries, gated by a force flag)."""
+    if not force:
+        return
+    if jax.process_index() not in ranks:
+        return
+    parts = [message]
+    for i, dev in enumerate(jax.local_devices()):
+        s = device_memory_stats(dev)
+        if s["bytes_limit"]:
+            parts.append(
+                f"dev{i}: {_gb(s['bytes_in_use'])} in use "
+                f"(peak {_gb(s['peak_bytes_in_use'])}, "
+                f"limit {_gb(s['bytes_limit'])})")
+    parts.append(f"host RSS: {_gb(host_memory_rss())}")
+    logger.info(" | ".join(parts))
+
+
+def memory_status(tag=""):
+    """Compact dict for programmatic checks (used by offload tests to
+    assert HBM headroom)."""
+    s = device_memory_stats()
+    return {"tag": tag, **s, "host_rss": host_memory_rss()}

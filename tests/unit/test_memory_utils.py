@@ -1,0 +1,34 @@
+"""Memory diagnostics tests (reference runtime/utils.py see_memory_usage)."""
+
+from deepspeed_tpu.utils.memory import (device_memory_stats, host_memory_rss,
+                                        memory_status, see_memory_usage)
+
+
+def test_stats_shapes():
+    s = device_memory_stats()
+    assert set(s) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+    assert host_memory_rss() > 0
+    m = memory_status("tag")
+    assert m["tag"] == "tag" and m["host_rss"] > 0
+
+
+def test_see_memory_usage_logs():
+    import logging
+    from deepspeed_tpu.utils.logging import logger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    logger.addHandler(h)
+    try:
+        see_memory_usage("after init", force=True)
+        assert any("after init" in m for m in records)
+        records.clear()
+        see_memory_usage("quiet", force=False)
+        assert not records
+    finally:
+        logger.removeHandler(h)

@@ -63,6 +63,49 @@ def test_bloom_ingestion(ids):
     np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
 
 
+def test_gptj_ingestion(ids):
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPTJForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_gpt_neox_ingestion(ids):
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, attention_dropout=0.0,
+        hidden_dropout=0.0)
+    hf = transformers.GPTNeoXForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_gpt_neox_nonparallel_residual(ids):
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=1.0,
+        use_parallel_residual=False, attention_dropout=0.0,
+        hidden_dropout=0.0)
+    hf = transformers.GPTNeoXForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_gptj_generation_with_cache(ids):
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPTJForCausalLM(cfg)
+    engine = deepspeed_tpu.init_inference(hf, dtype="float32")
+    out = engine.generate(ids[:, :6], max_new_tokens=6)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids[:, :6]), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_llama_ingestion(ids):
     cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=48, num_hidden_layers=2,

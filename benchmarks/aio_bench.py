@@ -1,0 +1,83 @@
+"""Async file-IO sweep (reference ``csrc/aio/py_test/aio_bench_perf_sweep.py``
+— the NVMe tier's perf harness behind ZeRO-Infinity).
+
+Sweeps (block_size, thread_count, o_direct) over the native AIO
+handle's read and write paths and reports GB/s per configuration as
+bench-style JSON lines. Without --o-direct the numbers include the page
+cache (useful for the double-buffered optimizer-swap pattern, where the
+cache is an asset); pass --o-direct for raw device throughput like the
+reference sweep.
+
+Usage: python benchmarks/aio_bench.py [--dir /tmp] [--mb 64]
+       [--block-sizes 262144,1048576] [--threads 1,4] [--o-direct]
+       [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="/tmp")
+    p.add_argument("--mb", type=int, default=64, help="file size in MiB")
+    p.add_argument("--block-sizes", default="262144,1048576")
+    p.add_argument("--threads", default="1,4")
+    p.add_argument("--o-direct", action="store_true")
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+
+    from deepspeed_tpu.ops.aio import AioHandle
+
+    nbytes = args.mb * (1 << 20)
+    data = np.random.default_rng(0).integers(
+        0, 255, nbytes, dtype=np.uint8)
+    path = os.path.join(args.dir, "aio_bench.bin")
+    results = []
+    try:
+        for bs in (int(x) for x in args.block_sizes.split(",")):
+            for th in (int(x) for x in args.threads.split(",")):
+                h = AioHandle(block_size=bs, thread_count=th,
+                              o_direct=args.o_direct)
+                # write sweep
+                t_w = []
+                for _ in range(args.trials):
+                    t0 = time.time()
+                    h.async_pwrite(data, path)
+                    h.wait()
+                    t_w.append(time.time() - t0)
+                # read sweep
+                buf = np.empty(nbytes, np.uint8)
+                t_r = []
+                for _ in range(args.trials):
+                    t0 = time.time()
+                    h.async_pread(buf, path)
+                    h.wait()
+                    t_r.append(time.time() - t0)
+                assert (buf == data).all(), "aio read corruption"
+                row = {
+                    "block_size": bs, "threads": th,
+                    "o_direct": bool(args.o_direct), "file_mb": args.mb,
+                    "write_gbps": round(nbytes / min(t_w) / 1e9, 3),
+                    "read_gbps": round(nbytes / min(t_r) / 1e9, 3),
+                }
+                results.append(row)
+                print(json.dumps(row))
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

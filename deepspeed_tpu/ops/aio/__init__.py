@@ -40,10 +40,16 @@ class AioHandle:
     def __init__(self, block_size=1 << 20, queue_depth=4, single_submit=False,
                  overlap_events=True, thread_count=None, o_direct=False):
         self.block_size = block_size
-        self.queue_depth = thread_count or queue_depth
+        self.queue_depth = queue_depth
+        # the native pool's parallelism knob is its worker-thread count;
+        # queue_depth (the reference's per-thread kernel-AIO depth) has no
+        # separate meaning in the pthread design and serves as the pool
+        # size fallback when thread_count is not given
+        self.thread_count = thread_count if thread_count is not None \
+            else queue_depth
         lib = _native()
         self._lib = lib
-        self._h = lib.ds_aio_new(block_size, self.queue_depth,
+        self._h = lib.ds_aio_new(block_size, self.thread_count,
                                  int(o_direct)) if lib else None
         self._fallback_pending = []
         self._inflight = []      # keep submitted buffers alive until wait()

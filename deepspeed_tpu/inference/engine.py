@@ -103,8 +103,14 @@ class InferenceEngine:
             self.module = type(model)(dataclasses.replace(cfg,
                                                           attn_impl="auto"))
 
-        if params is not None:
+        ckpt_pending = config.checkpoint is not None
+        if params is not None and not ckpt_pending:
             self.set_params(params)
+        elif params is not None:
+            # a checkpoint load follows immediately and replaces these
+            # weights; skip the full cast/quantize/offload of a tree
+            # that would be thrown away
+            pass
 
         ckpt = config.checkpoint
         if isinstance(ckpt, dict):
@@ -128,14 +134,20 @@ class InferenceEngine:
                                  kind="param")
         return shd.tree_shardings(self.mesh, pspecs)
 
-    def set_params(self, params, quantize=None):
+    def set_params(self, params, quantize=None, offload=None):
         """Cast to inference dtype and shard over the mesh (the reference's
         _convert_to_dtype + ReplaceWithTensorSlicing combined); with
         quant.enabled, Dense kernels then quantize to int8 groups
         (reference GroupQuantizer sweep, replace_module.py:138).
         `quantize=False` keeps floats (checkpoint-restore target trees)."""
+        offload = (self._config.zero or {}).get("stage") == 3 \
+            if offload is None else offload
         sh = self._param_shardings(params)     # needs Partitioned metadata
         params = shd.unbox(params)
+        if offload:
+            # larger-than-HBM loading: cast/quantize/offload LEAF BY LEAF
+            # so peak device memory is one leaf, never the whole model
+            return self._set_params_offloaded(params, sh, quantize)
         cast = jax.jit(
             lambda p: jax.tree.map(
                 lambda x: x.astype(self.dtype)
@@ -143,12 +155,74 @@ class InferenceEngine:
                 p),
             out_shardings=sh)
         self.params = cast(params)
+        return self._postprocess_params(quantize=quantize, offload=False)
+
+    def _set_params_offloaded(self, params, sh_tree, quantize):
+        from deepspeed_tpu.ops.quant import QTensor
+        from deepspeed_tpu.ops.quant.quantizer import _eligible, quantize as q
+        quantize = self._config.quant.enabled if quantize is None else quantize
+        qcfg = self._config.quant
+
+        def host(x):
+            return jax.device_put(
+                x, x.sharding.with_memory_kind("pinned_host"))
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        sh_flat = jax.tree.leaves(sh_tree)
+        out = []
+        for (path, leaf), sh in zip(flat, sh_flat):
+            dev = jax.device_put(leaf, sh)
+            if jnp.issubdtype(dev.dtype, jnp.floating):
+                dev = dev.astype(self.dtype)
+            key = jax.tree_util.keystr(path)
+            if quantize and "kernel" in key and \
+                    _eligible(dev, qcfg.group_size):
+                qv, scale = q(dev, bits=qcfg.num_bits,
+                              group_size=qcfg.group_size)
+                out.append(QTensor(host(qv), host(scale), dev.dtype,
+                                   qcfg.num_bits))
+            else:
+                out.append(host(dev))
+            del dev
+        self.params = jax.tree_util.tree_unflatten(treedef, out)
+        self._offload_params = True
+        self._params_postprocessed = True
+        self._mat_sh = jax.tree.map(
+            lambda l: l.sharding.with_memory_kind("device"), self.params)
+        n = sum(int(np.prod(np.shape(l)))
+                for l in jax.tree.leaves(self.params))
+        log_dist(f"inference params ready: {n/1e6:.1f}M, "
+                 f"dtype={self._config.dtype}"
+                 f"{' +int8' if quantize else ''} +host-offload "
+                 f"(leaf-streamed), tp={self.mp_world_size}", ranks=[0])
+        return self
+
+    def _postprocess_params(self, quantize=None, offload=None):
+        """Quantize then host-offload self.params per config (split out so
+        checkpoint restore can load raw floats first)."""
         quantize = self._config.quant.enabled if quantize is None else quantize
         if quantize:
             self.params = self._quantize(self.params)
+        if offload is None:
+            offload = (self._config.zero or {}).get("stage") == 3
+        self._offload_params = bool(offload)
+        self._params_postprocessed = bool(quantize or offload)
+        if offload:
+            # ZeRO-Inference (reference zero.stage=3 + init_inference,
+            # docs/2022-09-10-zero-inference.md): weights live in PINNED
+            # HOST memory and stream to HBM per use inside the jitted
+            # forward — models larger than HBM serve from host RAM, and
+            # with int8 the PCIe/DMA stream is the quantized bytes.
+            self._mat_sh = jax.tree.map(
+                lambda l: l.sharding.with_memory_kind("device"), self.params)
+            self.params = jax.tree.map(
+                lambda l: jax.device_put(
+                    l, l.sharding.with_memory_kind("pinned_host")),
+                self.params)
         n = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(self.params))
         log_dist(f"inference params ready: {n/1e6:.1f}M, dtype={self._config.dtype}"
-                 f"{' +int8' if quantize else ''}, "
+                 f"{' +int8' if quantize else ''}"
+                 f"{' +host-offload' if offload else ''}, "
                  f"tp={self.mp_world_size}", ranks=[0])
         return self
 
@@ -161,20 +235,26 @@ class InferenceEngine:
                              predicate=lambda path, leaf: "kernel" in path)
 
     def _materialize(self, params):
-        """Dequantize QTensor leaves inside a jitted computation."""
+        """Inside a jitted computation: stream host-offloaded leaves to
+        device memory (XLA schedules each transfer next to its consumer)
+        and dequantize QTensor leaves — in that order, so offloaded int8
+        weights cross the host-device link quantized."""
+        if getattr(self, "_offload_params", False):
+            params = jax.tree.map(jax.device_put, params, self._mat_sh)
         if not self._config.quant.enabled:
             return params
         from deepspeed_tpu.ops.quant import dequantize_tree
         return dequantize_tree(params)
 
-    def init_params(self, example_ids=None, seed=0, quantize=None):
+    def init_params(self, example_ids=None, seed=0, quantize=None,
+                    offload=None):
         """Random init (benchmarks / smoke tests)."""
         ids = example_ids if example_ids is not None \
             else jnp.zeros((1, 8), jnp.int32)
         variables = self.module.init(jax.random.PRNGKey(seed),
                                      jnp.asarray(ids))
         return self.set_params(variables.get("params", variables),
-                               quantize=quantize)
+                               quantize=quantize, offload=offload)
 
     def load_checkpoint(self, path, tag=None):
         """Load params saved by the training engine's save_checkpoint."""
@@ -187,13 +267,18 @@ class InferenceEngine:
                     tag = f.read().strip()
         full = os.path.join(path, tag) if tag else path
         quant = self._config.quant.enabled
-        if self.params is None or quant:
-            # restore needs a float target tree (shapes + shardings);
-            # quantization re-applies after the load
-            self.init_params(quantize=False)
+        offload = (self._config.zero or {}).get("stage") == 3
+        if self.params is None or quant or offload or \
+                getattr(self, "_params_postprocessed", False):
+            # restore needs a float on-DEVICE target tree (shapes +
+            # shardings); quantization/offload re-apply after the load.
+            # Also rebuilds when the LIVE params were postprocessed (e.g.
+            # an explicit set_params(offload=True)) so the restore target
+            # is never a quantized/host tree
+            self.init_params(quantize=False, offload=False)
         # restore only the params subtree of the saved TrainState
-        params = load_subtree(full, self.params, prefix=".params")
-        self.params = self._quantize(params) if quant else params
+        self.params = load_subtree(full, self.params, prefix=".params")
+        self._postprocess_params(quantize=quant, offload=offload)
         log_dist(f"inference checkpoint loaded from {full}", ranks=[0])
         return self
 

@@ -17,6 +17,7 @@ TPU redesign:
     single-token decode step, KV cache as a device-resident pytree.
 """
 
+import os
 import time
 from typing import Any, Optional
 
@@ -103,26 +104,26 @@ class InferenceEngine:
             self.module = type(model)(dataclasses.replace(cfg,
                                                           attn_impl="auto"))
 
-        ckpt_pending = config.checkpoint is not None
-        if params is not None and not ckpt_pending:
-            self.set_params(params)
-        elif params is not None:
-            # a checkpoint load follows immediately and replaces these
-            # weights; skip the full cast/quantize/offload of a tree
-            # that would be thrown away
-            pass
-
         ckpt = config.checkpoint
         if isinstance(ckpt, dict):
             ckpt = ckpt.get("checkpoint_dir") or ckpt.get("base_dir")
         elif hasattr(ckpt, "checkpoint_dir"):
             ckpt = ckpt.checkpoint_dir or getattr(ckpt, "base_dir", None)
-        if isinstance(ckpt, str):
-            self.load_checkpoint(ckpt)
-        elif config.checkpoint is not None and ckpt is None:
+        if ckpt is not None and not isinstance(ckpt, (str, os.PathLike)):
             raise ValueError(
                 f"unusable checkpoint config: {config.checkpoint!r} "
                 "(expected a path or {'checkpoint_dir': path})")
+        if config.checkpoint is not None and ckpt is None:
+            raise ValueError(
+                f"unusable checkpoint config: {config.checkpoint!r} "
+                "(expected a path or {'checkpoint_dir': path})")
+
+        # a pending checkpoint load replaces provided params — skip the
+        # full cast/quantize/offload of a tree about to be thrown away
+        if params is not None and ckpt is None:
+            self.set_params(params)
+        if ckpt is not None:
+            self.load_checkpoint(str(ckpt))
 
     # ------------------------------------------------------------------ params
     def _param_shardings(self, params):
@@ -175,7 +176,7 @@ class InferenceEngine:
             if jnp.issubdtype(dev.dtype, jnp.floating):
                 dev = dev.astype(self.dtype)
             key = jax.tree_util.keystr(path)
-            if quantize and "kernel" in key and \
+            if quantize and self._quant_leaf_predicate(key) and \
                     _eligible(dev, qcfg.group_size):
                 qv, scale = q(dev, bits=qcfg.num_bits,
                               group_size=qcfg.group_size)
@@ -226,13 +227,18 @@ class InferenceEngine:
                  f"tp={self.mp_world_size}", ranks=[0])
         return self
 
+    @staticmethod
+    def _quant_leaf_predicate(path):
+        """THE quant leaf predicate — shared by the on-device tree sweep
+        and the leaf-streamed offload path."""
+        return "kernel" in path
+
     def _quantize(self, params):
-        """The one place the quant leaf predicate/parameters live."""
         from deepspeed_tpu.ops.quant import quantize_tree
         qcfg = self._config.quant
-        return quantize_tree(params, bits=qcfg.num_bits,
-                             group_size=qcfg.group_size,
-                             predicate=lambda path, leaf: "kernel" in path)
+        return quantize_tree(
+            params, bits=qcfg.num_bits, group_size=qcfg.group_size,
+            predicate=lambda path, leaf: self._quant_leaf_predicate(path))
 
     def _materialize(self, params):
         """Inside a jitted computation: stream host-offloaded leaves to
@@ -256,9 +262,32 @@ class InferenceEngine:
         return self.set_params(variables.get("params", variables),
                                quantize=quantize, offload=offload)
 
+    def _host_float_template(self):
+        """A zero-valued float param tree already placed in PINNED HOST
+        memory, built leaf-by-leaf from eval_shape — nothing ever
+        materializes on device (the restore target for larger-than-HBM
+        ZeRO-Inference loads)."""
+        ids = jnp.zeros((1, 8), jnp.int32)
+        boxed = jax.eval_shape(
+            lambda: self.module.init(jax.random.PRNGKey(0), ids))["params"]
+        sh_tree = self._param_shardings(boxed)
+        shapes = shd.unbox(boxed)
+        flat, treedef = jax.tree_util.tree_flatten(shapes)
+        sh_flat = jax.tree.leaves(sh_tree)
+        out = []
+        for leaf, sh in zip(flat, sh_flat):
+            dtype = self.dtype if jnp.issubdtype(leaf.dtype, jnp.floating) \
+                else leaf.dtype
+            out.append(jax.device_put(
+                np.zeros(leaf.shape, dtype),
+                sh.with_memory_kind("pinned_host")))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     def load_checkpoint(self, path, tag=None):
-        """Load params saved by the training engine's save_checkpoint."""
-        import os
+        """Load params saved by the training engine's save_checkpoint.
+        For ZeRO-Inference engines the restore streams straight into host
+        memory (and quantizes leaf-by-leaf) — peak device memory during
+        the load is at most one parameter."""
         from deepspeed_tpu.checkpoint.engine import load_subtree
         if tag is None:
             latest = os.path.join(path, "latest")
@@ -268,17 +297,53 @@ class InferenceEngine:
         full = os.path.join(path, tag) if tag else path
         quant = self._config.quant.enabled
         offload = (self._config.zero or {}).get("stage") == 3
-        if self.params is None or quant or offload or \
+
+        if offload:
+            target = self._host_float_template()
+            loaded = load_subtree(full, target, prefix=".params")
+            # leaf-streamed postprocess: host float -> (device) quantize
+            # -> host, one leaf at a time
+            from deepspeed_tpu.ops.quant import QTensor
+            from deepspeed_tpu.ops.quant.quantizer import (_eligible,
+                                                           quantize as q)
+            qcfg = self._config.quant
+            flat, treedef = jax.tree_util.tree_flatten_with_path(loaded)
+            out = []
+            for pth, leaf in flat:
+                key = jax.tree_util.keystr(pth)
+                if quant and self._quant_leaf_predicate(key) and \
+                        _eligible(leaf, qcfg.group_size):
+                    dev = jax.device_put(
+                        leaf, leaf.sharding.with_memory_kind("device"))
+                    qv, scale = q(dev, bits=qcfg.num_bits,
+                                  group_size=qcfg.group_size)
+                    host = lambda x: jax.device_put(
+                        x, x.sharding.with_memory_kind("pinned_host"))
+                    out.append(QTensor(host(qv), host(scale), dev.dtype,
+                                       qcfg.num_bits))
+                    del dev
+                else:
+                    out.append(leaf)
+            self.params = jax.tree_util.tree_unflatten(treedef, out)
+            self._offload_params = True
+            self._params_postprocessed = True
+            self._mat_sh = jax.tree.map(
+                lambda l: l.sharding.with_memory_kind("device"), self.params)
+            log_dist(f"inference checkpoint loaded from {full} "
+                     "(host-offloaded, leaf-streamed)", ranks=[0])
+            return self
+
+        if self.params is None or quant or \
                 getattr(self, "_params_postprocessed", False):
             # restore needs a float on-DEVICE target tree (shapes +
-            # shardings); quantization/offload re-apply after the load.
-            # Also rebuilds when the LIVE params were postprocessed (e.g.
-            # an explicit set_params(offload=True)) so the restore target
-            # is never a quantized/host tree
+            # shardings); quantization re-applies after the load. Also
+            # rebuilds when the LIVE params were postprocessed (e.g. an
+            # explicit set_params override) so the restore target is
+            # never a quantized/host tree
             self.init_params(quantize=False, offload=False)
         # restore only the params subtree of the saved TrainState
         self.params = load_subtree(full, self.params, prefix=".params")
-        self._postprocess_params(quantize=quant, offload=offload)
+        self._postprocess_params(quantize=quant, offload=False)
         log_dist(f"inference checkpoint loaded from {full}", ranks=[0])
         return self
 

@@ -192,6 +192,41 @@ class DeepSpeedEngine:
     def get_global_grad_norm(self):
         return self._last_metrics.get("grad_norm")
 
+    # reference accessor surface (engine.py:480-857 exposes ~120 of
+    # these; the ones client code commonly touches)
+    def get_mom(self):
+        """Current (beta1, beta2) per param group (reference get_mom)."""
+        betas = (self._config.optimizer.params or {}).get(
+            "betas", (0.9, 0.999))
+        return [tuple(betas)]
+
+    def global_rank(self):
+        return jax.process_index()
+
+    def world_size(self):
+        return jax.process_count()
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def fp16_enabled(self):
+        return bool(self._config.fp16.enabled)
+
+    def bfloat16_enabled(self):
+        return bool(self._config.bf16.enabled)
+
+    def zero_offload_optimizer(self):
+        return self._offload is not None
+
+    def wall_clock_breakdown(self):
+        return bool(self._config.wall_clock_breakdown)
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def monitor_enabled(self):
+        return bool(self.monitor.enabled)
+
     @property
     def loss_scale(self):
         if self._offload is not None:

@@ -164,3 +164,36 @@ def test_zero_inference_with_int8(tiny_llama):
         q.q.sharding.memory_kind == "pinned_host" for q in qleaves)
     out = e.generate(ids, max_new_tokens=4)
     assert out.shape == (2, 12)
+
+
+def test_zero_inference_checkpoint_restore_streams_to_host(tmp_path,
+                                                           tiny_llama):
+    """Offloaded engines restore checkpoints straight into host memory
+    (the larger-than-HBM load path: no full float tree on device)."""
+    import deepspeed_tpu
+    module, params = tiny_llama
+    ids = np.random.default_rng(2).integers(3, 250, (2, 8)).astype("i4")
+
+    # train-engine-style checkpoint to restore from (attribute-path
+    # .params like the engine's TrainState)
+    import flax.struct
+
+    @flax.struct.dataclass
+    class FakeState:
+        params: dict
+
+    ref_e = deepspeed_tpu.init_inference(module, params=params,
+                                         dtype="float32")
+    from deepspeed_tpu.checkpoint.engine import save_state
+    save_state(str(tmp_path / "t"), FakeState(params=ref_e.params))
+    (tmp_path / "latest").write_text("t")
+
+    off_e = deepspeed_tpu.init_inference(
+        module, dtype="float32", zero={"stage": 3},
+        checkpoint={"checkpoint_dir": str(tmp_path)})
+    kinds = {getattr(l.sharding, "memory_kind", None)
+             for l in jax.tree.leaves(off_e.params)}
+    assert kinds == {"pinned_host"}, kinds
+    ref = np.asarray(jax.device_get(ref_e.forward(ids)))
+    got = np.asarray(jax.device_get(off_e.forward(ids)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

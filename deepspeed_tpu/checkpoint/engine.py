@@ -110,6 +110,70 @@ def _leaf_chunks(leaf):
         yield _index_key(_full_index(arr.shape), arr.shape), arr
 
 
+def _coordination_client():
+    """The distributed coordination-service client, or None outside a
+    jax.distributed-initialized run. Lives in jax's private distributed
+    module (there is no public accessor as of jax 0.9)."""
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None)
+    except Exception:
+        return None
+
+
+def param_leaf_names(params, prefix=".params"):
+    """Checkpoint leaf names for a params tree, in flat order — the same
+    names _flatten_named assigns when the tree sits under the state's
+    ``params`` field. Single source of the naming contract shared by the
+    engine (offload master pairing) and consolidate()."""
+    names, _, _ = _flatten_named(params)
+    return [prefix + n for n in names]
+
+
+def _durability_barrier(save_id, path, on_writer_thread):
+    """Block until every process's shard file is durably written.
+
+    In async mode this runs on the *writer thread*, so it must not be a
+    device collective: the main thread keeps issuing train-step
+    collectives, and two threads enqueueing collectives in host-dependent
+    order can deadlock or mismatch across hosts. Preferred channel is the
+    coordination service's barrier (the same channel Orbax uses) — a pure
+    host-side RPC that never touches the devices. Without a coordination
+    client, the sync path uses the device barrier (safe on the main
+    thread) and the async path polls the checkpoint directory for every
+    process's shard file — valid because multi-process checkpoints
+    require a shared directory (the loader assembles all shard files)."""
+    if jax.process_count() == 1:
+        return
+    client = _coordination_client()
+    if client is not None:
+        client.wait_at_barrier(f"ckpt_done:{save_id}", 600_000)
+        return
+    if not on_writer_thread:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_done:{save_id}")
+        return
+    # writer thread, no coordination client: only process 0 (which flips
+    # the `latest` pointer in on_done) needs to wait; it watches for all
+    # processes' shard files to appear in the shared directory
+    if jax.process_index() != 0:
+        return
+    import time
+    deadline = time.time() + 600.0
+    want = jax.process_count()
+    while True:
+        done = sum(1 for fn in os.listdir(path)
+                   if fn.startswith("shards_p") and fn.endswith(".npz")
+                   and f".{save_id}." in fn)
+        if done >= want:
+            return
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"checkpoint barrier: only {done}/{want} shard files for "
+                f"save {save_id} appeared in {path} after 600s")
+        time.sleep(0.25)
+
+
 def _agree_save_id():
     """One save_id shared by ALL processes: generated on process 0 and
     broadcast. A per-process uuid would stamp every host's shard file
@@ -183,13 +247,9 @@ def save_state(path, state, client_state=None, async_write=False,
                     os.remove(os.path.join(path, fn))
                 except OSError:
                     pass
-        if jax.process_count() > 1:
-            # all hosts' shard files must be durable before the `latest`
-            # pointer flips; in async mode this barrier runs on the writer
-            # thread, so it must not interleave with another collective —
-            # the engine serializes saves via wait_checkpoint()
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt_done:{save_id}")
+        # all hosts' shard files must be durable before the `latest`
+        # pointer flips
+        _durability_barrier(save_id, path, on_writer_thread=async_write)
         if on_done is not None and jax.process_index() == 0:
             on_done()
 
@@ -438,7 +498,15 @@ def consolidate(path, out_file, prefix=".params", dtype=np.float32):
             master_npz = np.load(host_opt, allow_pickle=False)
             n_master = sum(1 for k in master_npz.files
                            if k.startswith("master_"))
-            if n_master == len(names):
+            if "leaf_names" in master_npz.files:
+                # authoritative pairing: the offload optimizer records its
+                # flat-leaf order by checkpoint name
+                saved_names = [str(s) for s in master_npz["leaf_names"]]
+                known = set(names)
+                master_of = {name: f"master_{i}"
+                             for i, name in enumerate(saved_names)
+                             if name in known}
+            elif n_master == len(names):
                 master_of = {name: f"master_{i}"
                              for i, name in enumerate(names)}
 
@@ -446,8 +514,14 @@ def consolidate(path, out_file, prefix=".params", dtype=np.float32):
             for name in names:
                 shape = chunks.saved_shape(name)
                 if name in master_of:
-                    arr = master_npz[master_of[name]].reshape(shape) \
-                        .astype(dtype)
+                    flat = master_npz[master_of[name]]
+                    if flat.size != int(np.prod(shape)):
+                        raise ValueError(
+                            f"host master entry {master_of[name]} has "
+                            f"{flat.size} elements but leaf {name} has "
+                            f"shape {shape} — offload state and model "
+                            "meta disagree")
+                    arr = flat.reshape(shape).astype(dtype)
                 else:
                     arr = chunks.assemble(name, _full_index(shape), shape,
                                           dtype)

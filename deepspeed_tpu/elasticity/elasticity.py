@@ -130,6 +130,13 @@ def compute_elastic_config(ds_config, target_deepspeed_version=None,
     final_batch, valid = _best_candidate(
         candidates, cfg.micro_batches, cfg.min_gpus, max_devs,
         cfg.prefer_larger_batch_size)
+    if not valid:
+        # refuse configs with no compatible device count rather than hand
+        # back an unusable fallback batch (reference raises the same way)
+        raise ElasticityError(
+            f"no candidate batch size in {candidates} is compatible with "
+            f"any device count in [{cfg.min_gpus}, {max_devs}] for "
+            f"micro_batches {cfg.micro_batches}")
 
     # valid counts are DATA-PARALLEL replica counts: with model
     # parallelism, the device world divides into world/mp replicas

@@ -517,9 +517,10 @@ class InferenceEngine:
                     0 if eos_token_id is None else int(eos_token_id))
             rest = np.asarray(jax.device_get(toks))[:, :n_rest]
             dt = time.time() - t0
-            # aggregate dispatch: spread the loop time over its tokens so
-            # model_times() percentiles stay meaningful
-            self._model_times.extend([dt / n_bucket] * n_rest)
+            # aggregate dispatch: spread the loop time over the *emitted*
+            # tokens so the recorded times sum to the measured wall time
+            # even when the scan length was rounded up past n_rest
+            self._model_times.extend([dt / n_rest] * n_rest)
             gen = np.concatenate([first[:, None], rest], axis=1)
             return np.concatenate([ids, gen], axis=1)
 

@@ -345,9 +345,11 @@ class DeepSpeedEngine:
                 fp16_cfg=self._config.fp16, fp16_enabled=self.fp16_enabled,
                 offload_cfg=self._offload_cfg,
                 aio_config=self._config.aio_config)
+            from deepspeed_tpu.checkpoint.engine import param_leaf_names
             host_leaves = [np.asarray(jax.device_get(l))
                            for l in jax.tree.leaves(params)]
-            self._offload.init_master(host_leaves)
+            self._offload.init_master(host_leaves,
+                                      names=param_leaf_names(params))
             compute_dtype = self.compute_dtype
             cast_fn = jax.jit(
                 lambda p: jax.tree.map(
@@ -881,9 +883,11 @@ class DeepSpeedEngine:
                     self._offload.load_state_dict(dict(d))
             else:
                 # params are authoritative: refresh the master from them
+                from deepspeed_tpu.checkpoint.engine import param_leaf_names
                 self._offload.init_master(
                     [np.asarray(jax.device_get(l))
-                     for l in jax.tree.leaves(self.state.params)])
+                     for l in jax.tree.leaves(self.state.params)],
+                    names=param_leaf_names(self.state.params))
         self.global_steps = client.get("global_steps", 0)
         self.micro_steps = client.get("micro_steps", 0)
         self.global_samples = client.get("global_samples", 0)

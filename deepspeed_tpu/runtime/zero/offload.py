@@ -153,6 +153,7 @@ class HostOffloadOptimizer:
         self.nvme_path = getattr(offload_cfg, "nvme_path", None)
         self.aio_config = aio_config
         self.master = None       # list of flat fp32 arrays
+        self.names = None        # checkpoint leaf names, tree order
         self.moments = None      # list of (m, v) or None when on NVMe
         self.nvme = None
         self.acc = None          # fp32 grad accumulators
@@ -160,11 +161,15 @@ class HostOffloadOptimizer:
         self.skipped_steps = 0
 
     # ------------------------------------------------------------- state
-    def init_master(self, host_leaves):
+    def init_master(self, host_leaves, names=None):
         """host_leaves: list of numpy arrays (any float dtype) in tree
-        order; copied into flat fp32 master buffers."""
+        order; copied into flat fp32 master buffers. ``names`` (optional)
+        are the checkpoint leaf names in the same order — persisted with
+        the state so consolidation pairs master buffers by name, never by
+        enumeration order."""
         self.master = [_to_f32(a).reshape(-1).copy() for a in host_leaves]
         self.shapes = [a.shape for a in host_leaves]
+        self.names = list(names) if names is not None else None
         sizes = [m.size for m in self.master]
         if str(self.device) == "nvme":
             assert self.nvme_path, "offload_optimizer.nvme_path required"
@@ -247,6 +252,8 @@ class HostOffloadOptimizer:
         d = {"step_count": self.step_count,
              "skipped_steps": self.skipped_steps,
              "loss_scale": self.scaler.loss_scale}
+        if self.names is not None:
+            d["leaf_names"] = np.array(self.names)
         for i, mstr in enumerate(self.master):
             d[f"master_{i}"] = mstr
             if self.moments is not None:
@@ -261,14 +268,34 @@ class HostOffloadOptimizer:
         self.step_count = int(d["step_count"])
         self.skipped_steps = int(d["skipped_steps"])
         self.scaler.loss_scale = float(d["loss_scale"])
+        # pair saved master_{j}/m_{j}/v_{j} entries with live leaves by
+        # *name* when both sides recorded names; positional pairing would
+        # silently swap optimizer state if the model's flatten order
+        # changed between save and load
+        index_of = {i: i for i in range(len(self.master))}
+        if "leaf_names" in d and self.names is not None:
+            saved = [str(s) for s in d["leaf_names"]]
+            pos = {n: j for j, n in enumerate(saved)}
+            missing = [n for n in self.names if n not in pos]
+            if missing:
+                raise KeyError(
+                    f"offload state missing master entries for leaves "
+                    f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+            index_of = {i: pos[n] for i, n in enumerate(self.names)}
         for i in range(len(self.master)):
-            self.master[i][:] = d[f"master_{i}"]
+            j = index_of[i]
+            if d[f"master_{j}"].size != self.master[i].size:
+                raise ValueError(
+                    f"offload master_{j} has {d[f'master_{j}'].size} "
+                    f"elements but live leaf {i} has "
+                    f"{self.master[i].size}")
+            self.master[i][:] = d[f"master_{j}"]
             if self.moments is not None:
-                self.moments[i][0][:] = d[f"m_{i}"]
-                self.moments[i][1][:] = d[f"v_{i}"]
+                self.moments[i][0][:] = d[f"m_{j}"]
+                self.moments[i][1][:] = d[f"v_{j}"]
             else:
-                self.nvme.writeback(i, np.ascontiguousarray(d[f"m_{i}"]),
-                                    np.ascontiguousarray(d[f"v_{i}"]))
+                self.nvme.writeback(i, np.ascontiguousarray(d[f"m_{j}"]),
+                                    np.ascontiguousarray(d[f"v_{j}"]))
         if self.nvme is not None:
             self.nvme.flush()
 

@@ -38,6 +38,12 @@ class GPTConfig:
     dtype: Any = jnp.float32          # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
+    scan_layers: bool = False          # lax.scan over layers: stacked
+    # params with a leading [num_layers] dim. One compiled block instead
+    # of num_layers inlined copies (fast compiles at depth), and under
+    # ZeRO-3 param offload XLA streams each layer's slice from host
+    # memory per scan step. Training-path only: the KV-cache decode path
+    # keeps per-layer modules, and MoE interleaving is unsupported.
     attn_impl: str = "auto"            # "auto" | "reference" | "flash"
     use_bias: bool = True
     tie_embeddings: bool = True
@@ -296,13 +302,38 @@ class GPT2(nn.Module):
         if cfg.remat and cache is None:
             block = nn.remat(Block, prevent_cse=False)
         new_layer_caches = []
-        for i in range(cfg.num_layers):
-            use_moe = (cfg.moe_num_experts > 1 and
-                       i % cfg.moe_every == cfg.moe_every - 1)
-            layer_cache = cache["layers"][i] if cache is not None else None
-            x, new_c = block(cfg, use_moe, name=f"h_{i}")(
-                x, deterministic, layer_cache, positions)
-            new_layer_caches.append(new_c)
+        if cfg.scan_layers and cache is None:
+            assert cfg.moe_num_experts <= 1, \
+                "scan_layers cannot interleave MoE blocks (heterogeneous)"
+            # one scanned block: params stack to [num_layers, ...] leaves
+            # ('layers' logical axis). With the stacked leaves in host
+            # memory (ZeRO-3 param offload) XLA's scan streams one layer
+            # slice to HBM per step — the partitioned_param_coordinator's
+            # prefetch loop (reference :218) as a compiler schedule.
+            scanned = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, False, name="h_scan")(
+                x, deterministic, None, positions)
+        else:
+            if cfg.scan_layers:
+                raise ValueError(
+                    "scan_layers is a training-path option: the KV-cache "
+                    "decode path needs per-layer modules. Serve with "
+                    "scan_layers=False (unstack the h_scan leaves along "
+                    "axis 0 into h_{i} subtrees).")
+            for i in range(cfg.num_layers):
+                use_moe = (cfg.moe_num_experts > 1 and
+                           i % cfg.moe_every == cfg.moe_every - 1)
+                layer_cache = cache["layers"][i] if cache is not None else None
+                x, new_c = block(cfg, use_moe, name=f"h_{i}")(
+                    x, deterministic, layer_cache, positions)
+                new_layer_caches.append(new_c)
 
         logits = _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
         if cache is not None:

@@ -103,8 +103,16 @@ def _base_pspec(logical_spec, shape, mesh, zero_stage, min_fsdp_stage, rules,
 
 
 def param_pspec(logical_spec, shape, mesh, zero_stage=0, rules=DEFAULT_LOGICAL_AXIS_RULES,
-                fsdp_axis="data"):
-    """PartitionSpec for a parameter under TP rules + ZeRO stage."""
+                fsdp_axis="data", persist_threshold=0):
+    """PartitionSpec for a parameter under TP rules + ZeRO stage.
+
+    ``persist_threshold`` is the reference's
+    ``stage3_param_persistence_threshold`` (zero/config.py): parameters
+    with fewer elements stay replicated over the fsdp axis (their
+    all-gather would cost more latency than the memory saved). TP axes
+    still apply — persistence is a ZeRO decision only."""
+    if persist_threshold and int(np.prod(shape or (1,))) < persist_threshold:
+        zero_stage = min(zero_stage, 2)
     return _base_pspec(logical_spec, shape, mesh, zero_stage, 3, rules, fsdp_axis)
 
 
@@ -147,12 +155,16 @@ def tree_param_shardings(mesh, shapes, logical_specs, zero_stage=0,
 
 
 def tree_pspecs(mesh, shapes, logical_specs, zero_stage, kind,
-                rules=DEFAULT_LOGICAL_AXIS_RULES):
-    """PartitionSpec tree for params ('param') or optimizer state ('opt')."""
-    fn = param_pspec if kind == "param" else optstate_pspec
-
-    def leaf(sh, sp):
-        return fn(sp, sh.shape, mesh, zero_stage, rules)
+                rules=DEFAULT_LOGICAL_AXIS_RULES, persist_threshold=0):
+    """PartitionSpec tree for params ('param') or optimizer state ('opt').
+    ``persist_threshold`` applies to params only (see param_pspec)."""
+    if kind == "param":
+        def leaf(sh, sp):
+            return param_pspec(sp, sh.shape, mesh, zero_stage, rules,
+                               persist_threshold=persist_threshold)
+    else:
+        def leaf(sh, sp):
+            return optstate_pspec(sp, sh.shape, mesh, zero_stage, rules)
 
     return jax.tree.map(leaf, shapes, logical_specs,
                         is_leaf=lambda x: x is None or isinstance(x, tuple))

@@ -8,6 +8,7 @@ auto-populates, so old configs keep working.
 
 import json
 from functools import reduce
+from typing import ClassVar, Dict
 
 from pydantic import BaseModel, ConfigDict, model_validator
 
@@ -19,7 +20,15 @@ class DeepSpeedConfigModel(BaseModel):
 
     Deprecated fields are declared via ``Field(json_schema_extra={
     "deprecated": True, "new_param": "other_field", "new_param_fn": fn})``.
+
+    Fields that are accepted for reference-config compatibility but have
+    no effect in the TPU runtime are declared in ``_inert_fields``
+    (name -> reason). Explicitly setting one logs a loud warning — a
+    silently-ignored knob misleads users porting reference configs
+    (e.g. expecting ZeRO++ quantized comm that never engages).
     """
+
+    _inert_fields: ClassVar[Dict[str, str]] = {}
 
     model_config = ConfigDict(
         validate_default=True,
@@ -35,6 +44,15 @@ class DeepSpeedConfigModel(BaseModel):
         if not strict:
             data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
         super().__init__(**data)
+
+    @model_validator(mode="after")
+    def _warn_inert_fields(self):
+        for name, reason in type(self)._inert_fields.items():
+            if name in self.model_fields_set:
+                logger.warning(
+                    f"Config key '{name}' is accepted for compatibility "
+                    f"but has NO EFFECT on TPU: {reason}")
+        return self
 
     @model_validator(mode="after")
     def _process_deprecated_fields(self):

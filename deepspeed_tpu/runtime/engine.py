@@ -102,11 +102,40 @@ class DeepSpeedEngine:
         self.zero_stage = self._config.zero_optimization_stage
         # ZeRO-Offload / ZeRO-Infinity: host-RAM (or NVMe) optimizer state
         # (runtime/zero/offload.py; reference stage_1_and_2.py CPU path)
+        def _dev(cfg):
+            if cfg is None:
+                return "none"
+            return str(cfg.device.value if hasattr(cfg.device, "value")
+                       else cfg.device)
+
         _oc = self._config.zero_config.offload_optimizer
-        self._offload_cfg = _oc if (_oc is not None and
-                                    str(_oc.device.value
-                                        if hasattr(_oc.device, "value")
-                                        else _oc.device) != "none") else None
+        self._offload_cfg = _oc if _dev(_oc) != "none" else None
+        # Training-time ZeRO-3 parameter offload (reference stage3.py:445-480
+        # + swap_tensor/partitioned_param_swapper.py): the at-rest compute
+        # copy of the params lives in PINNED HOST memory and streams to HBM
+        # inside the jitted step (XLA schedules each leaf's transfer next to
+        # its consumer); gradients stream back out to host memory, where the
+        # host optimizer consumes them.
+        _pc = self._config.zero_config.offload_param
+        self._offload_param = _dev(_pc) != "none"
+        if self._offload_param and self.zero_stage < 3:
+            logger.warning("offload_param requires ZeRO stage 3 (reference "
+                           "zero/config.py); ignoring for stage "
+                           f"{self.zero_stage}")
+            self._offload_param = False
+        if self._offload_param and self._offload_cfg is None:
+            # params on host with optimizer state on device would free the
+            # small fraction and keep the big one: optimizer state (fp32
+            # master + moments, 12B/param) dwarfs the bf16 compute copy.
+            # Imply the host-optimizer tier, like ZeRO-Infinity.
+            logger.warning(
+                "offload_param without offload_optimizer: enabling host "
+                "optimizer offload (optimizer state is 6x the bytes of the "
+                "bf16 params)")
+            from deepspeed_tpu.runtime.zero.config import \
+                DeepSpeedZeroOffloadOptimizerConfig
+            self._offload_cfg = DeepSpeedZeroOffloadOptimizerConfig(
+                device=_dev(_pc), nvme_path=_pc.nvme_path)
         self._offload = None
         self.compute_dtype = DTYPES[self._config.precision_dtype]
         self.fp16_enabled = self._config.fp16.enabled
@@ -313,8 +342,12 @@ class DeepSpeedEngine:
         logical = shd.get_logical_specs(boxed_shapes)
         shapes = shd.unbox(boxed_shapes)
 
+        persist = int(self._config.zero_config
+                      .stage3_param_persistence_threshold) \
+            if self.zero_stage >= 3 else 0
         self.param_pspecs = shd.tree_pspecs(mesh, shapes, logical,
-                                            self.zero_stage, kind="param")
+                                            self.zero_stage, kind="param",
+                                            persist_threshold=persist)
         opt_param_pspecs = shd.tree_pspecs(mesh, shapes, logical,
                                            self.zero_stage, kind="opt")
         if self._offload_cfg is not None:
@@ -356,6 +389,42 @@ class DeepSpeedEngine:
                     lambda x: x.astype(compute_dtype), p),
                 out_shardings=param_sh, donate_argnums=(0,))
             params = cast_fn(params)
+            if self._offload_param:
+                # at-rest compute copy in pinned host memory; the jitted
+                # step streams leaves to HBM per use (same mechanism the
+                # inference engine proves for ZeRO-Inference,
+                # inference/engine.py _materialize) and writes grads back
+                # to host memory. Between steps the chip holds no params.
+                host_sh = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"), param_sh)
+                params = jax.tree.map(jax.device_put, params, host_sh)
+                self._param_mat_sh = param_sh   # device-kind shardings
+                # Streaming strategy: prefer materializing INSIDE the
+                # jitted step (XLA schedules each leaf's — or, with
+                # scan_layers, each layer slice's — transfer next to its
+                # consumer and frees it after last use: params larger
+                # than HBM train). Some backends reject memory-space
+                # transfers of sharded arrays under SPMD ("side-effect
+                # ops cannot be replicated"); probe once and fall back to
+                # an eager pre-dispatch transfer (full bf16 tree resident
+                # for the dispatch) when unsupported.
+                self._injit_materialize = self._probe_injit_materialize(
+                    params, param_sh, host_sh)
+                self._grad_sh_dev = self._grad_sh
+                if self._injit_materialize:
+                    # grad cotangents flow back through the in-program
+                    # transfer and land directly in host memory — no
+                    # separate D2H before the host optimizer
+                    self._grad_sh = jax.tree.map(
+                        lambda s: s.with_memory_kind("pinned_host"),
+                        self._grad_sh)
+                log_dist("ZeRO-3 param offload: at-rest params in pinned "
+                         "host memory, "
+                         + ("in-program streaming"
+                            if self._injit_materialize else
+                            "per-dispatch transfer (backend rejects "
+                            "in-program memory-space moves)"), ranks=[0])
+                param_sh = host_sh
             self._param_treedef = jax.tree.structure(params)
             self._param_sh_flat = jax.tree.leaves(param_sh)
             opt_state = ()      # optimizer state lives on the host
@@ -426,6 +495,18 @@ class DeepSpeedEngine:
                 lambda x: x.astype(compute_dtype)
                 if x.dtype == jnp.float32 and compute_dtype != jnp.float32 else x, p)
 
+        # in-program param streaming (ZeRO-3 param offload): host-kind
+        # params enter the program; XLA places each transfer next to its
+        # consumer and frees the device buffer after last use
+        mat_sh = self._param_mat_sh \
+            if getattr(self, "_injit_materialize", False) else None
+
+        def materialize(p):
+            if mat_sh is None:
+                return p
+            return jax.tree.map(jax.device_put, p, mat_sh)
+
+
         # pipeline loss_fns hand back (loss, grads) from one interleaved
         # 1F1B scan — cheaper than value_and_grad, which would run the
         # forward-only pipeline AND the backward's forward slots
@@ -433,13 +514,13 @@ class DeepSpeedEngine:
 
         def fwd_bwd(params, scale, batch, rng):
             if loss_and_grads is not None:
-                loss, grads = loss_and_grads(cast(params), batch)
+                loss, grads = loss_and_grads(cast(materialize(params)), batch)
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) * (scale / gas), grads)
                 return loss, grads
 
             def scaled_loss(p):
-                loss = loss_fn(cast(p), batch, rng)
+                loss = loss_fn(cast(materialize(p)), batch, rng)
                 return loss.astype(jnp.float32) * scale / gas, loss
 
             (s_loss, loss), grads = jax.value_and_grad(
@@ -547,7 +628,8 @@ class DeepSpeedEngine:
         state = self._live_state()
         rest = state.replace(params=None, opt_state=None)
         if self._offload is not None:
-            micro = cost_analysis(self._micro_first, state.params,
+            micro = cost_analysis(self._micro_first,
+                                  self._materialize_params(state.params),
                                   jnp.float32(1.0), dev_batch, rng)
             flops = micro["flops"] * self.gas
             bytes_ = micro["bytes_accessed"] * self.gas
@@ -595,6 +677,71 @@ class DeepSpeedEngine:
             ranks=[0])
 
     # ------------------------------------------------------------------ train
+    def _probe_injit_materialize(self, host_params, dev_sh, host_sh):
+        """True when this backend *executes* memory-space transfers of
+        arrays with this param tree's shardings in BOTH directions inside
+        jit — host->device for the streamed weights, device->host for the
+        grad cotangents. Probes tiny stand-ins carrying each distinct
+        PartitionSpec (the failure mode — "side-effect ops cannot be
+        replicated" under SPMD — depends on the sharding, not the size,
+        and only surfaces at execution)."""
+        distinct = {}
+        for sh in set(jax.tree.leaves(
+                jax.tree.map(lambda s: s, dev_sh),
+                is_leaf=lambda x: isinstance(x, NamedSharding))):
+            # minimal shape divisible by every mesh axis in the spec
+            dims = tuple(
+                int(np.prod([self.mesh.shape[a] for a in
+                             ((e,) if isinstance(e, str) else e)]))
+                if e is not None else 1
+                for e in sh.spec)
+            distinct[sh] = jnp.zeros(dims or (), self.compute_dtype)
+        try:
+            def round_trip(ps):
+                dev = [jax.device_put(p, s) for p, s in
+                       zip(ps, distinct.keys())]
+                return [jax.device_put(d, s.with_memory_kind("pinned_host"))
+                        for d, s in zip(dev, distinct.keys())]
+            host_ins = [jax.device_put(
+                v, s.with_memory_kind("pinned_host"))
+                for s, v in distinct.items()]
+            jax.block_until_ready(jax.jit(round_trip)(host_ins))
+            return True
+        except Exception:
+            return False
+
+    def _fallback_to_eager_streaming(self, err):
+        """Some backends accept the tiny probe but reject the real step's
+        in-program memory-space moves at execution ("side-effect ops
+        cannot be replicated" from the SPMD partitioner). Flip to the
+        eager per-dispatch transfer once and rebuild the jitted fns."""
+        if not (self._offload_param and
+                getattr(self, "_injit_materialize", False)) or \
+                "annotate_device_placement" not in str(err):
+            return False
+        log_dist("ZeRO-3 param offload: backend rejected in-program "
+                 "streaming at execution; falling back to per-dispatch "
+                 "transfers", ranks=[0])
+        self._injit_materialize = False
+        self._grad_sh = self._grad_sh_dev
+        self._build_jitted_fns()
+        if hasattr(self, "_eval_fn"):
+            del self._eval_fn
+        return True
+
+    def _materialize_params(self, params):
+        """ZeRO-3 param offload, eager-fallback path: move the pinned-host
+        compute copy to HBM for one dispatch (reference fetch_sub_module,
+        partitioned_param_coordinator.py:218). The transfer is async; the
+        device buffers die with the dispatch's last use, so between steps
+        the chip holds no parameters. When `_injit_materialize` is set the
+        transfer happens inside the program instead and this is a no-op."""
+        if not self._offload_param or \
+                getattr(self, "_param_mat_sh", None) is None or \
+                getattr(self, "_injit_materialize", False):
+            return params
+        return jax.device_put(params, self._param_mat_sh)
+
     def _live_state(self):
         """The most recent state tree with live (non-donated) buffers.
 
@@ -628,8 +775,16 @@ class DeepSpeedEngine:
             # offload mode: grads ship to host in backward(), the host
             # optimizer applies in step() — the jit graph is fwd+bwd only
             scale = jnp.float32(self._offload.scaler.loss_scale)
-            loss, grads = self._micro_first(
-                self.state.params, scale, dev_batch, rng)
+            try:
+                loss, grads = self._micro_first(
+                    self._materialize_params(self.state.params), scale,
+                    dev_batch, rng)
+            except jax.errors.JaxRuntimeError as e:
+                if not self._fallback_to_eager_streaming(e):
+                    raise
+                loss, grads = self._micro_first(
+                    self._materialize_params(self.state.params), scale,
+                    dev_batch, rng)
             self._pending = ("offload", loss, grads)
             self.timers(FORWARD_GLOBAL_TIMER).stop()
             return loss
@@ -780,14 +935,18 @@ class DeepSpeedEngine:
                     [("Train/Samples/train_loss", mean_loss, self.global_samples)])
         return mean_loss
 
-    def eval_batch(self, batch):
+    def eval_batch(self, batch, _retried=False):
         """Loss-only forward (no grads)."""
         self._ensure_initialized(batch)
         if not hasattr(self, "_eval_fn"):
             loss_fn = self.loss_fn
             compute_dtype = self.compute_dtype
+            mat_sh = self._param_mat_sh \
+                if getattr(self, "_injit_materialize", False) else None
 
             def ev(params, batch):
+                if mat_sh is not None:
+                    params = jax.tree.map(jax.device_put, params, mat_sh)
                 p = jax.tree.map(
                     lambda x: x.astype(compute_dtype)
                     if x.dtype == jnp.float32 and compute_dtype != jnp.float32
@@ -795,7 +954,14 @@ class DeepSpeedEngine:
                 return loss_fn(p, batch, None)
 
             self._eval_fn = jax.jit(ev)
-        return self._eval_fn(self._live_state().params, self._put_batch(batch))
+        try:
+            return jax.block_until_ready(self._eval_fn(
+                self._materialize_params(self._live_state().params),
+                self._put_batch(batch)))
+        except jax.errors.JaxRuntimeError as e:
+            if _retried or not self._fallback_to_eager_streaming(e):
+                raise
+            return self.eval_batch(batch, _retried=True)
 
     # ------------------------------------------------------------------- io
     def deepspeed_io(self, dataset, collate_fn=None, route="train"):
@@ -840,6 +1006,14 @@ class DeepSpeedEngine:
             if host_optim is not None:
                 np.savez(os.path.join(path, "host_optim_states.npz"),
                          **host_optim)
+            if self._config.zero_config \
+                    .stage3_gather_16bit_weights_on_model_save:
+                # reference engine.py:754: emit one unpartitioned 16-bit
+                # weights file next to the sharded checkpoint (shard files
+                # are durable here — finalize runs after the barrier)
+                from deepspeed_tpu.checkpoint.engine import consolidate
+                consolidate(path, os.path.join(path, "weights_16bit.npz"),
+                            dtype=np.float16)
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as f:
                     f.write(str(tag))

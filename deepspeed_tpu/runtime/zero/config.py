@@ -11,7 +11,7 @@ Offload configs select the host-RAM / disk paths (ZeRO-Offload/Infinity).
 """
 
 from enum import Enum
-from typing import Optional
+from typing import ClassVar, Dict, Optional
 
 from pydantic import Field, model_validator
 
@@ -25,12 +25,25 @@ class OffloadDeviceEnum(str, Enum):
 
 
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """ZeRO-3 parameter offload (reference offload_config.py). On TPU the
+    at-rest compute copy lives in pinned host memory and streams to HBM
+    inside the jitted step; `device: nvme` additionally keeps the fp32
+    master + moments on NVMe (via the host optimizer tier)."""
     device: OffloadDeviceEnum = "none"
     nvme_path: Optional[str] = None
     buffer_count: int = Field(5, ge=0)
     buffer_size: int = Field(100_000_000, ge=0)
     max_in_cpu: int = Field(1_000_000_000, ge=0)
     pin_memory: bool = False
+
+    _inert_fields: ClassVar[Dict[str, str]] = {
+        "buffer_count": "XLA schedules the host->HBM streams; no staging "
+                        "buffer pool",
+        "buffer_size": "XLA schedules the host->HBM streams; no staging "
+                       "buffer pool",
+        "max_in_cpu": "the full compute copy lives in host memory",
+        "pin_memory": "the at-rest copy is always in pinned host memory",
+    }
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
@@ -42,13 +55,56 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
 
+    _inert_fields: ClassVar[Dict[str, str]] = {
+        "buffer_count": "NVMe moment IO is double-buffered (2 in flight)",
+        "pin_memory": "host buffers are plain numpy; the runtime DMAs "
+                      "from pageable memory",
+        "pipeline_read": "NVMe reads are always prefetched one leaf ahead",
+        "pipeline_write": "NVMe write-back is always async",
+        "fast_init": "master init is a device_get, already batched",
+    }
+
     @property
     def pipeline(self):
         return self.pipeline_read or self.pipeline_write
 
 
+_XLA_SCHED = ("XLA's latency-hiding scheduler decides gather/prefetch " \
+              "lifetime and bucketing under jit")
+
 class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     stage: int = Field(0, ge=0, le=3)
+
+    _inert_fields: ClassVar[Dict[str, str]] = {
+        "stage3_max_live_parameters": _XLA_SCHED,
+        "stage3_max_reuse_distance": _XLA_SCHED,
+        "stage3_prefetch_bucket_size": _XLA_SCHED,
+        "reduce_bucket_size": _XLA_SCHED,
+        "allgather_bucket_size": _XLA_SCHED,
+        "contiguous_gradients": "gradients are laid out by XLA",
+        "reduce_scatter": "grad partitioning is a sharding spec; XLA picks "
+                          "the collective",
+        "allgather_partitions": "param gathers are XLA-inserted",
+        "overlap_comm": _XLA_SCHED,
+        "legacy_stage1": "GPU-implementation detail",
+        "round_robin_gradients": "GPU-implementation detail",
+        "zero_hpz_partition_size": "ZeRO++ hierarchical partitioning is "
+                                   "not implemented",
+        "zero_quantized_weights": "ZeRO++ quantized weights are not "
+                                  "implemented",
+        "zero_quantized_gradients": "ZeRO++ quantized gradients are not "
+                                    "implemented (1-bit optimizers cover "
+                                    "compressed grad sync)",
+        "sub_group_size": "no sub-group flat buffers; params stay "
+                          "tree-structured",
+        "cpu_offload_use_pin_memory": "host buffers are plain numpy",
+        "ignore_unused_parameters": "jax autodiff produces zero grads for "
+                                    "unused params",
+        "elastic_checkpoint": "checkpoints are world-size-independent by "
+                              "construction",
+        "load_from_fp32_weights": "the fp32 master is always authoritative "
+                                  "when present",
+    }
 
     # Bucketing / overlap knobs exist for config compatibility; XLA's
     # latency-hiding scheduler supersedes manual bucketing on TPU.

@@ -20,13 +20,13 @@ from tests.unit.simple_model import (SimpleModel, random_regression_data,
                                      simple_loss_fn)
 
 
-def make_engine(mesh, zero_stage, devices=None):
+def make_engine(mesh, zero_stage, devices=None, **zero_extra):
     model = SimpleModel()
     cfg = {
         "train_micro_batch_size_per_gpu": 4,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "mesh": mesh,
-        "zero_optimization": {"stage": zero_stage},
+        "zero_optimization": {"stage": zero_stage, **zero_extra},
     }
     mesh_obj = None
     if devices is not None:
@@ -70,10 +70,32 @@ def test_sharded_layout_and_roundtrip(tmp_path):
     assert engine2.global_steps == engine.global_steps
 
 
+def test_gather_16bit_weights_on_model_save(tmp_path):
+    """stage3_gather_16bit_weights_on_model_save (reference engine.py:754)
+    emits one unpartitioned 16-bit weights file next to the shards."""
+    engine = make_engine({"data": 8}, zero_stage=3,
+                         stage3_gather_16bit_weights_on_model_save=True)
+    train(engine)
+    engine.save_checkpoint(str(tmp_path), tag="g16")
+    engine.wait_checkpoint()
+    f = os.path.join(str(tmp_path), "g16", "weights_16bit.npz")
+    assert os.path.exists(f)
+    with np.load(f) as z:
+        live = jax.device_get(engine.state.params)
+        flat, _ = jax.tree_util.tree_flatten_with_path(live)
+        for p, leaf in flat:
+            key = ".params" + jax.tree_util.keystr(p)
+            assert key in z.files, (key, z.files)
+            assert z[key].dtype == np.float16
+            np.testing.assert_allclose(z[key], np.asarray(leaf, np.float32),
+                                       rtol=2e-3, atol=2e-3)
+
+
 def test_chunks_are_shard_sized_not_full_arrays(tmp_path):
     """The save path must write per-device shards, never gather a
     zero-3-sharded leaf to one host buffer (VERDICT weak #6)."""
-    engine = make_engine({"data": 8}, zero_stage=3)
+    engine = make_engine({"data": 8}, zero_stage=3,
+                         stage3_param_persistence_threshold=0)
     train(engine)
     engine.save_checkpoint(str(tmp_path), tag="t")
     tag_dir = os.path.join(str(tmp_path), "t")

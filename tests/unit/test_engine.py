@@ -49,10 +49,28 @@ def test_loss_decreases_all_zero_stages(stage):
 
 
 def test_zero3_params_sharded_over_data():
-    engine = make_engine(base_config(zero_optimization={"stage": 3}))
+    engine = make_engine(base_config(zero_optimization={
+        "stage": 3, "stage3_param_persistence_threshold": 0}))
     train_steps(engine, n=1)
     specs = [l.sharding.spec for l in jax.tree.leaves(engine.state.params)]
     assert any("data" in str(s) for s in specs)
+
+
+def test_zero3_param_persistence_threshold():
+    """Leaves below the threshold stay replicated over the fsdp axis
+    (reference stage3_param_persistence_threshold semantics); larger
+    leaves still shard. SimpleModel kernels are 16x64 and 64x8."""
+    engine = make_engine(base_config(zero_optimization={
+        "stage": 3, "stage3_param_persistence_threshold": 600}))
+    train_steps(engine, n=1)
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
+    by_name = {jax.tree_util.keystr(p): l for p, l in flat}
+    for name, leaf in by_name.items():
+        sharded = "data" in str(leaf.sharding.spec)
+        if leaf.size >= 600:
+            assert sharded, (name, leaf.shape, leaf.sharding.spec)
+        else:
+            assert not sharded, (name, leaf.shape, leaf.sharding.spec)
 
 
 def test_zero1_opt_sharded_params_replicated():

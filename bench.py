@@ -31,21 +31,24 @@ def guess_peak(device):
     return 197e12
 
 
-def main():
+def run_config(gas, batch, seq, n_dev):
+    """Train GPT-2-small for a timed window; returns (tokens/s, loss).
+    gas>1 uses the engine's scan-fused window (one dispatch per
+    optimizer step), with micro = batch // gas so tokens/step is the
+    same in every configuration."""
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    micro = batch // gas
     cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
                     num_heads=12, max_seq_len=seq, dtype=jnp.bfloat16)
     model = GPT2(cfg)
-    n_dev = len(jax.devices())
     config = {
-        "train_micro_batch_size_per_gpu": batch,
-        "gradient_accumulation_steps": 1,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
                                                   "weight_decay": 0.01}},
         "bf16": {"enabled": True},
@@ -56,15 +59,17 @@ def main():
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
 
     rng = np.random.default_rng(0)
-    global_batch = batch * n_dev
-    batch_data = {"input_ids": rng.integers(
-        0, cfg.vocab_size, size=(global_batch, seq)).astype(np.int32)}
+    micros = [{"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(micro * n_dev, seq)).astype(np.int32)} for _ in range(gas)]
 
     def step():
-        loss = engine.forward(batch_data)
-        engine.backward(loss)
-        engine.step()
-        return loss
+        if gas == 1:
+            loss = engine.forward(micros[0])
+            engine.backward(loss)
+            engine.step()
+            return loss
+        return engine.train_batch(batches=micros, sync=False)
 
     def fence():
         # A host transfer of a value derived from the params cannot complete
@@ -85,28 +90,47 @@ def main():
     fence()
     dt = time.time() - t0
 
-    tokens_per_step = global_batch * seq
+    tokens_per_step = batch * n_dev * seq
     tokens_per_sec = tokens_per_step * n_steps / dt
-
+    loss = loss if isinstance(loss, float) else float(jax.device_get(loss))
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree.leaves(engine.state.params))
     # 6N per token (fwd+bwd) + attention term 12*L*hidden*seq
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_layers * cfg.hidden_size * seq
+    return tokens_per_sec, loss, flops_per_token
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    n_dev = len(jax.devices())
+    tokens_per_sec, loss, flops_per_token = run_config(1, batch, seq, n_dev)
+    gas4_tps, gas4_loss, _ = run_config(4, batch, seq, n_dev) \
+        if batch % 4 == 0 else (None, None, None)
+
     achieved = tokens_per_sec * flops_per_token
     peak = guess_peak(jax.devices()[0]) * n_dev
     mfu = achieved / peak
     vs_baseline = mfu / 0.40
 
+    extra = {"mfu": round(mfu, 4), "n_devices": n_dev,
+             "platform": jax.devices()[0].platform,
+             "device_kind": jax.devices()[0].device_kind,
+             "batch": batch * n_dev, "seq": seq,
+             "final_loss": loss}
+    if gas4_tps is not None:
+        extra["gas4_tokens_per_sec"] = round(gas4_tps, 1)
+        extra["gas4_over_gas1"] = round(gas4_tps / tokens_per_sec, 4)
+        extra["gas4_final_loss"] = gas4_loss
     print(json.dumps({
         "metric": "gpt2_small_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 4),
-        "extra": {"mfu": round(mfu, 4), "n_devices": n_dev,
-                  "platform": jax.devices()[0].platform,
-                  "device_kind": jax.devices()[0].device_kind,
-                  "batch": global_batch, "seq": seq,
-                  "final_loss": float(jax.device_get(loss))},
+        "extra": extra,
     }))
 
 

@@ -182,19 +182,33 @@ class HostOffloadOptimizer:
             self.moments = [self.opt.init_state(n) for n in sizes]
 
     def accumulate(self, host_grad_leaves):
-        """Add one micro-batch's grads (any float dtype) into the fp32
-        accumulators (reference async_accumulate_grad_in_cpu_via_gpu)."""
+        """Add one micro-batch's grads into the fp32 accumulators
+        (reference async_accumulate_grad_in_cpu_via_gpu). A leaf is
+        either a dense array or a row-sparse ``(indices, values)`` pair
+        (the engine's sparse_gradients embedding path — reference
+        SparseTensor + engine.py:2303): sparse pairs scatter-add into
+        the accumulator, so only touched rows crossed the link."""
         if self.acc is None:
-            self.acc = [_to_f32(g).reshape(-1).copy()
-                        for g in host_grad_leaves]
-        else:
-            for a, g in zip(self.acc, host_grad_leaves):
+            self.acc = [np.zeros(m.size, np.float32) for m in self.master]
+        for a, g, shape in zip(self.acc, host_grad_leaves, self.shapes):
+            if isinstance(g, tuple):
+                idx, vals = g
+                np.add.at(a.reshape(shape), np.asarray(idx),
+                          _to_f32(np.asarray(vals)))
+            else:
                 axpy(a, _to_f32(g).reshape(-1))
 
     # -------------------------------------------------------------- step
-    def step(self, lr):
-        """Unscale+clip+Adam over all leaves; returns (bf16 leaves,
-        metrics dict). Clears the accumulators."""
+    def step(self, lr, on_leaf=None):
+        """Unscale+clip+Adam over all leaves; returns (leaves, metrics).
+        Clears the accumulators.
+
+        ``on_leaf(i, bf16_leaf) -> result`` (optional) is called right
+        after each leaf's update, replacing that leaf in the returned
+        list with its result — the engine passes an async device_put so
+        the H2D of leaf i overlaps the host Adam of leaf i+1 (the
+        reference overlaps its CPU step with copy streams,
+        stage_1_and_2.py:1031)."""
         assert self.acc is not None, "no grads accumulated"
         scale = self.scaler.loss_scale
         overflow = any(has_inf_nan(a) for a in self.acc)
@@ -205,14 +219,15 @@ class HostOffloadOptimizer:
         if self.clip > 0.0 and gnorm > self.clip:
             clip_coef = self.clip / (gnorm + 1e-6)
 
-        bf16_leaves = []
+        emit = (lambda i, l: l) if on_leaf is None else on_leaf
+        leaves = []
         if overflow:
             self.skipped_steps += 1
             from deepspeed_tpu.ops.adam.cpu_adam import f32_to_bf16
-            for mstr, shape in zip(self.master, self.shapes):
-                bf16_leaves.append(f32_to_bf16(mstr).reshape(shape))
+            for i, (mstr, shape) in enumerate(zip(self.master, self.shapes)):
+                leaves.append(emit(i, f32_to_bf16(mstr).reshape(shape)))
             self.acc = None
-            return bf16_leaves, self._metrics(gnorm, overflow)
+            return leaves, self._metrics(gnorm, overflow)
 
         self.step_count += 1
         n = len(self.master)
@@ -231,7 +246,7 @@ class HostOffloadOptimizer:
             self.opt.step_flat(self.master[i], m, v, self.acc[i], lr=lr,
                                grad_scale=scale, clip_coef=clip_coef,
                                step=self.step_count, bf16_out=out)
-            bf16_leaves.append(out.reshape(self.shapes[i]))
+            leaves.append(emit(i, out.reshape(self.shapes[i])))
             if self.nvme is not None:
                 if pending_write is not None:
                     # bound in-flight buffers to one leaf (double buffer)
@@ -241,7 +256,7 @@ class HostOffloadOptimizer:
         if self.nvme is not None:
             self.nvme.flush()
         self.acc = None
-        return bf16_leaves, self._metrics(gnorm, overflow)
+        return leaves, self._metrics(gnorm, overflow)
 
     def _metrics(self, gnorm, overflow):
         return {"grad_norm": gnorm, "overflow": overflow,

@@ -149,6 +149,7 @@ class ThroughputTimer:
         self.step_elapsed_time = 0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
+        self._steps_since_report = 0
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -157,7 +158,12 @@ class ThroughputTimer:
     def start(self):
         self.started = True
         if self.global_step_count >= self.start_step:
-            _sync()
+            # sync only at a measurement-window edge: a device barrier
+            # per step would serialize the async dispatch queue (and on
+            # relayed devices costs a full host round trip per step);
+            # per-step wall deltas still sum to the true window time
+            if self.global_step_count == self.start_step:
+                _sync()
             self.start_time = time.time()
 
     def stop(self, global_step=False, report_speed=True):
@@ -168,22 +174,30 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _sync()
+            if global_step and \
+                    self.global_step_count % self.steps_per_output == 0:
+                _sync()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             self.start_time = 0
             if global_step:
+                self._steps_since_report += 1
                 if report_speed and \
                         self.global_step_count % self.steps_per_output == 0:
+                    # current rate over the whole window since the last
+                    # report: with sync only at window edges, a single
+                    # step's delta would absorb the async queue drain
+                    window = self.batch_size * self._steps_since_report
                     log_dist(
                         f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                         f"global_step={self.global_step_count}, "
                         f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
-                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.4f}",
+                        f"CurrSamplesPerSec={window / self.step_elapsed_time:.4f}",
                         ranks=[0])
-                self.step_elapsed_time = 0
+                    self.step_elapsed_time = 0
+                    self._steps_since_report = 0
 
     def avg_samples_per_sec(self):
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:

@@ -175,6 +175,110 @@ def test_offload_bf16_compute():
         assert leaf.dtype == jnp.bfloat16
 
 
+def test_offload_train_batch_gas_window():
+    """train_batch with gas>1 on an offload engine must take the
+    micro-dispatch path (host accumulation), including on the very first
+    call when the offload optimizer doesn't exist yet."""
+    engine = make_engine(offload_config(
+        "cpu", train_micro_batch_size_per_gpu=2,
+        gradient_accumulation_steps=2))
+    data = random_regression_data(n=32)
+    micros = [{k: v[:16] for k, v in data.items()},
+              {k: v[16:] for k, v in data.items()}]
+    losses = [engine.train_batch(batches=micros) for _ in range(4)]
+    assert all(isinstance(l, float) for l in losses)
+    assert losses[-1] < losses[0], losses
+    assert engine.global_steps == 4 and engine.micro_steps == 8
+
+
+def test_sparse_embedding_grads_match_dense():
+    """sparse_gradients ships embedding grads D2H as (touched rows,
+    values) — trajectory must match the dense path exactly (reference
+    SparseTensor + engine sparse_allreduce, engine.py:2303)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+
+    def mk(sparse):
+        # untied: a tied lm head would make wte's grad dense (the sparse
+        # path detects that case and raises)
+        model = GPT2(gpt2_tiny(vocab_size=512, hidden_size=32,
+                               num_layers=2, num_heads=2, max_seq_len=32,
+                               tie_embeddings=False))
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "sparse_gradients": sparse,
+            "mesh": {"data": 8},
+        }
+        e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        return e
+
+    rng = np.random.default_rng(0)
+    micros = [{"input_ids": rng.integers(0, 512, size=(16, 16))
+               .astype(np.int32)} for _ in range(2)]
+    # token id 0 MUST appear: nonzero()'s pad slots point at index 0,
+    # and an unmasked pad would scatter row 0's grad once per slot
+    micros[0]["input_ids"][:, 0] = 0
+    e_sp, e_dn = mk(True), mk(False)
+    for e in (e_sp, e_dn):
+        for _ in range(3):
+            for b in micros:
+                loss = e.forward(b)
+                e.backward(loss)
+                e.step()
+    # wte (512 vocab) + wpe leaves detected; 16*16=256 tokens < 512 rows
+    assert e_sp._sparse_positions, "no sparse leaves detected"
+    assert e_dn._sparse_positions is None
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a), np.float32),
+            np.asarray(jax.device_get(b), np.float32), rtol=1e-5,
+            atol=1e-6),
+        e_sp.state.params, e_dn.state.params)
+    # the wire format is actually sparse: the jitted micro dispatch
+    # returns (idx, rows) pairs for the embedding leaves
+    b = micros[0]
+    loss, leaves = e_sp._micro_offload(
+        e_sp.state.params, jnp.float32(1.0), e_sp._put_batch(b),
+        jax.random.PRNGKey(0))
+    kinds = [isinstance(l, tuple) for l in leaves]
+    assert any(kinds)
+    for l in leaves:
+        if isinstance(l, tuple):
+            idx, vals, n_touched = l
+            assert idx.shape[0] == vals.shape[0] <= 256
+            assert int(n_touched) <= idx.shape[0]
+
+
+def test_sparse_gradients_dense_grad_raises():
+    """A tied-embedding model routes head gradient into wte: the sparse
+    path must fail loudly, never truncate silently."""
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+    model = GPT2(gpt2_tiny(vocab_size=64, hidden_size=32, num_layers=1,
+                           num_heads=2, max_seq_len=32,
+                           tie_embeddings=True))
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+        "sparse_gradients": True,
+        "mesh": {"data": 8},
+    }
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    # 32 tokens < 64 vocab rows, so the sparse path engages; the tied
+    # head still produces dense wte grad -> loud failure
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, size=(16, 2)).astype(np.int32)}
+    loss = e.forward(batch)
+    e.backward(loss)
+    with pytest.raises(RuntimeError, match="sparse_gradients"):
+        e.step()
+
+
 # ---------------------------------------------------- ZeRO-3 param offload
 def param_offload_config(**over):
     cfg = offload_config("cpu", zero_optimization={

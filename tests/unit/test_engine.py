@@ -90,6 +90,39 @@ def test_zero0_everything_replicated():
         assert "data" not in str(l.sharding.spec)
 
 
+def test_fused_gas_window_matches_micro_dispatches():
+    """train_batch's scan-fused single-dispatch window must reproduce the
+    forward/backward/step micro-dispatch trajectory exactly (same fp32
+    accumulation, same boundary apply)."""
+    gas = 4
+    cfg = base_config(
+        train_micro_batch_size_per_gpu=2, gradient_accumulation_steps=gas,
+        zero_optimization={"stage": 2})
+    data = random_regression_data(n=64)
+    micros = [{k: v[i * 16:(i + 1) * 16] for k, v in data.items()}
+              for i in range(gas)]
+
+    e_fused = make_engine(cfg)
+    e_micro = make_engine(cfg)
+    fused_losses, micro_losses = [], []
+    for _ in range(3):
+        fused_losses.append(e_fused.train_batch(batches=micros))
+        window = []
+        for b in micros:
+            loss = e_micro.forward(b)
+            e_micro.backward(loss)
+            window.append(float(jax.device_get(loss)))
+        e_micro.step()
+        micro_losses.append(float(np.mean(window)))
+    np.testing.assert_allclose(fused_losses, micro_losses, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), rtol=1e-5, atol=1e-6),
+        e_fused.state.params, e_micro.state.params)
+    assert e_fused.global_steps == e_micro.global_steps == 3
+    assert e_fused.micro_steps == e_micro.micro_steps == 12
+
+
 def test_gradient_accumulation():
     engine = make_engine(base_config(gradient_accumulation_steps=2,
                                      train_batch_size=64))

@@ -34,7 +34,8 @@ def main():
     p.add_argument("--warmups", type=int, default=2)
     p.add_argument("--dtype", default="float32")
     p.add_argument("--ops", default="all_reduce,all_gather,reduce_scatter,"
-                                    "all_to_all,ppermute")
+                                    "all_to_all,ppermute,"
+                                    "compressed_allreduce")
     p.add_argument("--json", default=None)
     args = p.parse_args()
 
@@ -59,6 +60,18 @@ def main():
           f"platform={jax.default_backend()}", file=sys.stderr)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def compressed(x):
+        # 1-bit error-feedback allreduce (runtime/comm/compressed.py):
+        # sign bits + one scale per phase on the wire
+        from deepspeed_tpu.runtime.comm.compressed import \
+            compressed_allreduce
+        we = jnp.zeros_like(x)
+        pad = (-x.size) % (n * 8)
+        se = jnp.zeros((x.size + pad) // n, x.dtype)
+        out, _, _ = compressed_allreduce(x, we, se, ax)
+        return out
+
     OPS = {
         "all_reduce": lambda x: lax.psum(x, ax),
         "all_gather": lambda x: lax.all_gather(x, ax, tiled=True),
@@ -66,6 +79,7 @@ def main():
         "all_to_all": lambda x: lax.all_to_all(
             x.reshape(n, -1), ax, 0, 0, tiled=False).reshape(-1),
         "ppermute": lambda x: lax.ppermute(x, ax, perm),
+        "compressed_allreduce": compressed,
     }
     results = []
     for op_name in args.ops.split(","):
@@ -88,11 +102,26 @@ def main():
                     times.append(dt)
             lat = float(np.median(times))
             # calc_bw_log expects the per-rank message size
-            _, algbw, busbw = calc_bw_log(op_name, size // max(n, 1),
-                                          lat, n=n)
+            _, algbw, busbw = calc_bw_log(
+                "all_reduce" if op_name == "compressed_allreduce"
+                else op_name, size // max(n, 1), lat, n=n)
             row = {"op": op_name, "bytes": size, "latency_ms":
                    round(lat * 1e3, 4), "algbw_gbps": round(algbw, 3),
                    "busbw_gbps": round(busbw, 3), "n": n}
+            if op_name == "compressed_allreduce":
+                # bytes-on-wire per rank: each rank quantizes its LOCAL
+                # shard (eager_collective splits dim 0 over the axis) and
+                # ships sign bits in both phases (all_to_all out +
+                # all_gather back) + n scales, vs 2*(n-1)/n * shard for a
+                # ring allreduce at this dtype
+                shard = elems // max(n, 1)
+                wire = 2 * (shard // 8) + 2 * n * dtype.itemsize
+                row["wire_bytes_per_rank"] = wire
+                row["uncompressed_allreduce_wire_bytes"] = int(
+                    2 * (n - 1) / n * shard * dtype.itemsize)
+                if n > 1:   # ratio undefined on a single device
+                    row["compression_x"] = round(
+                        row["uncompressed_allreduce_wire_bytes"] / wire, 2)
             results.append(row)
             print(json.dumps(row))
             size <<= 2

@@ -1,0 +1,151 @@
+"""Elastic agent: monitor workers, re-rendezvous on failure, resume.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py:28`` (``DSElasticAgent``
+subclassing torch.elastic's ``LocalElasticAgent``: rendezvous + worker
+monitoring + restart with DS env injected) and the elastic branch of
+``launcher/launch.py``.
+
+TPU shape: one agent per node supervises the node's worker processes.
+On any worker failure the agent tears the group down (a jax.distributed
+collective cannot survive a lost participant), picks a fresh coordinator
+port, and relaunches every worker with ``DS_ELASTIC_RESTART_COUNT``
+bumped. Recovery of *state* is checkpoint-based (SURVEY §5.3: the real
+fault-tolerance story): training scripts call ``load_checkpoint`` at
+startup, which no-ops on the first launch (no ``latest`` yet) and
+resumes after a restart.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class DSElasticAgent:
+    """Supervise one node's workers with restart-on-failure.
+
+    Args mirror the per-node launcher (launch.py): the agent owns worker
+    spawning so it can re-rendezvous the whole group on a new port.
+    """
+
+    def __init__(self, training_script, script_args=(), num_workers=1,
+                 num_nodes=1, node_rank=0, master_addr="127.0.0.1",
+                 master_port=None, max_restarts=3, monitor_interval=0.25,
+                 force_cpu_devices=0):
+        self.training_script = training_script
+        self.script_args = list(script_args)
+        self.num_workers = num_workers
+        self.num_nodes = num_nodes
+        self.node_rank = node_rank
+        self.master_addr = master_addr
+        self.master_port = master_port or _free_port()
+        self.max_restarts = max_restarts
+        self.monitor_interval = monitor_interval
+        self.force_cpu_devices = force_cpu_devices
+        self.restart_count = 0
+        self._procs = []
+
+    # ----------------------------------------------------------- workers
+    def _spawn(self):
+        world_size = self.num_nodes * self.num_workers
+        self._procs = []
+        for local_rank in range(self.num_workers):
+            rank = self.node_rank * self.num_workers + local_rank
+            env = os.environ.copy()
+            env.update({
+                "COORDINATOR_ADDRESS":
+                    f"{self.master_addr}:{self.master_port}",
+                "NUM_PROCESSES": str(world_size),
+                "PROCESS_ID": str(rank),
+                "RANK": str(rank),
+                "LOCAL_RANK": str(local_rank),
+                "WORLD_SIZE": str(world_size),
+                "MASTER_ADDR": self.master_addr,
+                "MASTER_PORT": str(self.master_port),
+                "DS_ELASTIC_RESTART_COUNT": str(self.restart_count),
+            })
+            if self.force_cpu_devices:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "") +
+                    " --xla_force_host_platform_device_count="
+                    f"{self.force_cpu_devices}")
+            cmd = [sys.executable, self.training_script] + self.script_args
+            self._procs.append(subprocess.Popen(cmd, env=env))
+        logger.info(f"elastic agent: spawned {self.num_workers} workers "
+                    f"(attempt {self.restart_count}, "
+                    f"port {self.master_port})")
+
+    def _terminate(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def _monitor(self):
+        """Block until the group finishes or a worker dies. Returns
+        ("ok", 0) | ("failed", rc)."""
+        while True:
+            states = [p.poll() for p in self._procs]
+            if any(rc is not None and rc != 0 for rc in states):
+                bad = next(rc for rc in states if rc is not None and rc != 0)
+                return "failed", bad
+            if all(rc == 0 for rc in states):
+                return "ok", 0
+            time.sleep(self.monitor_interval)
+
+    # --------------------------------------------------------------- run
+    def run(self):
+        """Supervise until success or restart budget exhausted; returns
+        the exit code (0 = the whole group finished cleanly)."""
+        if self.num_nodes > 1:
+            # a re-rendezvous after failure needs every node's agent to
+            # agree on the new coordinator port; without a cross-node
+            # control channel the surviving nodes would keep waiting on
+            # the old port forever
+            raise ValueError(
+                "elastic restart currently supports single-node groups; "
+                "multi-node recovery needs an external supervisor that "
+                "relaunches all nodes (e.g. the pod scheduler)")
+        handled = {}
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            handled[sig] = signal.signal(
+                sig, lambda s, f: (self._terminate(), sys.exit(128 + s)))
+        try:
+            while True:
+                self._spawn()
+                state, rc = self._monitor()
+                if state == "ok":
+                    return 0
+                logger.warning(
+                    f"elastic agent: worker failed (rc={rc}) on attempt "
+                    f"{self.restart_count}")
+                self._terminate()
+                if self.restart_count >= self.max_restarts:
+                    logger.error(
+                        f"elastic agent: restart budget "
+                        f"({self.max_restarts}) exhausted")
+                    return rc
+                self.restart_count += 1
+                # a fresh port forces a clean re-rendezvous: the old
+                # coordinator's listening socket dies with its process
+                self.master_port = _free_port()
+        finally:
+            for sig, old in handled.items():
+                signal.signal(sig, old)

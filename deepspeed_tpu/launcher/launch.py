@@ -31,6 +31,10 @@ def parse_args(args=None):
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument("--force_cpu_devices", type=int, default=0,
                    help="virtual CPU devices per process (CI)")
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers with restart-on-failure "
+                        "(reference elastic_agent.py)")
+    p.add_argument("--max_elastic_restarts", type=int, default=3)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(args)
@@ -38,6 +42,16 @@ def parse_args(args=None):
 
 def main(args=None):
     args = parse_args(args)
+    if args.elastic:
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+        agent = DSElasticAgent(
+            args.training_script, args.training_script_args,
+            num_workers=args.num_workers, num_nodes=args.num_nodes,
+            node_rank=args.node_rank, master_addr=args.master_addr,
+            master_port=args.master_port,
+            max_restarts=args.max_elastic_restarts,
+            force_cpu_devices=args.force_cpu_devices)
+        sys.exit(agent.run())
     world_size = args.num_nodes * args.num_workers
     procs = []
 

@@ -128,18 +128,18 @@ class Bert(nn.Module):
         wpe = self.param("position_embeddings", nn.with_partitioning(
             nn.initializers.normal(0.02), ("seq", "embed")),
             (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-        wtt = self.param("token_type_embeddings", nn.with_partitioning(
-            nn.initializers.normal(0.02), (None, "embed")),
-            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte_v = wte.value if hasattr(wte, "value") else wte
         wpe_v = wpe.value if hasattr(wpe, "value") else wpe
-        wtt_v = wtt.value if hasattr(wtt, "value") else wtt
-
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
         x = (wte_v.astype(cfg.dtype)[input_ids] +
-             wpe_v.astype(cfg.dtype)[jnp.arange(l)][None] +
-             wtt_v.astype(cfg.dtype)[token_type_ids])
+             wpe_v.astype(cfg.dtype)[jnp.arange(l)][None])
+        if cfg.type_vocab_size > 0:   # DistilBERT has no segment table
+            wtt = self.param("token_type_embeddings", nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+            wtt_v = wtt.value if hasattr(wtt, "value") else wtt
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + wtt_v.astype(cfg.dtype)[token_type_ids]
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_embed")(x)
 

@@ -59,6 +59,11 @@ class GPTConfig:
     parallel_residual: bool = False    # x + attn(ln1 x) + mlp(...) (J/NeoX)
     single_ln: bool = False            # GPT-J: mlp reads ln_1's output
     attn_bias: Optional[bool] = None   # GPT-J: no attn biases; default use_bias
+    qkv_bias: Optional[bool] = None    # GPT-Neo: qkv unbiased, proj biased
+    # per-layer local-attention windows (GPT-Neo "global"/"local"
+    # alternation): entry i is layer i's window size, 0 = full causal.
+    # Empty = all global.
+    attn_windows: tuple = ()
     lm_head_bias: bool = False         # GPT-J lm_head carries a bias
     # MoE (reference deepspeed/moe): every `moe_every`-th block swaps its MLP
     # for a sharded MoE layer
@@ -103,14 +108,16 @@ def alibi_slopes(num_heads):
 
 class SelfAttention(nn.Module):
     cfg: GPTConfig
+    window: int = 0   # >0: local sliding-window causal attention
 
     @nn.compact
     def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
         b, l, _ = x.shape
         attn_bias = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
+        qkv_bias = attn_bias if cfg.qkv_bias is None else cfg.qkv_bias
         qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv",
-                     use_bias=attn_bias)(x)
+                     use_bias=qkv_bias)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
@@ -142,6 +149,9 @@ class SelfAttention(nn.Module):
             max_len = k_cache.shape[1]
             k_pos = jnp.arange(max_len)
             mask = k_pos[None, None, :] <= positions[:, :, None]  # [b,l,max]
+            if self.window > 0:
+                mask &= k_pos[None, None, :] > \
+                    positions[:, :, None] - self.window
             bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)[:, None]
             if cfg.use_alibi:
                 # softmax is shift-invariant per query row, so
@@ -150,6 +160,15 @@ class SelfAttention(nn.Module):
                                * k_pos[None, None, None, :])
             from deepspeed_tpu.ops.attention import decode_attention
             out = decode_attention(q, k_cache, v_cache, bias=bias)
+        elif self.window > 0:
+            # local sliding-window causal attention (GPT-Neo "local"):
+            # query attends to keys in (q_pos - window, q_pos]
+            q_pos = jnp.arange(l)[:, None]
+            k_pos = jnp.arange(l)[None, :]
+            mask = (k_pos <= q_pos) & (k_pos > q_pos - self.window)
+            bias = jnp.where(mask, 0.0,
+                             jnp.finfo(jnp.float32).min)[None, None]
+            out = mha_reference(q, k, v, causal=False, bias=bias)
         elif cfg.use_alibi:
             k_pos = jnp.arange(l)
             bias = (alibi_slopes(cfg.num_heads)[None, :, None, None] *
@@ -202,13 +221,14 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: GPTConfig
     use_moe: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, deterministic=True, cache=None, positions=None):
         cfg = self.cfg
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            name="ln_1")(x)
-        attn_out, new_cache = SelfAttention(cfg, name="attn")(
+        attn_out, new_cache = SelfAttention(cfg, self.window, name="attn")(
             ln1, deterministic, cache, positions)
         if cfg.parallel_residual:
             # GPT-J / GPT-NeoX: attn and mlp branch from the same input;
@@ -305,6 +325,8 @@ class GPT2(nn.Module):
         if cfg.scan_layers and cache is None:
             assert cfg.moe_num_experts <= 1, \
                 "scan_layers cannot interleave MoE blocks (heterogeneous)"
+            assert not any(cfg.attn_windows), \
+                "scan_layers needs homogeneous layers (no local windows)"
             # one scanned block: params stack to [num_layers, ...] leaves
             # ('layers' logical axis). With the stacked leaves in host
             # memory (ZeRO-3 param offload) XLA's scan streams one layer
@@ -330,8 +352,9 @@ class GPT2(nn.Module):
             for i in range(cfg.num_layers):
                 use_moe = (cfg.moe_num_experts > 1 and
                            i % cfg.moe_every == cfg.moe_every - 1)
+                win = cfg.attn_windows[i] if i < len(cfg.attn_windows) else 0
                 layer_cache = cache["layers"][i] if cache is not None else None
-                x, new_c = block(cfg, use_moe, name=f"h_{i}")(
+                x, new_c = block(cfg, use_moe, win, name=f"h_{i}")(
                     x, deterministic, layer_cache, positions)
                 new_layer_caches.append(new_c)
 

@@ -338,6 +338,144 @@ class GPTNeoXPolicy(InjectionPolicy):
         return p
 
 
+class GPTNeoPolicy(InjectionPolicy):
+    """HF GPTNeoForCausalLM (reference containers/gptneo.py:
+    HFGPTNEOLayerPolicy). Alternating global/local (sliding-window)
+    attention per ``attention_types``; separate unbiased q/k/v with a
+    biased out_proj. GPT-Neo was trained WITHOUT the 1/sqrt(head_dim)
+    attention scale, so convert() pre-scales the q projection by
+    sqrt(head_dim) to cancel the native module's scaling."""
+
+    model_type = "gpt_neo"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        inter = getattr(c, "intermediate_size", None) or 4 * c.hidden_size
+        assert inter % c.hidden_size == 0
+        # expand attention_types ([["global","local"], n/2] pairs) into
+        # the per-layer window tuple
+        pattern = []
+        for kinds, times in c.attention_types:
+            pattern += list(kinds) * times
+        windows = tuple(c.window_size if k == "local" else 0
+                        for k in pattern)
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_layers, num_heads=c.num_heads,
+            max_seq_len=c.max_position_embeddings,
+            mlp_ratio=inter // c.hidden_size,
+            layer_norm_eps=c.layer_norm_epsilon,
+            activation="gelu",            # gelu_new
+            qkv_bias=False, attn_windows=windows,
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        p = {"wte": _np(sd["transformer.wte.weight"]),
+             "wpe": _np(sd["transformer.wpe.weight"]),
+             "ln_f": {"scale": _np(sd["transformer.ln_f.weight"]),
+                      "bias": _np(sd["transformer.ln_f.bias"])}}
+        head_dim = hf_config.hidden_size // hf_config.num_heads
+        # HF GPT-Neo attention does NOT divide scores by sqrt(head_dim);
+        # fold the compensation into the q projection
+        q_scale = float(np.sqrt(head_dim))
+        for i in range(hf_config.num_layers):
+            h = f"transformer.h.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[h + f"attn.attention.{n}_proj.weight"]) *
+                 (q_scale if n == "q" else 1.0)
+                 for n in ("q", "k", "v")], axis=1).astype(np.float32)
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "ln_1.weight"]),
+                         "bias": _np(sd[h + "ln_1.bias"])},
+                "ln_2": {"scale": _np(sd[h + "ln_2.weight"]),
+                         "bias": _np(sd[h + "ln_2.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w},
+                    "proj": {
+                        "kernel": _t(sd[h + "attn.attention.out_proj.weight"]),
+                        "bias": _np(sd[h + "attn.attention.out_proj.bias"])}},
+                "mlp": {
+                    "fc_in": {"kernel": _t(sd[h + "mlp.c_fc.weight"]),
+                              "bias": _np(sd[h + "mlp.c_fc.bias"])},
+                    "fc_out": {"kernel": _t(sd[h + "mlp.c_proj.weight"]),
+                               "bias": _np(sd[h + "mlp.c_proj.bias"])}},
+            }
+        return p
+
+
+class MegatronGPT2Policy(InjectionPolicy):
+    """Megatron-LM GPT-2 checkpoints (reference containers/megatron_gpt.py:
+    MegatronLayerPolicy). Matched by the Megatron state-dict key layout
+    (``language_model.transformer.layers.N.*``) rather than an HF
+    model_type; the fused query_key_value is head-interleaved like BLOOM."""
+
+    model_type = "megatron-lm"
+
+    @classmethod
+    def matches(cls, hf_config):
+        return getattr(hf_config, "model_type", None) in (
+            "megatron-lm", "megatron_gpt2", "megatron")
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        inter = getattr(c, "ffn_hidden_size", None) or 4 * c.hidden_size
+        assert inter % c.hidden_size == 0
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            mlp_ratio=inter // c.hidden_size,
+            layer_norm_eps=getattr(c, "layernorm_epsilon", 1e-5),
+            activation="gelu",
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        lm = "language_model."
+        if lm + "embedding.word_embeddings.weight" not in sd and \
+                "embedding.word_embeddings.weight" in sd:
+            lm = ""
+        e = lm + "embedding."
+        t = lm + "transformer."
+        p = {"wte": _np(sd[e + "word_embeddings.weight"]),
+             "wpe": _np(sd[e + "position_embeddings.weight"]),
+             "ln_f": {"scale": _np(sd[t + "final_layernorm.weight"]),
+                      "bias": _np(sd[t + "final_layernorm.bias"])}}
+        for i in range(hf_config.num_layers):
+            h = f"{t}layers.{i}."
+            qkv_w, qkv_b = BloomPolicy._split_qkv(
+                _np(sd[h + "attention.query_key_value.weight"]),
+                _np(sd[h + "attention.query_key_value.bias"]),
+                hf_config.num_attention_heads)
+            p[f"h_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "input_layernorm.weight"]),
+                         "bias": _np(sd[h + "input_layernorm.bias"])},
+                "ln_2": {
+                    "scale": _np(sd[h + "post_attention_layernorm.weight"]),
+                    "bias": _np(sd[h + "post_attention_layernorm.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {"kernel": _t(sd[h + "attention.dense.weight"]),
+                             "bias": _np(sd[h + "attention.dense.bias"])}},
+                "mlp": {
+                    "fc_in": {
+                        "kernel": _t(sd[h + "mlp.dense_h_to_4h.weight"]),
+                        "bias": _np(sd[h + "mlp.dense_h_to_4h.bias"])},
+                    "fc_out": {
+                        "kernel": _t(sd[h + "mlp.dense_4h_to_h.weight"]),
+                        "bias": _np(sd[h + "mlp.dense_4h_to_h.bias"])}},
+            }
+        return p
+
+
 class LlamaPolicy(InjectionPolicy):
     """HF LlamaForCausalLM (the reference gained containers/llama.py in
     later snapshots; built natively here). Rotary convention (rotate-half,
@@ -384,6 +522,184 @@ class LlamaPolicy(InjectionPolicy):
                     "w_gate": {"kernel": _t(sd[h + "mlp.gate_proj.weight"])},
                     "w_up": {"kernel": _t(sd[h + "mlp.up_proj.weight"])},
                     "w_down": {"kernel": _t(sd[h + "mlp.down_proj.weight"])}},
+            }
+        return p
+
+
+class AutoTPPolicy(InjectionPolicy):
+    """Generic fallback for unknown decoder-only architectures
+    (reference ``module_inject/auto_tp.py:13`` — discover the linear
+    layout instead of requiring a hand-written container). Recognizes
+    the llama-shaped decoder by state-dict structure — per-layer
+    q/k/v/o projections, gate/up/down MLP, RMS norms — whatever the HF
+    class is (Mistral, and other llama-family derivatives). TP then
+    falls out of the native module's logical axes like every policy."""
+
+    model_type = None   # never matched by model_type; from_hf falls back
+
+    _LAYER_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                   "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                   "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                   "mlp.down_proj.weight", "input_layernorm.weight",
+                   "post_attention_layernorm.weight")
+
+    @classmethod
+    def discover(cls, sd):
+        """Return the decoder prefix (e.g. 'model.') when `sd` has the
+        llama-shaped layout, else None."""
+        for key in sd:
+            if key.endswith("layers.0.self_attn.q_proj.weight"):
+                prefix = key[:-len("layers.0.self_attn.q_proj.weight")]
+                if all(f"{prefix}layers.0.{k}" in sd
+                       for k in cls._LAYER_KEYS) and \
+                        f"{prefix}embed_tokens.weight" in sd and \
+                        f"{prefix}norm.weight" in sd:
+                    return prefix
+        return None
+
+    @classmethod
+    def ingest(cls, hf_config, sd, dtype=jnp.float32):
+        """(module, params) for a discovered llama-shaped decoder."""
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        prefix = cls.discover(sd)
+        if prefix is None:
+            raise ValueError(
+                "AutoTP fallback: state dict is not a recognizable "
+                "llama-shaped decoder (need per-layer "
+                "self_attn.{q,k,v,o}_proj + mlp.{gate,up,down}_proj + "
+                "input/post_attention layernorms)")
+        biased = [k for k in sd
+                  if "layers.0." in k and k.endswith("proj.bias")]
+        if biased:
+            raise ValueError(
+                f"AutoTP fallback: biased projections {biased[:3]} need "
+                "a hand-written policy (the native llama module is "
+                "bias-free)")
+        c = hf_config
+        # attention-semantics configs the plain llama module cannot
+        # honor must fail loudly, not silently diverge
+        if getattr(c, "sliding_window", None):
+            raise ValueError(
+                "AutoTP fallback: config.sliding_window="
+                f"{c.sliding_window} — windowed attention needs a "
+                "hand-written policy (set sliding_window=None only if "
+                "your sequences never exceed the window)")
+        if getattr(c, "rope_scaling", None):
+            raise ValueError(
+                "AutoTP fallback: config.rope_scaling is set — scaled "
+                "rope needs a hand-written policy")
+        n_layers = 1 + max(
+            int(k[len(prefix) + len("layers."):].split(".")[0])
+            for k in sd if k.startswith(prefix + "layers."))
+        hidden = sd[prefix + "embed_tokens.weight"].shape[1]
+        n_heads = getattr(c, "num_attention_heads")
+        kv_dim = sd[prefix + "layers.0.self_attn.k_proj.weight"].shape[0]
+        head_dim = hidden // n_heads
+        tie = getattr(c, "tie_word_embeddings", False) or \
+            "lm_head.weight" not in sd
+        cfg = LlamaConfig(
+            vocab_size=sd[prefix + "embed_tokens.weight"].shape[0],
+            hidden_size=hidden,
+            num_layers=n_layers, num_heads=n_heads,
+            num_kv_heads=kv_dim // head_dim,
+            intermediate_size=sd[
+                prefix + "layers.0.mlp.gate_proj.weight"].shape[0],
+            max_seq_len=getattr(c, "max_position_embeddings", 2048),
+            rope_base=getattr(c, "rope_theta", 10000.0),
+            rms_eps=getattr(c, "rms_norm_eps", 1e-6),
+            tie_embeddings=tie, dtype=dtype, param_dtype=dtype)
+        module = Llama(cfg)
+
+        p = {"embed_tokens": _np(sd[prefix + "embed_tokens.weight"]),
+             "norm": {"scale": _np(sd[prefix + "norm.weight"])}}
+        if not tie:
+            p["lm_head"] = {"kernel": _t(sd["lm_head.weight"])}
+        for i in range(n_layers):
+            h = f"{prefix}layers.{i}."
+            p[f"layers_{i}"] = {
+                "input_norm": {
+                    "scale": _np(sd[h + "input_layernorm.weight"])},
+                "post_attn_norm": {
+                    "scale":
+                        _np(sd[h + "post_attention_layernorm.weight"])},
+                "attn": {
+                    "wq": {"kernel": _t(sd[h + "self_attn.q_proj.weight"])},
+                    "wk": {"kernel": _t(sd[h + "self_attn.k_proj.weight"])},
+                    "wv": {"kernel": _t(sd[h + "self_attn.v_proj.weight"])},
+                    "wo": {"kernel": _t(sd[h + "self_attn.o_proj.weight"])}},
+                "mlp": {
+                    "w_gate": {"kernel": _t(sd[h + "mlp.gate_proj.weight"])},
+                    "w_up": {"kernel": _t(sd[h + "mlp.up_proj.weight"])},
+                    "w_down": {"kernel": _t(sd[h + "mlp.down_proj.weight"])}},
+            }
+        return module, p
+
+
+class DistilBertPolicy(InjectionPolicy):
+    """HF DistilBertForMaskedLM (reference containers/distil_bert.py:
+    HFDistilBertLayerPolicy). BERT encoder minus segment embeddings and
+    pooler; MLM head = vocab_transform + vocab_layer_norm + tied
+    projector with a bias."""
+
+    model_type = "distilbert"
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.bert import Bert, BertConfig
+        c = hf_config
+        if getattr(c, "sinusoidal_pos_embds", False):
+            raise ValueError("sinusoidal_pos_embds DistilBERT variants "
+                             "are not supported (learned positions only)")
+        cfg = BertConfig(
+            vocab_size=c.vocab_size, hidden_size=c.dim,
+            num_layers=c.n_layers, num_heads=c.n_heads,
+            intermediate_size=c.hidden_dim,
+            max_seq_len=c.max_position_embeddings,
+            type_vocab_size=0,                # no segment table
+            layer_norm_eps=1e-12,
+            pre_layer_norm=False,
+            activation="gelu_exact" if c.activation == "gelu" else "gelu",
+            mlm_bias=True, dtype=dtype, param_dtype=dtype)
+        return Bert(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        e = "distilbert.embeddings."
+        p = {"word_embeddings": _np(sd[e + "word_embeddings.weight"]),
+             "position_embeddings":
+                 _np(sd[e + "position_embeddings.weight"]),
+             "ln_embed": {"scale": _np(sd[e + "LayerNorm.weight"]),
+                          "bias": _np(sd[e + "LayerNorm.bias"])},
+             "mlm_transform": {
+                 "kernel": _t(sd["vocab_transform.weight"]),
+                 "bias": _np(sd["vocab_transform.bias"])},
+             "mlm_ln": {"scale": _np(sd["vocab_layer_norm.weight"]),
+                        "bias": _np(sd["vocab_layer_norm.bias"])},
+             "mlm_decoder_bias": _np(sd["vocab_projector.bias"])}
+        for i in range(hf_config.n_layers):
+            h = f"distilbert.transformer.layer.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[h + f"attention.{n}_lin.weight"])
+                 for n in ("q", "k", "v")], axis=1)
+            qkv_b = np.concatenate(
+                [_np(sd[h + f"attention.{n}_lin.bias"])
+                 for n in ("q", "k", "v")])
+            p[f"layer_{i}"] = {
+                "attn": {
+                    "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                    "proj": {
+                        "kernel": _t(sd[h + "attention.out_lin.weight"]),
+                        "bias": _np(sd[h + "attention.out_lin.bias"])}},
+                "ln_attn": {
+                    "scale": _np(sd[h + "sa_layer_norm.weight"]),
+                    "bias": _np(sd[h + "sa_layer_norm.bias"])},
+                "ln_mlp": {
+                    "scale": _np(sd[h + "output_layer_norm.weight"]),
+                    "bias": _np(sd[h + "output_layer_norm.bias"])},
+                "fc_in": {"kernel": _t(sd[h + "ffn.lin1.weight"]),
+                          "bias": _np(sd[h + "ffn.lin1.bias"])},
+                "fc_out": {"kernel": _t(sd[h + "ffn.lin2.weight"]),
+                           "bias": _np(sd[h + "ffn.lin2.bias"])},
             }
         return p
 

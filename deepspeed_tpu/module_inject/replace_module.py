@@ -92,9 +92,22 @@ def from_hf(model_or_path, dtype=jnp.float32, hf_config=None):
               for k, v in model_or_path.state_dict().items()}
 
     from deepspeed_tpu.module_inject.replace_policy import policy_for
-    pol = policy_for(cfg)
-    module = pol.build_module(cfg, dtype=dtype)
-    params = pol.convert(cfg, sd)
+    try:
+        pol = policy_for(cfg)
+        module = pol.build_module(cfg, dtype=dtype)
+        params = pol.convert(cfg, sd)
+    except ValueError as policy_err:
+        # generic structural fallback (reference auto_tp.py:13): unknown
+        # architectures whose state dict is a llama-shaped decoder
+        from deepspeed_tpu.module_inject.policy import AutoTPPolicy
+        if AutoTPPolicy.discover(sd) is None:
+            raise policy_err
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            f"no policy for model_type="
+            f"{getattr(cfg, 'model_type', None)!r}; using the AutoTP "
+            "structural fallback (llama-shaped decoder discovered)")
+        module, params = AutoTPPolicy.ingest(cfg, sd, dtype=dtype)
     params = jax.tree.map(lambda x: np.asarray(x, jnp.dtype(dtype)), params)
 
     # shape/dtype template with Partitioned metadata, no real compute

@@ -1,13 +1,18 @@
 """Policy registry (reference ``module_inject/replace_policy.py`` —
 ``replace_policies``/``generic_policies`` lists)."""
 
-from deepspeed_tpu.module_inject.policy import (BertPolicy, BloomPolicy,
-                                                GPT2Policy, GPTJPolicy,
-                                                GPTNeoXPolicy, LlamaPolicy,
+from deepspeed_tpu.module_inject.policy import (AutoTPPolicy, BertPolicy,
+                                                BloomPolicy,
+                                                DistilBertPolicy, GPT2Policy,
+                                                GPTJPolicy, GPTNeoPolicy,
+                                                GPTNeoXPolicy,
+                                                LlamaPolicy,
+                                                MegatronGPT2Policy,
                                                 OPTPolicy)
 
-POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, GPTJPolicy, GPTNeoXPolicy,
-            LlamaPolicy, BertPolicy]
+POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, GPTJPolicy, GPTNeoPolicy,
+            GPTNeoXPolicy, LlamaPolicy, MegatronGPT2Policy, BertPolicy,
+            DistilBertPolicy]
 
 
 def policy_for(hf_config):
@@ -17,4 +22,5 @@ def policy_for(hf_config):
     raise ValueError(
         f"no ingestion policy for model_type="
         f"{getattr(hf_config, 'model_type', None)!r}; supported: "
-        f"{[p.model_type for p in POLICIES]}")
+        f"{[p.model_type for p in POLICIES]} "
+        f"(+ the AutoTP structural fallback for llama-shaped decoders)")

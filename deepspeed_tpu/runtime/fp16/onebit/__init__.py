@@ -1,2 +1,3 @@
 from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam  # noqa: F401
 from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb  # noqa: F401
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import zero_one_adam  # noqa: F401

@@ -44,13 +44,27 @@ def build_optimizer(name, params=None, gradient_clipping=0.0):
     momentum = params.pop("momentum", 0.0)
     bias_correction = params.pop("bias_correction", True)
     freeze_step = params.pop("freeze_step", 100)
+    var_freeze_step = params.pop("var_freeze_step", 100000)
+    var_update_scaler = params.pop("var_update_scaler", 16)
     params.pop("torch_adam", None)
+    # the engine consumes comm_backend_name (compressed grad sync);
+    # local-step knobs are subsumed by the engine's sync (zoadam.py)
+    params.pop("comm_backend_name", None)
+    params.pop("cuda_aware", None)
+    params.pop("local_step_scaler", None)
+    params.pop("local_step_clipper", None)
     for k in list(params):
         logger.warning(f"Optimizer param '{k}' ignored on TPU backend")
 
     def make(learning_rate):
         lr_ = learning_rate
-        if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+        if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit import zero_one_adam
+            return zero_one_adam(lr_, b1=betas[0], b2=betas[1], eps=eps,
+                                 weight_decay=weight_decay,
+                                 var_freeze_step=var_freeze_step,
+                                 var_update_scaler=var_update_scaler)
+        if name == C.ONEBIT_ADAM_OPTIMIZER:
             from deepspeed_tpu.runtime.fp16.onebit import onebit_adam
             return onebit_adam(lr_, b1=betas[0], b2=betas[1], eps=eps,
                                weight_decay=weight_decay,

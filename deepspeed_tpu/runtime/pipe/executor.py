@@ -1,0 +1,206 @@
+"""Host-driven executor for the pipeline instruction schedules.
+
+Reference: ``PipelineEngine._exec_schedule`` (``runtime/pipe/engine.py:1286``)
+dispatching each :class:`~deepspeed_tpu.runtime.pipe.schedule.PipeInstruction`
+through ``_INSTRUCTION_MAP`` (:1273).
+
+On TPU the production path is the fused SPMD 1F1B
+(``runtime/pipe/one_f_one_b.py`` — one shard_map scan, XLA-scheduled).
+This executor is the *eager* counterpart: it walks the same
+``TrainSchedule``/``InferenceSchedule`` streams with per-stage callables
+and an explicit mailbox for the p2p edges. Its roles:
+
+  1. debug/irregular topologies — stages can be arbitrary Python
+     callables (different devices, host stages, uneven shapes) that the
+     fused jit cannot express;
+  2. specification — the oracle tests assert its loss/grads equal plain
+     autodiff, and the fused pipeline is tested against the same oracle,
+     so schedule and fused program are pinned to the same semantics.
+
+All stages run in one process. Like the reference's blocking p2p
+(``pipe/p2p.py``), a Recv waits for its Send: stages advance
+cooperatively, each yielding when the next instruction's mailbox entry
+has not arrived yet.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule,
+                                                 LoadMicroBatch,
+                                                 OptimizerStep, RecvActivation,
+                                                 RecvGrad, ReduceGrads,
+                                                 ReduceTiedGrads,
+                                                 SendActivation, SendGrad,
+                                                 TrainSchedule)
+
+
+class _StageRun:
+    """One stage's flattened instruction stream + its local buffers."""
+
+    def __init__(self, stage_id, sched):
+        self.stage_id = stage_id
+        self.cmds = [c for step in sched.steps() for c in step]
+        self.pos = 0
+        self.bufs = {}      # buffer_id -> current activation / cotangent
+        self.saved = {}     # buffer_id -> (vjp, microbatch index)
+        self.fwd_mb = 0
+        self.bwd_mb = 0
+        self.cur_bwd_mb = 0
+        self.mb_of = {}     # buffer_id -> microbatch currently in it
+
+    def done(self):
+        return self.pos >= len(self.cmds)
+
+    def peek(self):
+        return self.cmds[self.pos]
+
+
+class ScheduleExecutor:
+    """Execute instruction schedules over per-stage callables.
+
+    Args:
+      stage_fns: list of ``fn(stage_params, x) -> y``, one per stage.
+      loss_fn: ``fn(last_stage_output, label_microbatch) -> scalar``
+        (mean over the microbatch), used by :meth:`train`.
+    """
+
+    def __init__(self, stage_fns, loss_fn=None):
+        self.stage_fns = list(stage_fns)
+        self.loss_fn = loss_fn
+        self.stages = len(self.stage_fns)
+
+    def _drive(self, runs, ready, exec_one):
+        """Cooperative round-robin: run each stage until it blocks on a
+        Recv whose mailbox entry is missing; error on deadlock."""
+        while any(not r.done() for r in runs):
+            progressed = False
+            for r in runs:
+                while not r.done() and ready(r):
+                    exec_one(r)
+                    r.pos += 1
+                    progressed = True
+            if not progressed:
+                stuck = {r.stage_id: repr(r.peek())
+                         for r in runs if not r.done()}
+                raise RuntimeError(
+                    f"pipeline schedule deadlock; waiting on {stuck}")
+
+    # ------------------------------------------------------------- train
+    def train(self, stage_params, micro_inputs, micro_labels):
+        """Run ``TrainSchedule`` for every stage; returns (mean_loss,
+        per-stage grads) with grads averaged over microbatches — the
+        same convention as the fused 1F1B (mean of microbatch means)."""
+        M = len(micro_inputs)
+        S = self.stages
+        runs = [_StageRun(s, TrainSchedule(M, S, s)) for s in range(S)]
+        act_mail, grad_mail = {}, {}
+        losses = [None] * M
+        grads = [None] * S
+
+        # Mailboxes are keyed by (stage, MICROBATCH): adjacent stages
+        # number buffers mod different nbuf, so buffer ids don't line up
+        # across the p2p edge; microbatches are processed in order on
+        # both sides (the reference's p2p pairs by send/recv order).
+        def ready(r):
+            cmd = r.peek()
+            if isinstance(cmd, RecvActivation):
+                return (r.stage_id, r.fwd_mb) in act_mail
+            if isinstance(cmd, RecvGrad):
+                return (r.stage_id, r.bwd_mb) in grad_mail
+            if isinstance(cmd, ForwardPass):
+                # 1F1B pacing: a buffer's vjp must be consumed by its
+                # backward before the buffer is reused
+                return cmd.buffer_id not in r.saved
+            return True
+
+        def exec_one(r):
+            s, cmd = r.stage_id, r.peek()
+            last = s == S - 1
+            if isinstance(cmd, LoadMicroBatch):
+                r.bufs[cmd.buffer_id] = micro_inputs[r.fwd_mb]
+            elif isinstance(cmd, RecvActivation):
+                r.bufs[cmd.buffer_id] = act_mail.pop((s, r.fwd_mb))
+            elif isinstance(cmd, ForwardPass):
+                mb, r.fwd_mb = r.fwd_mb, r.fwd_mb + 1
+                r.mb_of[cmd.buffer_id] = mb
+                x = r.bufs[cmd.buffer_id]
+                if last and self.loss_fn is not None:
+                    def run_fn(p, x_):
+                        return self.loss_fn(self.stage_fns[s](p, x_),
+                                            micro_labels[mb])
+                    loss, vjp = jax.vjp(run_fn, stage_params[s], x)
+                    losses[mb] = loss
+                else:
+                    y, vjp = jax.vjp(
+                        lambda p, x_: self.stage_fns[s](p, x_),
+                        stage_params[s], x)
+                    r.bufs[cmd.buffer_id] = y
+                r.saved[cmd.buffer_id] = vjp
+            elif isinstance(cmd, SendActivation):
+                act_mail[(s + 1, r.mb_of[cmd.buffer_id])] = \
+                    r.bufs[cmd.buffer_id]
+            elif isinstance(cmd, RecvGrad):
+                r.bufs[cmd.buffer_id] = grad_mail.pop((s, r.bwd_mb))
+            elif isinstance(cmd, BackwardPass):
+                r.cur_bwd_mb, r.bwd_mb = r.bwd_mb, r.bwd_mb + 1
+                vjp = r.saved.pop(cmd.buffer_id)
+                if s == S - 1 and self.loss_fn is not None:
+                    dp, dx = vjp(jnp.ones((), jnp.float32))
+                else:
+                    dp, dx = vjp(r.bufs[cmd.buffer_id])
+                grads[s] = dp if grads[s] is None else \
+                    jax.tree.map(jnp.add, grads[s], dp)
+                r.bufs[cmd.buffer_id] = dx
+            elif isinstance(cmd, SendGrad):
+                grad_mail[(s - 1, r.cur_bwd_mb)] = r.bufs[cmd.buffer_id]
+            elif isinstance(cmd, (ReduceTiedGrads, ReduceGrads,
+                                  OptimizerStep)):
+                pass  # single-process: reduction/step are the caller's
+            else:
+                raise TypeError(f"unknown instruction {cmd!r}")
+
+        self._drive(runs, ready, exec_one)
+        mean_loss = jnp.mean(jnp.stack(losses))
+        grads = [jax.tree.map(lambda g: g / M, g) for g in grads]
+        return mean_loss, grads
+
+    # --------------------------------------------------------- inference
+    def infer(self, stage_params, micro_inputs):
+        """Run ``InferenceSchedule``; returns the last stage's outputs in
+        microbatch order."""
+        M = len(micro_inputs)
+        S = self.stages
+        runs = [_StageRun(s, InferenceSchedule(M, S, s)) for s in range(S)]
+        act_mail = {}
+        outs = [None] * M
+
+        def ready(r):
+            cmd = r.peek()
+            if isinstance(cmd, RecvActivation):
+                return (r.stage_id, r.fwd_mb) in act_mail
+            return True
+
+        def exec_one(r):
+            s, cmd = r.stage_id, r.peek()
+            if isinstance(cmd, LoadMicroBatch):
+                r.bufs[cmd.buffer_id] = micro_inputs[r.fwd_mb]
+            elif isinstance(cmd, RecvActivation):
+                r.bufs[cmd.buffer_id] = act_mail.pop((s, r.fwd_mb))
+            elif isinstance(cmd, ForwardPass):
+                mb, r.fwd_mb = r.fwd_mb, r.fwd_mb + 1
+                r.mb_of[cmd.buffer_id] = mb
+                y = self.stage_fns[s](stage_params[s],
+                                      r.bufs[cmd.buffer_id])
+                r.bufs[cmd.buffer_id] = y
+                if s == S - 1:
+                    outs[mb] = y
+            elif isinstance(cmd, SendActivation):
+                act_mail[(s + 1, r.mb_of[cmd.buffer_id])] = \
+                    r.bufs[cmd.buffer_id]
+            else:
+                raise TypeError(f"unknown instruction {cmd!r}")
+
+        self._drive(runs, ready, exec_one)
+        return outs

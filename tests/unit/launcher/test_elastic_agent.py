@@ -1,0 +1,63 @@
+"""Elastic agent integration test (VERDICT r2 #6 done-criterion: kill
+one of 2 CPU processes mid-run and observe recovery with loss
+continuity). Reference: deepspeed/elasticity/elastic_agent.py:28."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_elastic_agent_restarts_and_resumes(tmp_path):
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker = os.path.join(REPO, "tests", "unit", "launcher",
+                          "elastic_worker.py")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--num_nodes", "1", "--num_workers", "2",
+         "--master_port", str(port), "--force_cpu_devices", "2",
+         "--elastic", "--max_elastic_restarts", "2",
+         worker, str(out_dir)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+
+    results = {}
+    for rank in range(2):
+        f = out_dir / f"rank{rank}.json"
+        assert f.exists(), (list(out_dir.iterdir()), r.stderr[-2000:])
+        results[rank] = json.loads(f.read_text())
+    for rank, res in results.items():
+        # the surviving run is attempt 1 (one restart happened)...
+        assert res["attempt"] == 1, res
+        # ...which RESUMED from the checkpoint near the kill step
+        # instead of starting over
+        assert res["start_step"] >= 2, res
+        assert res["end_step"] == 6, res
+        # loss continuity: training kept improving after the restart
+        assert res["losses"][-1] < res["losses"][0], res
+
+
+def test_elastic_agent_budget_exhaustion(tmp_path):
+    """A worker that always fails must exhaust the restart budget and
+    propagate the failure code."""
+    script = tmp_path / "always_fail.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    agent = DSElasticAgent(str(script), num_workers=1, max_restarts=2,
+                           monitor_interval=0.05)
+    rc = agent.run()
+    assert rc == 9
+    assert agent.restart_count == 2

@@ -35,6 +35,41 @@ def test_fetch_hostfile(tmp_path):
         fetch_hostfile(str(bad))
 
 
+def test_ds_elastic_cli(tmp_path):
+    """bin/ds_elastic (reference namesake): compute elastic batch config
+    from a JSON config file."""
+    import json
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"elasticity": {
+        "enabled": True, "max_train_batch_size": 64,
+        "micro_batch_sizes": [4, 8]}}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_elastic"),
+         "-c", str(cfg), "-w", "4"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["final_batch_size"] == 48
+    assert 4 in out["valid_device_counts"]
+    assert out["micro_batch_per_device"] * 4 * \
+        out["gradient_accumulation_steps"] == out["final_batch_size"]
+
+
+def test_ds_bench_cli():
+    """bin/ds_bench (reference namesake): one-op sweep on the virtual
+    CPU mesh prints benchmark JSON rows."""
+    import json
+    env = dict(os.environ, DSTPU_BENCH_CPU="8")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_bench"),
+         "--ops", "all_reduce", "--minsize", "16", "--maxsize", "16"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["op"] == "all_reduce" and row["n"] == 8
+
+
 @pytest.mark.parametrize("nproc", [2])
 def test_cli_two_process_rendezvous_and_allreduce(tmp_path, nproc):
     """Spawn 2 real processes through the CLI; they rendezvous via

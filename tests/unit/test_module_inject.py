@@ -115,6 +115,118 @@ def test_llama_ingestion(ids):
     np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
 
 
+def test_gpt_neo_ingestion(ids):
+    """Alternating global/local attention + unscaled-attention weights
+    (GPTNeoPolicy pre-scales q by sqrt(head_dim))."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=4,
+        max_position_embeddings=64, intermediate_size=256,
+        embed_dropout=0.0, attention_dropout=0.0, resid_dropout=0.0)
+    hf = transformers.GPTNeoForCausalLM(cfg)
+    np.testing.assert_allclose(our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
+def test_distilbert_ingestion(ids):
+    cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=48, n_layers=2, n_heads=4, hidden_dim=96,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0,
+        activation="gelu")
+    hf = transformers.DistilBertForMaskedLM(cfg)
+    mask = np.ones_like(ids)
+    ours = our_logits(hf, ids, attention_mask=mask)
+    theirs = hf_logits(hf, ids, attention_mask=torch.tensor(mask))
+    np.testing.assert_allclose(ours, theirs, **TOL)
+
+
+def test_megatron_gpt2_ingestion(ids):
+    """Megatron-LM checkpoint layout: build a synthetic megatron state
+    dict from an HF GPT2 model (known weight correspondence) and assert
+    the ingested logits equal the HF forward."""
+    from types import SimpleNamespace
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        activation_function="gelu_new", attn_pdrop=0.0, embd_pdrop=0.0,
+        resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    hsd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    n_head, h = hf_cfg.n_head, hf_cfg.n_embd
+    hd = h // n_head
+
+    def to_megatron_qkv(w, b):
+        # HF GPT2 Conv1D [in, 3h] contiguous q|k|v -> megatron
+        # [(heads, 3, hd), in] interleaved
+        w = w.T  # [3h, in]
+        q, k, v = np.split(w, 3, axis=0)
+        inter = np.stack([q.reshape(n_head, hd, h),
+                          k.reshape(n_head, hd, h),
+                          v.reshape(n_head, hd, h)], axis=1)
+        bq, bk, bv = np.split(b, 3)
+        ib = np.stack([bq.reshape(n_head, hd), bk.reshape(n_head, hd),
+                       bv.reshape(n_head, hd)], axis=1)
+        return inter.reshape(3 * h, h), ib.reshape(3 * h)
+
+    sd = {"language_model.embedding.word_embeddings.weight":
+              hsd["transformer.wte.weight"],
+          "language_model.embedding.position_embeddings.weight":
+              hsd["transformer.wpe.weight"],
+          "language_model.transformer.final_layernorm.weight":
+              hsd["transformer.ln_f.weight"],
+          "language_model.transformer.final_layernorm.bias":
+              hsd["transformer.ln_f.bias"]}
+    for i in range(hf_cfg.n_layer):
+        src = f"transformer.h.{i}."
+        dst = f"language_model.transformer.layers.{i}."
+        qkv_w, qkv_b = to_megatron_qkv(hsd[src + "attn.c_attn.weight"],
+                                       hsd[src + "attn.c_attn.bias"])
+        sd[dst + "input_layernorm.weight"] = hsd[src + "ln_1.weight"]
+        sd[dst + "input_layernorm.bias"] = hsd[src + "ln_1.bias"]
+        sd[dst + "post_attention_layernorm.weight"] = \
+            hsd[src + "ln_2.weight"]
+        sd[dst + "post_attention_layernorm.bias"] = hsd[src + "ln_2.bias"]
+        sd[dst + "attention.query_key_value.weight"] = qkv_w
+        sd[dst + "attention.query_key_value.bias"] = qkv_b
+        sd[dst + "attention.dense.weight"] = \
+            hsd[src + "attn.c_proj.weight"].T
+        sd[dst + "attention.dense.bias"] = hsd[src + "attn.c_proj.bias"]
+        sd[dst + "mlp.dense_h_to_4h.weight"] = \
+            hsd[src + "mlp.c_fc.weight"].T
+        sd[dst + "mlp.dense_h_to_4h.bias"] = hsd[src + "mlp.c_fc.bias"]
+        sd[dst + "mlp.dense_4h_to_h.weight"] = \
+            hsd[src + "mlp.c_proj.weight"].T
+        sd[dst + "mlp.dense_4h_to_h.bias"] = hsd[src + "mlp.c_proj.bias"]
+
+    meg_cfg = SimpleNamespace(
+        model_type="megatron-lm", vocab_size=128, hidden_size=48,
+        num_layers=2, num_attention_heads=4, max_position_embeddings=64,
+        ffn_hidden_size=192, layernorm_epsilon=hf_cfg.layer_norm_epsilon)
+    from deepspeed_tpu.module_inject.replace_policy import policy_for
+    from deepspeed_tpu.module_inject.policy import MegatronGPT2Policy
+    assert policy_for(meg_cfg) is MegatronGPT2Policy
+    module = MegatronGPT2Policy.build_module(meg_cfg)
+    params = MegatronGPT2Policy.convert(meg_cfg, sd)
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    ours = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits(hf, ids), **TOL)
+
+
+def test_autotp_fallback_llama_shaped(ids):
+    """An architecture with NO policy (Mistral) ingests through the
+    structural AutoTP fallback (reference auto_tp.py:13) with exact
+    logits parity."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, max_position_embeddings=64,
+        sliding_window=None, attention_dropout=0.0)
+    hf = transformers.MistralForCausalLM(cfg)
+    from deepspeed_tpu.module_inject.replace_policy import policy_for
+    with pytest.raises(ValueError):
+        policy_for(cfg)   # no hand-written policy...
+    np.testing.assert_allclose(  # ...but from_hf falls back structurally
+        our_logits(hf, ids), hf_logits(hf, ids), **TOL)
+
+
 def test_bert_ingestion(ids):
     cfg = transformers.BertConfig(
         vocab_size=128, hidden_size=48, num_hidden_layers=2,

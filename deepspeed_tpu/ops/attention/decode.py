@@ -34,13 +34,14 @@ from deepspeed_tpu.ops.attention.flash import NEG_INF, _pick_block
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, nk, kv_h, grp):
+                   m_scr, l_scr, acc_scr, *, scale, nk):
     """One grid step: ALL heads against one kv block. Blocks span the
-    full head dimensions (equal-to-array, so any head count satisfies
-    the TPU (8,128) tiling rule — per-head blocks of a small GQA group
-    do not)."""
+    full head dims (equal-to-array, so any head count satisfies the TPU
+    (8,128) tiling rule), and the per-head products use dot_general
+    batch dims directly on the cache's storage layout — Mosaic rejects
+    both the reshape ([h,d]->[kv,grp,d], "unsupported shape cast") and
+    per-head sub-8 blocks, so no reshapes or transposes appear here."""
     ki = pl.program_id(1)
-    h = kv_h * grp
 
     @pl.when(ki == 0)
     def _init():
@@ -48,31 +49,30 @@ def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    d = q_ref.shape[3]
-    bk = k_ref.shape[1]
-    q = q_ref[0, 0, :, :].reshape(kv_h, grp, d)           # [kv_h, grp, d]
-    k = k_ref[0].transpose(1, 0, 2)                       # [kv_h, bk, d]
-    v = v_ref[0].transpose(1, 0, 2)                       # [kv_h, bk, d]
-    # batched over kv heads: q groups hit their own head's cache
+    h = q_ref.shape[1]
+    q = q_ref[0]                                          # [h, 1, d]
+    k = k_ref[0].transpose(1, 0, 2)                       # [h, bk, d]
+    v = v_ref[0].transpose(1, 0, 2)                       # [h, bk, d]
+    # leading-batch dot over heads (Mosaic supports batch dims only at
+    # position 0 on both sides — hence q pre-shaped [h, 1, d] outside)
     s = jax.lax.dot_general(
         q, k, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32) * scale       # [kv_h, grp, bk]
-    s = s.reshape(h, bk) + bias_ref[0, :, 0, :]
+        preferred_element_type=jnp.float32) * scale       # [h, 1, bk]
+    s = s + bias_ref[0]                                   # [h, 1, bk]
     s = jnp.maximum(s, NEG_INF)  # keep masked slots finite (see flash.py)
 
     m_prev = m_scr[:h, :1]
     l_prev = l_scr[:h, :1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_cur = jnp.max(s, axis=2)                            # [h, 1]
     m_new = jnp.maximum(m_prev, m_cur)
     row_live = m_new > NEG_INF / 2
     alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
-    p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
-    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    p = jnp.where(row_live[..., None], jnp.exp(s - m_new[..., None]), 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=2)
     pv = jax.lax.dot_general(
-        p.reshape(kv_h, grp, bk).astype(v.dtype), v,
-        (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32).reshape(h, d)
-    acc_scr[:h] = acc_scr[:h] * alpha + pv
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)               # [h, 1, d]
+    acc_scr[:h] = acc_scr[:h] * alpha + pv[:, 0, :]
     m_scr[:h] = jnp.broadcast_to(m_new, (h, m_scr.shape[1]))
     l_scr[:h] = jnp.broadcast_to(l_new, (h, l_scr.shape[1]))
 
@@ -80,37 +80,46 @@ def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
     def _finalize():
         l = l_scr[:h, :1]
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0, :, :] = (acc_scr[:h] / l).astype(o_ref.dtype)
+        o_ref[0] = ((acc_scr[:h] / l)[:, None, :]).astype(o_ref.dtype)
 
 
 def _decode_pallas(q, k_cache, v_cache, bias, *, scale, block_k, interpret):
     b, one, h, d = q.shape
     max_len, kv_h = k_cache.shape[1], k_cache.shape[2]
-    grp = h // kv_h
+    if kv_h != h:
+        # GQA: expand the cache to full heads for the kernel (the
+        # per-kv-head block formulation violates the (8,128) tiling rule
+        # for small groups); the expansion costs grp x cache traffic,
+        # still a net win over materializing [h, max_len] scores
+        k_cache = _repeat_kv(k_cache, h // kv_h)
+        v_cache = _repeat_kv(v_cache, h // kv_h)
     nk = max_len // block_k
     scr_rows = max(h, 8)   # TPU sublane tile
+    # q enters as [b, h, 1, d]: the kernel needs the head dim leading
+    # for Mosaic's batch-dim-0 dot rule (the [h, d] -> [kv, grp, d]
+    # reshape of the head dim is an unsupported shape cast in-kernel)
+    q_t = q.transpose(0, 2, 1, 3)
 
-    kernel = functools.partial(_decode_kernel, scale=scale, nk=nk,
-                               kv_h=kv_h, grp=grp)
+    kernel = functools.partial(_decode_kernel, scale=scale, nk=nk)
     out = pl.pallas_call(
         kernel,
         grid=(b, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, h, d), lambda ib, j: (ib, 0, 0, 0)),
-            pl.BlockSpec((1, block_k, kv_h, d), lambda ib, j: (ib, j, 0, 0)),
-            pl.BlockSpec((1, block_k, kv_h, d), lambda ib, j: (ib, j, 0, 0)),
+            pl.BlockSpec((1, h, 1, d), lambda ib, j: (ib, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, h, d), lambda ib, j: (ib, j, 0, 0)),
+            pl.BlockSpec((1, block_k, h, d), lambda ib, j: (ib, j, 0, 0)),
             pl.BlockSpec((1, h, 1, block_k), lambda ib, j: (ib, 0, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, 1, h, d), lambda ib, j: (ib, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        out_specs=pl.BlockSpec((1, h, 1, d), lambda ib, j: (ib, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
         scratch_shapes=[
             pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, 128), jnp.float32),
             pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, 128), jnp.float32),
             pl.ANY if pltpu is None else pltpu.VMEM((scr_rows, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k_cache, v_cache, bias)
-    return out
+    )(q_t, k_cache, v_cache, bias)
+    return out.transpose(0, 2, 1, 3)                      # [b, 1, h, d]
 
 
 def _repeat_kv(x, n_rep):

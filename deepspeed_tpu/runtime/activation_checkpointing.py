@@ -1,0 +1,104 @@
+"""Activation checkpointing (reference
+``runtime/activation_checkpointing/checkpointing.py`` — Megatron-style
+``CheckpointFunction`` :474 with ``partition_activations`` :366 and CPU
+checkpointing :461).
+
+TPU mapping:
+  * recompute-instead-of-save is ``jax.checkpoint`` (remat) — models
+    apply it per block (``GPTConfig.remat``), and the engine can wrap
+    the whole loss with a named policy (``remat_policy``).
+  * ``cpu_checkpointing`` — saved residuals live in PINNED HOST memory
+    between forward and backward (``offload_dot_with_no_batch_dims`` /
+    ``save_and_offload_only_these_names``): the reference's
+    checkpoint-to-CPU for long sequences, expressed as a remat policy
+    so XLA schedules the transfers.
+  * ``partition_activations`` is subsumed: under SPMD the saved
+    residuals carry the program's shardings (batch/sequence-sharded by
+    construction); there is no replicated per-TP-rank activation copy
+    to slice up. The key is accepted and marked inert.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+_POLICIES = {
+    name: getattr(jax.checkpoint_policies, name)
+    for name in ("everything_saveable", "nothing_saveable",
+                 "dots_saveable", "checkpoint_dots",
+                 "dots_with_no_batch_dims_saveable",
+                 "checkpoint_dots_with_no_batch_dims")
+    if hasattr(jax.checkpoint_policies, name)
+}
+
+
+def _offload_policy_usable(mesh):
+    """True when this backend executes host-offloaded remat residuals
+    under SPMD. The CPU SPMD partitioner rejects placement annotations
+    it cannot shard ("side-effect HLO must have sharding") in programs
+    richer than any cheap probe, so multi-device non-TPU meshes are
+    excluded outright; the probe covers the rest."""
+    if mesh is not None and mesh.devices.size > 1 and \
+            jax.default_backend() != "tpu":
+        return False
+    try:
+        pol = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+
+        def f(x, w):
+            g = jax.checkpoint(
+                lambda a, b: jnp.sum(jnp.tanh(a @ b)), policy=pol)
+            return jax.grad(g)(x, w)
+
+        n = mesh.shape.get("data", 1) if mesh is not None else 1
+        x = jnp.ones((max(n, 1) * 2, 4))
+        w = jnp.ones((4, 4))
+        if mesh is not None:
+            x = jax.device_put(x, NamedSharding(mesh, P("data")))
+            w = jax.device_put(w, NamedSharding(mesh, P()))
+        jax.block_until_ready(jax.jit(f)(x, w))
+        return True
+    except Exception:
+        return False
+
+
+def resolve_policy(cfg, mesh=None):
+    """jax.checkpoint policy (or None = no wrapping) for an
+    ActivationCheckpointingConfig."""
+    if cfg.cpu_checkpointing:
+        # keep matmul outputs, but in host memory: the long-sequence
+        # activation footprint leaves HBM between fwd and bwd
+        if cfg.remat_policy:
+            logger.warning("cpu_checkpointing overrides remat_policy="
+                           f"{cfg.remat_policy!r}")
+        if _offload_policy_usable(mesh):
+            return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host")
+        logger.warning(
+            "cpu_checkpointing: backend rejects host-offloaded remat "
+            "residuals under SPMD; saving dot products in device memory "
+            "instead (dots_with_no_batch_dims_saveable)")
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy:
+        if cfg.remat_policy not in _POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; choose from "
+                f"{sorted(_POLICIES)} (or enable cpu_checkpointing)")
+        return _POLICIES[cfg.remat_policy]
+    return None
+
+
+def wrap_loss_fn(loss_fn, cfg, mesh=None):
+    """Wrap a ``loss_fn(params, batch, rng)`` with jax.checkpoint per the
+    config section; returns loss_fn unchanged when the section requests
+    nothing."""
+    policy = resolve_policy(cfg, mesh)
+    if policy is None:
+        return loss_fn
+    inner = jax.checkpoint(
+        lambda params, batch, rng: loss_fn(params, batch, rng),
+        policy=policy, prevent_cse=False)
+    inner.__wrapped_by_activation_checkpointing__ = True
+    return inner

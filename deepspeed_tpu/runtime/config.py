@@ -11,7 +11,7 @@ sequence) that replace the reference's process-group plumbing.
 
 import json
 import os
-from typing import Optional
+from typing import ClassVar, Dict, Optional
 
 from pydantic import Field
 
@@ -71,6 +71,17 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     # TPU-only: jax.checkpoint policy name ("nothing_saveable",
     # "dots_saveable", "dots_with_no_batch_dims_saveable", ...)
     remat_policy: Optional[str] = None
+
+    _inert_fields: ClassVar[Dict[str, str]] = {
+        "partition_activations": "saved residuals carry the program's "
+                                 "SPMD shardings; there is no replicated "
+                                 "per-TP-rank activation copy to slice",
+        "contiguous_memory_optimization": "XLA lays out residuals",
+        "number_checkpoints": "checkpoint granularity is the model's "
+                              "per-block remat",
+        "synchronize_checkpoint_boundary": "no streams to synchronize",
+        "profile": "use the flops profiler / jax profiler traces",
+    }
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

@@ -156,6 +156,14 @@ class DeepSpeedEngine:
                     model.schedule == "1f1b":
                 loss_fn = model.make_loss_fn()
         self.loss_fn = loss_fn or self._default_loss_fn()
+        # activation checkpointing section (reference checkpointing.py:474):
+        # remat the whole loss under a named policy / host-offload the
+        # saved dot products (cpu_checkpointing)
+        from deepspeed_tpu.runtime.activation_checkpointing import \
+            wrap_loss_fn
+        self.loss_fn = wrap_loss_fn(self.loss_fn,
+                                    self._config.activation_checkpointing,
+                                    mesh=self.mesh)
         self._rng = jax.random.PRNGKey(seed)
         self._example_batch = example_batch
 

@@ -1,0 +1,92 @@
+"""Activation checkpointing config -> jax.checkpoint wiring.
+
+Reference analogue: tests exercising runtime/activation_checkpointing/
+checkpointing.py (CheckpointFunction matches plain autograd). Here the
+oracle is the unwrapped loss: remat/offload policies must not change
+loss or trajectory."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.activation_checkpointing import (resolve_policy,
+                                                            wrap_loss_fn)
+from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig
+
+from tests.unit.simple_model import (SimpleModel, random_regression_data,
+                                     simple_loss_fn)
+
+
+def mk_engine(act_ckpt):
+    model = SimpleModel()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"data": 8},
+        "activation_checkpointing": act_ckpt,
+    }
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                          loss_fn=simple_loss_fn(model))
+    return e
+
+
+def trajectory(engine, batch, n=5):
+    out = []
+    for _ in range(n):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        out.append(float(jax.device_get(loss)))
+    return out
+
+
+@pytest.mark.parametrize("section", [
+    {"remat_policy": "nothing_saveable"},
+    {"remat_policy": "dots_with_no_batch_dims_saveable"},
+    {"cpu_checkpointing": True},
+])
+def test_policies_preserve_trajectory(section):
+    batch = random_regression_data(n=32)
+    ref = trajectory(mk_engine({}), batch)
+    got = trajectory(mk_engine(section), batch)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_loss_fn_actually_wrapped():
+    e = mk_engine({"remat_policy": "nothing_saveable"})
+    assert getattr(e.loss_fn,
+                   "__wrapped_by_activation_checkpointing__", False)
+    e2 = mk_engine({})
+    assert not getattr(e2.loss_fn,
+                      "__wrapped_by_activation_checkpointing__", False)
+
+
+def test_unknown_policy_raises():
+    with pytest.raises(ValueError, match="remat_policy"):
+        resolve_policy(ActivationCheckpointingConfig(
+            remat_policy="who_knows"))
+
+
+def test_inert_keys_warn():
+    import logging
+
+    class Cap(logging.Handler):
+        def __init__(self):
+            super().__init__(logging.WARNING)
+            self.msgs = []
+
+        def emit(self, r):
+            self.msgs.append(r.getMessage())
+
+    from deepspeed_tpu.utils.logging import logger as L
+    h = Cap()
+    L.addHandler(h)
+    try:
+        ActivationCheckpointingConfig(partition_activations=True,
+                                      number_checkpoints=4)
+    finally:
+        L.removeHandler(h)
+    text = "\n".join(h.msgs)
+    assert "partition_activations" in text and "NO EFFECT" in text
+    assert "number_checkpoints" in text

@@ -108,20 +108,24 @@ def main():
             row = {"op": op_name, "bytes": size, "latency_ms":
                    round(lat * 1e3, 4), "algbw_gbps": round(algbw, 3),
                    "busbw_gbps": round(busbw, 3), "n": n}
-            if op_name == "compressed_allreduce":
+            if op_name == "compressed_allreduce" and n > 1:
                 # bytes-on-wire per rank: each rank quantizes its LOCAL
                 # shard (eager_collective splits dim 0 over the axis) and
-                # ships sign bits in both phases (all_to_all out +
-                # all_gather back) + n scales, vs 2*(n-1)/n * shard for a
-                # ring allreduce at this dtype
-                shard = elems // max(n, 1)
-                wire = 2 * (shard // 8) + 2 * n * dtype.itemsize
+                # ships sign bits in both phases — but all_to_all out and
+                # all_gather back each keep 1/n of the payload local, so
+                # only (n-1)/n of the sign bits cross the wire per phase,
+                # plus the n scales; vs 2*(n-1)/n * shard for a ring
+                # allreduce at this dtype. All wire fields are skipped at
+                # n == 1 where nothing leaves the chip.
+                shard = elems // n
+                offchip = (n - 1) / n
+                wire = int(2 * offchip * (shard // 8)) \
+                    + 2 * (n - 1) * dtype.itemsize
                 row["wire_bytes_per_rank"] = wire
                 row["uncompressed_allreduce_wire_bytes"] = int(
-                    2 * (n - 1) / n * shard * dtype.itemsize)
-                if n > 1:   # ratio undefined on a single device
-                    row["compression_x"] = round(
-                        row["uncompressed_allreduce_wire_bytes"] / wire, 2)
+                    2 * offchip * shard * dtype.itemsize)
+                row["compression_x"] = round(
+                    row["uncompressed_allreduce_wire_bytes"] / wire, 2)
             results.append(row)
             print(json.dumps(row))
             size <<= 2

@@ -153,11 +153,11 @@ def _durability_barrier(save_id, path, on_writer_thread):
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_done:{save_id}")
         return
-    # writer thread, no coordination client: only process 0 (which flips
-    # the `latest` pointer in on_done) needs to wait; it watches for all
-    # processes' shard files to appear in the shared directory
-    if jax.process_index() != 0:
-        return
+    # writer thread, no coordination client: EVERY process watches for all
+    # processes' shard files to appear in the shared directory, so any
+    # rank's wait_checkpoint() implies global durability (matching the
+    # coordination-service barrier's semantics) — not just process 0's,
+    # which additionally flips the `latest` pointer in on_done
     import time
     deadline = time.time() + 600.0
     want = jax.process_count()

@@ -411,9 +411,54 @@ class MegatronGPT2Policy(InjectionPolicy):
     """Megatron-LM GPT-2 checkpoints (reference containers/megatron_gpt.py:
     MegatronLayerPolicy). Matched by the Megatron state-dict key layout
     (``language_model.transformer.layers.N.*``) rather than an HF
-    model_type; the fused query_key_value is head-interleaved like BLOOM."""
+    model_type.
+
+    The fused query_key_value layout depends on the Megatron-LM source
+    generation (reference containers/features/megatron.py:16
+    ``transpose_qkv_alignment``). Megatron's own ``checkpoint_version``
+    metadata distinguishes THREE fused-dim layouts (the same ones
+    transformers' ``fix_query_key_value_ordering`` handles):
+
+    * ``< 1.0``  — contiguous ``q|k|v`` = ``(3, heads, hd)``: the target
+      layout, transpose only.
+    * ``1.0``    — ``(heads, hd, 3)``.
+    * ``>= 2.0`` — per-head interleaved ``(heads, 3, hd)`` like BLOOM;
+      this is what the reference's ``megatron_v2 = True`` default assumes.
+
+    All three have identical tensor shapes, so they cannot be
+    distinguished structurally — we read the checkpoint's own metadata:
+    an explicit ``megatron_v2`` bool attr on the config wins (True →
+    ``(heads, 3, hd)``, False → contiguous, mirroring the reference
+    flag), else ``checkpoint_version`` (a key Megatron writes into its
+    checkpoints, also accepted as a config attr), else default to the
+    v2 layout like the reference (MegatronLayerPolicy.megatron_v2)."""
 
     model_type = "megatron-lm"
+
+    @staticmethod
+    def _qkv_layout(hf_config, sd):
+        """-> 'contiguous' | 'v1' | 'v2' (fused-dim layout, see class doc)."""
+        v2 = getattr(hf_config, "megatron_v2", None)
+        if v2 is not None:
+            return "v2" if v2 else "contiguous"
+        ver = sd.get("checkpoint_version",
+                     getattr(hf_config, "checkpoint_version", None))
+        if ver is None:
+            return "v2"
+        ver = float(ver)
+        if ver >= 2.0:
+            return "v2"
+        return "v1" if ver >= 1.0 else "contiguous"
+
+    @staticmethod
+    def _split_qkv_v1(w, b, n_head):
+        """(heads, hd, 3) fused layout -> [in, 3h] contiguous q|k|v."""
+        three_h, h_in = w.shape
+        d = three_h // (3 * n_head)
+        w = w.reshape(n_head, d, 3, h_in).transpose(2, 0, 1, 3) \
+             .reshape(3 * n_head * d, h_in)
+        b = b.reshape(n_head, d, 3).transpose(2, 0, 1).reshape(-1)
+        return _t(w), np.ascontiguousarray(b)
 
     @classmethod
     def matches(cls, hf_config):
@@ -449,12 +494,19 @@ class MegatronGPT2Policy(InjectionPolicy):
              "wpe": _np(sd[e + "position_embeddings.weight"]),
              "ln_f": {"scale": _np(sd[t + "final_layernorm.weight"]),
                       "bias": _np(sd[t + "final_layernorm.bias"])}}
+        layout = cls._qkv_layout(hf_config, sd)
         for i in range(hf_config.num_layers):
             h = f"{t}layers.{i}."
-            qkv_w, qkv_b = BloomPolicy._split_qkv(
-                _np(sd[h + "attention.query_key_value.weight"]),
-                _np(sd[h + "attention.query_key_value.bias"]),
-                hf_config.num_attention_heads)
+            w = _np(sd[h + "attention.query_key_value.weight"])
+            b = _np(sd[h + "attention.query_key_value.bias"])
+            if layout == "v2":     # per-head (heads, 3, hd) -> q|k|v
+                qkv_w, qkv_b = BloomPolicy._split_qkv(
+                    w, b, hf_config.num_attention_heads)
+            elif layout == "v1":   # (heads, hd, 3) -> q|k|v
+                qkv_w, qkv_b = cls._split_qkv_v1(
+                    w, b, hf_config.num_attention_heads)
+            else:                  # already contiguous q|k|v
+                qkv_w, qkv_b = _t(w), np.ascontiguousarray(b)
             p[f"h_{i}"] = {
                 "ln_1": {"scale": _np(sd[h + "input_layernorm.weight"]),
                          "bias": _np(sd[h + "input_layernorm.bias"])},

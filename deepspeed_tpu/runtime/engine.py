@@ -466,9 +466,10 @@ class DeepSpeedEngine:
                     params, param_sh, host_sh)
                 self._grad_sh_dev = self._grad_sh
                 if self._injit_materialize:
-                    # grad cotangents flow back through the in-program
-                    # transfer and land directly in host memory — no
-                    # separate D2H before the host optimizer
+                    # host-kind grad shardings: _micro_offload device_puts
+                    # each grad leaf to these inside the program, so grads
+                    # leave HBM before the dispatch returns and the host
+                    # optimizer reads pinned memory directly
                     self._grad_sh = jax.tree.map(
                         lambda s: s.with_memory_kind("pinned_host"),
                         self._grad_sh)
@@ -665,8 +666,21 @@ class DeepSpeedEngine:
 
         # offload-mode micro dispatch: flat per-leaf grads, with
         # embedding leaves row-sparsified on device so only touched rows
-        # cross the host link (reference sparse_allreduce, engine.py:2303)
+        # cross the host link (reference sparse_allreduce, engine.py:2303).
+        # When the backend supports in-program memory-space moves
+        # (_injit_materialize), each grad leaf is moved to pinned host
+        # memory INSIDE the program — the leaves never sit in HBM between
+        # dispatch and the host optimizer. The output structure depends on
+        # the traced batch shape (sparse leaves become (idx, rows, n)
+        # tuples), so this is an in-body device_put rather than jit
+        # out_shardings.
         sparse_pos = getattr(self, "_sparse_positions", None)
+        injit_grads_to_host = (self._offload is not None and
+                               getattr(self, "_injit_materialize", False))
+        if injit_grads_to_host:
+            grad_host_sh = jax.tree.leaves(self._grad_sh)  # host-kind
+            host_rep = NamedSharding(
+                self.mesh, P(), memory_kind="pinned_host")
 
         def micro_offload(params, scale, batch, rng):
             loss, grads = fwd_bwd(params, scale, batch, rng)
@@ -696,6 +710,12 @@ class DeepSpeedEngine:
                     else:
                         out.append(g)
                 leaves = out
+            if injit_grads_to_host:
+                leaves = [
+                    tuple(jax.device_put(part, host_rep) for part in g)
+                    if isinstance(g, tuple)
+                    else jax.device_put(g, grad_host_sh[i])
+                    for i, g in enumerate(leaves)]
             return loss, leaves
 
         self._micro_offload = jax.jit(micro_offload)

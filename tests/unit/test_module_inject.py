@@ -210,6 +210,93 @@ def test_megatron_gpt2_ingestion(ids):
     np.testing.assert_allclose(ours, hf_logits(hf, ids), **TOL)
 
 
+@pytest.mark.parametrize("ckpt_version", [0.0, 1.0])
+def test_megatron_gpt2_pre_v2_qkv_layouts(ids, ckpt_version):
+    """Old-Megatron checkpoints store the fused qkv in version-specific
+    layouts with identical shapes (reference
+    containers/features/megatron.py:16 handles v2; transformers'
+    fix_query_key_value_ordering documents the rest): version < 1.0 is
+    contiguous q|k|v, version 1.0 is (heads, hd, 3). Assert the sd-level
+    ``checkpoint_version`` key routes each to the correct conversion,
+    with logits parity against the HF forward."""
+    from types import SimpleNamespace
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        activation_function="gelu_new", attn_pdrop=0.0, embd_pdrop=0.0,
+        resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    hsd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    n_head, h = hf_cfg.n_head, hf_cfg.n_embd
+    hd = h // n_head
+
+    def to_qkv(w, b):
+        # HF Conv1D [in, 3h] contiguous q|k|v -> the version's layout
+        w, q_k_v = w.T, None                      # [3h, in]
+        if ckpt_version < 1.0:                    # contiguous: as-is
+            return w, b
+        q, k, v = np.split(w, 3, axis=0)          # each [heads*hd, in]
+        bq, bk, bv = np.split(b, 3)
+        # v1.0 fused dim is (heads, hd, 3)
+        w3 = np.stack([q.reshape(n_head, hd, h), k.reshape(n_head, hd, h),
+                       v.reshape(n_head, hd, h)], axis=2)
+        b3 = np.stack([bq.reshape(n_head, hd), bk.reshape(n_head, hd),
+                       bv.reshape(n_head, hd)], axis=2)
+        return w3.reshape(3 * h, h), b3.reshape(3 * h)
+
+    sd = {"language_model.embedding.word_embeddings.weight":
+              hsd["transformer.wte.weight"],
+          "language_model.embedding.position_embeddings.weight":
+              hsd["transformer.wpe.weight"],
+          "language_model.transformer.final_layernorm.weight":
+              hsd["transformer.ln_f.weight"],
+          "language_model.transformer.final_layernorm.bias":
+              hsd["transformer.ln_f.bias"],
+          "checkpoint_version": ckpt_version}
+    for i in range(hf_cfg.n_layer):
+        src = f"transformer.h.{i}."
+        dst = f"language_model.transformer.layers.{i}."
+        qkv_w, qkv_b = to_qkv(hsd[src + "attn.c_attn.weight"],
+                              hsd[src + "attn.c_attn.bias"])
+        sd[dst + "attention.query_key_value.weight"] = qkv_w
+        sd[dst + "attention.query_key_value.bias"] = qkv_b
+        sd[dst + "input_layernorm.weight"] = hsd[src + "ln_1.weight"]
+        sd[dst + "input_layernorm.bias"] = hsd[src + "ln_1.bias"]
+        sd[dst + "post_attention_layernorm.weight"] = \
+            hsd[src + "ln_2.weight"]
+        sd[dst + "post_attention_layernorm.bias"] = hsd[src + "ln_2.bias"]
+        sd[dst + "attention.dense.weight"] = \
+            hsd[src + "attn.c_proj.weight"].T
+        sd[dst + "attention.dense.bias"] = hsd[src + "attn.c_proj.bias"]
+        sd[dst + "mlp.dense_h_to_4h.weight"] = \
+            hsd[src + "mlp.c_fc.weight"].T
+        sd[dst + "mlp.dense_h_to_4h.bias"] = hsd[src + "mlp.c_fc.bias"]
+        sd[dst + "mlp.dense_4h_to_h.weight"] = \
+            hsd[src + "mlp.c_proj.weight"].T
+        sd[dst + "mlp.dense_4h_to_h.bias"] = hsd[src + "mlp.c_proj.bias"]
+
+    meg_cfg = SimpleNamespace(
+        model_type="megatron-lm", vocab_size=128, hidden_size=48,
+        num_layers=2, num_attention_heads=4, max_position_embeddings=64,
+        ffn_hidden_size=192, layernorm_epsilon=hf_cfg.layer_norm_epsilon)
+    from deepspeed_tpu.module_inject.policy import MegatronGPT2Policy
+    expect = "contiguous" if ckpt_version < 1.0 else "v1"
+    assert MegatronGPT2Policy._qkv_layout(meg_cfg, sd) == expect
+    module = MegatronGPT2Policy.build_module(meg_cfg)
+    params = MegatronGPT2Policy.convert(meg_cfg, sd)
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    ours = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits(hf, ids), **TOL)
+
+    # config-level flag beats the sd key; absent metadata defaults to v2
+    meg_cfg.megatron_v2 = True
+    assert MegatronGPT2Policy._qkv_layout(meg_cfg, sd) == "v2"
+    meg_cfg.megatron_v2 = False
+    assert MegatronGPT2Policy._qkv_layout(meg_cfg, sd) == "contiguous"
+    del sd["checkpoint_version"]
+    meg_cfg.megatron_v2 = None
+    assert MegatronGPT2Policy._qkv_layout(meg_cfg, sd) == "v2"
+
+
 def test_autotp_fallback_llama_shaped(ids):
     """An architecture with NO policy (Mistral) ingests through the
     structural AutoTP fallback (reference auto_tp.py:13) with exact

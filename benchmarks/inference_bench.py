@@ -24,40 +24,83 @@ def run(model_name, batch, prompt_len, new_tokens, dtype):
     from deepspeed_tpu.models.gpt2 import GPT2, gpt2_small
     from deepspeed_tpu.models.llama import Llama, llama_tiny
 
+    import jax.numpy as jnp
     if model_name == "gpt2-small":
-        import jax.numpy as jnp
         module = GPT2(gpt2_small(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16))
-        vocab = module.cfg.vocab_size
+        quant = {}
+    elif model_name == "gpt-2b7":
+        # GPT-Neo-2.7B-shaped decoder: the model class weight-only int8
+        # serving exists for (multi-GB weights streaming from HBM each
+        # token). 2.65B params: bf16 5.3GB, int8 ~2.7GB.
+        from deepspeed_tpu.models.gpt2 import GPTConfig
+        module = GPT2(GPTConfig(
+            vocab_size=50257, hidden_size=2560, num_layers=32,
+            num_heads=32, max_seq_len=2048, dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16))
+        quant = {"group_size": 128}
     else:
         raise ValueError(model_name)
+    vocab = module.cfg.vocab_size
 
     engine = deepspeed_tpu.init_inference(
-        module, dtype=dtype, max_out_tokens=prompt_len + new_tokens + 8)
+        module, dtype=dtype, max_out_tokens=prompt_len + new_tokens + 8,
+        **({"quant": quant} if quant and dtype == "int8" else {}))
     engine.init_params()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, prompt_len)).astype("i4")
+
+    # dispatch round-trip constant: on a tunneled/relayed rig this is
+    # ~100 ms of pure host<->device latency paid once per dispatch — NOT
+    # per-token compute. Measure it and report decode numbers with it
+    # subtracted from the (single-dispatch) fused decode loop.
+    import time
+    import jax
+    import jax.numpy as jnp
+    triv = jax.jit(lambda x: jnp.sum(x))
+    float(jax.device_get(triv(jnp.zeros(8))))
+    rt = []
+    for _ in range(5):
+        t0 = time.time()
+        float(jax.device_get(triv(jnp.zeros(8))))
+        rt.append(time.time() - t0)
+    overhead_ms = float(np.median(rt)) * 1e3
 
     # warmup (compile prefill + fused decode loop at the measured shape)
     engine.generate(ids, max_new_tokens=new_tokens)
     engine.model_times()
 
-    out = engine.generate(ids, max_new_tokens=new_tokens)
-    times = engine.model_times()
-    assert out.shape[1] == prompt_len + new_tokens
-    prefill_ms = times[0] * 1e3
-    decode_ms = np.asarray(times[1:]) * 1e3
+    # the relay constant jitters by tens of ms run to run — take medians
+    # over several whole-generate trials
+    trials = 7
+    prefills, totals = [], []
+    for _ in range(trials):
+        out = engine.generate(ids, max_new_tokens=new_tokens)
+        times = engine.model_times()
+        assert out.shape[1] == prompt_len + new_tokens
+        prefills.append(times[0] * 1e3)
+        totals.append(float(np.sum(times[1:])) * 1e3)
+        n = len(times) - 1
+    # times[1:] spread ONE fused-loop dispatch evenly, so the dispatch
+    # constant is the loop total's overhead, not each token's
+    raw_total = float(np.median(totals))
+    adj_total = max(raw_total - overhead_ms, 1e-9)
+    per_tok = adj_total / n
     return {
-        "prefill_ms": round(float(prefill_ms), 3),
-        "token_p50_ms": round(float(np.percentile(decode_ms, 50)), 3),
-        "token_p90_ms": round(float(np.percentile(decode_ms, 90)), 3),
-        "decode_tokens_per_sec":
-            round(batch * len(decode_ms) / (decode_ms.sum() / 1e3), 1),
+        "prefill_ms": round(float(np.median(prefills)) - overhead_ms, 3),
+        # the fused loop is ONE dispatch: only the mean per-token time is
+        # measurable (no per-token tail percentiles)
+        "token_mean_ms": round(per_tok, 3),
+        "decode_tokens_per_sec": round(batch * n / (adj_total / 1e3), 1),
+        "dispatch_overhead_ms": round(overhead_ms, 3),
+        "raw_decode_total_ms": round(raw_total, 3),
+        "trials": trials,
     }
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="gpt2-small")
+    p.add_argument("--model", default="gpt2-small",
+                   choices=["gpt2-small", "gpt-2b7"])
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt", type=int, default=128)
     p.add_argument("--tokens", type=int, default=64)
@@ -67,8 +110,8 @@ def main():
     for dtype in args.dtypes.split(","):
         r = run(args.model, args.batch, args.prompt, args.tokens, dtype)
         print(json.dumps({
-            "metric": f"{args.model}_{dtype}_decode_p50_latency",
-            "value": r["token_p50_ms"], "unit": "ms",
+            "metric": f"{args.model}_{dtype}_decode_token_latency",
+            "value": r["token_mean_ms"], "unit": "ms",
             "extra": {**r, "batch": args.batch, "prompt": args.prompt,
                       "new_tokens": args.tokens, "dtype": dtype},
         }))

@@ -5,4 +5,7 @@ step-scheduled config)."""
 from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
     QuantizedLinear, activation_quant_ste, head_prune_mask, prune_mask,
     row_prune_mask, weight_quant_ste)
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    CompressionRuntime, init_compression, redundancy_clean,
+    student_initialization)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler  # noqa: F401

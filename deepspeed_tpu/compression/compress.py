@@ -1,0 +1,239 @@
+"""Compression model-surgery API: the engine-facing runtime that makes
+``compression_training`` config change training, plus the reference's
+three public entry points.
+
+Reference: ``deepspeed/compression/compress.py`` — ``init_compression``
+:95 (replace matched Linear/Conv with compression-aware modules),
+``redundancy_clean`` :123 (bake masks/quantization into the weights),
+``student_initialization`` :167 (teacher->student layer mapping), with
+group matching from ``compression/config.py`` (per-method
+``shared_parameters`` + ``different_groups`` with module patterns).
+
+TPU redesign: flax modules are immutable and parameters live in a
+pytree, so "module surgery" becomes a **pure tree transformation**
+applied inside the jitted train step. :class:`CompressionRuntime`
+resolves each config group's module patterns against the flattened
+param paths once, then
+
+* ``strength_vector(step)`` (host, cheap, every micro step) packs each
+  group's current strength — quantization bit-width on its halving
+  schedule, pruning ratio past its offset — into one f32 vector, and
+* ``apply(params, vec)`` (traced) maps matched kernels through
+  straight-through-estimator fake quantization / magnitude-pruning
+  masks with the strengths as TRACED scalars, so schedule changes never
+  recompile (thresholds use ``jnp.quantile`` instead of static top-k).
+
+MoQ (eigenvalue-scheduled bits): the engine periodically power-iterates
+per-group Hessian eigenvalues (runtime/eigenvalue.py), normalizes by
+the max like the reference (eigenvalue.py:149), and
+``set_eigenvalue_factors`` stretches each group's quantization period
+by ``1 + floor(ev * 4)`` — the reference quantizer's factor
+(runtime/quantize.py:70): high-curvature groups quantize slower.
+
+``activation_quantization`` cannot be expressed as a param-tree map; it
+engages through :class:`deepspeed_tpu.compression.QuantizedLinear`
+(``act_bits``) in the model definition, as in the reference's replaced
+layers.
+"""
+
+import fnmatch
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression.basic_layer import _ste
+
+_PRUNE_METHODS = ("sparse_pruning", "row_pruning", "head_pruning")
+
+
+def _flat_paths(params):
+    import flax.traverse_util
+    flat = flax.traverse_util.flatten_dict(params, sep="/")
+    return list(flat.keys()), list(flat.values())
+
+
+def _match(path, patterns):
+    return any(fnmatch.fnmatch(path, f"*{p}*") for p in patterns)
+
+
+class CompressionRuntime:
+    """Resolved ``compression_training`` config against one param tree."""
+
+    def __init__(self, config, params, num_heads=None):
+        self.config = dict(config or {})
+        paths, leaves = _flat_paths(params)
+        self.n_leaves = len(paths)
+        self.groups = []      # (method, name, shared, gparams, positions)
+        for method in ("weight_quantization",) + _PRUNE_METHODS:
+            mcfg = self.config.get(method) or {}
+            shared = dict(mcfg.get("shared_parameters") or {})
+            if not shared.get("enabled"):
+                continue
+            for gname, g in (mcfg.get("different_groups") or {}).items():
+                pats = g.get("modules", ["*"])
+                pos = [i for i, (p, l) in enumerate(zip(paths, leaves))
+                       if p.endswith("kernel") and jnp.ndim(l) >= 2
+                       and _match(p, pats)]
+                if not pos:
+                    raise ValueError(
+                        f"compression group {method}/{gname}: no kernel "
+                        f"matches patterns {pats} (paths like "
+                        f"{paths[:3]}...)")
+                self.groups.append((method, gname, shared,
+                                    dict(g.get("params") or {}), pos))
+        if self.config.get("activation_quantization", {}).get(
+                "shared_parameters", {}).get("enabled"):
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "activation_quantization engages through "
+                "compression.QuantizedLinear(act_bits=...) in the model, "
+                "not the engine param transform (see compress.py docs)")
+        self.num_heads = num_heads
+        self._eig_factor = {}          # group index -> period multiplier
+
+    def __len__(self):
+        return len(self.groups)
+
+    # ------------------------------------------------------------- schedule
+    def set_eigenvalue_factors(self, eigenvalues):
+        """eigenvalues: {group_index: normalized |ev| in [0, 1]} ->
+        period factor 1 + floor(ev*4) (reference quantize.py:70)."""
+        import math
+        self._eig_factor = {
+            gi: 1 + math.floor(min(max(float(ev), 0.0), 1.0) * 4)
+            for gi, ev in eigenvalues.items()}
+
+    def strength_vector(self, step):
+        """One f32 strength per group at ``step``: bit-width for
+        weight-quant groups (0 = inactive), pruning ratio for pruning
+        groups (0 = inactive)."""
+        out = np.zeros(len(self.groups), np.float32)
+        for gi, (method, _, shared, gp, _) in enumerate(self.groups):
+            offset = int(shared.get("schedule_offset", 0))
+            if step < offset:
+                continue
+            if method == "weight_quantization":
+                start = int(gp.get("start_bits", 16))
+                target = int(gp.get("target_bits", 8))
+                period = max(int(gp.get("quantization_period", 1)), 1)
+                period *= self._eig_factor.get(gi, 1)
+                halvings = (step - offset) // period
+                bits = start
+                for _ in range(int(halvings)):
+                    if bits <= target:
+                        break
+                    bits = max(bits // 2, target)
+                out[gi] = bits
+            else:
+                out[gi] = 1.0 - float(gp.get("dense_ratio", 1.0))
+        return out
+
+    # --------------------------------------------------------------- apply
+    def _transform(self, w, method, strength, hard):
+        if method == "weight_quantization":
+            bits = strength
+            qmax = jnp.exp2(bits - 1.0) - 1.0       # traced bit-width
+            scale = jnp.max(jnp.abs(w)) / qmax
+            scale = jnp.where(scale > 0, scale, 1.0)
+            q = jnp.round(w / scale) * scale
+            q = q if hard else _ste(w, q.astype(w.dtype))
+            return jnp.where(bits > 0, q, w).astype(w.dtype)
+        if method == "sparse_pruning":
+            thresh = jnp.quantile(jnp.abs(w).astype(jnp.float32).ravel(),
+                                  strength)
+            mask = (jnp.abs(w) >= thresh).astype(w.dtype)
+        elif method == "row_pruning":
+            norms = jnp.linalg.norm(w.astype(jnp.float32), axis=1)
+            thresh = jnp.quantile(norms, strength)
+            mask = (norms >= thresh).astype(w.dtype)[:, None]
+        else:  # head_pruning — rank head slices of the output projection
+            nh = self.num_heads
+            assert nh, "head_pruning needs num_heads (engine passes " \
+                "model cfg.num_heads)"
+            hd = w.shape[0] // nh
+            norms = jnp.linalg.norm(
+                w.astype(jnp.float32).reshape(nh, -1), axis=1)
+            thresh = jnp.quantile(norms, strength)
+            hmask = (norms >= thresh).astype(w.dtype)
+            mask = jnp.repeat(hmask, hd)[:, None]
+        masked = w * mask
+        return (masked if hard else _ste(w, masked)).astype(w.dtype)
+
+    def apply(self, params, strengths, hard=False):
+        """Traced: params tree -> compressed params tree. ``strengths``
+        is the (possibly traced) vector from strength_vector."""
+        import flax.traverse_util
+        flat = flax.traverse_util.flatten_dict(params, sep="/")
+        keys = list(flat.keys())
+        vals = list(flat.values())
+        for gi, (method, _, _, _, pos) in enumerate(self.groups):
+            for i in pos:
+                vals[i] = self._transform(vals[i], method, strengths[gi],
+                                          hard)
+        return flax.traverse_util.unflatten_dict(
+            dict(zip(keys, vals)), sep="/")
+
+
+# --------------------------------------------------------------- public API
+def init_compression(params, deepspeed_config, teacher_params=None,
+                     num_heads=None):
+    """Reference compress.py:95 as a functional pair: returns
+    ``(params, runtime)`` where ``runtime.apply(params,
+    runtime.strength_vector(step))`` is the compression-aware forward
+    transform. With ``layer_reduction`` enabled, ``params`` is first
+    re-initialized from ``teacher_params`` (student_initialization)."""
+    cfg = _compression_section(deepspeed_config)
+    lr_cfg = cfg.get("layer_reduction") or {}
+    if lr_cfg.get("enabled"):
+        assert teacher_params is not None, \
+            "layer_reduction needs teacher_params (reference compress.py:115)"
+        params = student_initialization(params, teacher_params,
+                                        deepspeed_config)
+    return params, CompressionRuntime(cfg, params, num_heads=num_heads)
+
+
+def redundancy_clean(params, deepspeed_config, step=None, num_heads=None):
+    """Bake the final masks/quantization grids into the weights
+    (reference compress.py:123): no STE, values are permanently
+    quantized/zeroed. ``step`` defaults to past every schedule."""
+    cfg = _compression_section(deepspeed_config)
+    rt = CompressionRuntime(cfg, params, num_heads=num_heads)
+    step = 10 ** 9 if step is None else step
+    return jax.jit(lambda p, s: rt.apply(p, s, hard=True))(
+        params, rt.strength_vector(step))
+
+
+def student_initialization(student_params, teacher_params,
+                           deepspeed_config):
+    """Teacher->student init for layer reduction (reference
+    compress.py:167): student layer i copies teacher layer
+    ``teacher_layer[i]``; embeddings and ``other_module_name`` subtrees
+    copy through by name. Layer subtrees are matched as
+    ``{module_name_prefix}{index}`` keys (our models use ``h_{i}``)."""
+    cfg = _compression_section(deepspeed_config).get("layer_reduction", {})
+    prefix = cfg.get("module_name_prefix", "h_")
+    teacher_layers = list(cfg.get("teacher_layer", []))
+    keep = int(cfg.get("keep_number_layer", len(teacher_layers)))
+    assert len(teacher_layers) >= keep
+    out = jax.tree_util.tree_map(lambda x: x, student_params)  # copy
+    for i in range(keep):
+        skey, tkey = f"{prefix}{i}", f"{prefix}{teacher_layers[i]}"
+        assert skey in out and tkey in teacher_params, (skey, tkey)
+        out[skey] = jax.tree_util.tree_map(lambda x: x,
+                                           teacher_params[tkey])
+    for name in cfg.get("other_module_name", None) or \
+            [k for k in out if not k.startswith(prefix)]:
+        if name in teacher_params:
+            out[name] = jax.tree_util.tree_map(lambda x: x,
+                                               teacher_params[name])
+    return out
+
+
+def _compression_section(deepspeed_config):
+    if hasattr(deepspeed_config, "compression_training"):
+        return deepspeed_config.compression_training or {}
+    if isinstance(deepspeed_config, dict):
+        return deepspeed_config.get("compression_training",
+                                    deepspeed_config)
+    raise TypeError(f"unusable config {deepspeed_config!r}")

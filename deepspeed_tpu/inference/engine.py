@@ -242,12 +242,17 @@ class InferenceEngine:
 
     def _materialize(self, params):
         """Inside a jitted computation: stream host-offloaded leaves to
-        device memory (XLA schedules each transfer next to its consumer)
-        and dequantize QTensor leaves — in that order, so offloaded int8
-        weights cross the host-device link quantized."""
+        device memory (XLA schedules each transfer next to its consumer).
+        QTensor leaves pass through untouched when the module is
+        quant-aware (our models' QDense consumes them directly — on a
+        single TPU chip via the Pallas dequant-matmul, so the weight
+        never materializes in bf16); only legacy float-kernel modules get
+        the whole-tree dequantize. Offloaded int8 weights cross the
+        host-device link quantized either way."""
         if getattr(self, "_offload_params", False):
             params = jax.tree.map(jax.device_put, params, self._mat_sh)
-        if not self._config.quant.enabled:
+        if not self._config.quant.enabled or \
+                getattr(self.module, "qtensor_params", False):
             return params
         from deepspeed_tpu.ops.quant import dequantize_tree
         return dequantize_tree(params)

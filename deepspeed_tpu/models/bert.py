@@ -43,10 +43,11 @@ class BertConfig:
 
 
 def _dense(cfg, features, axes, name):
-    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    kernel_init=nn.with_partitioning(
-                        nn.initializers.normal(0.02), axes),
-                    name=name)
+    from deepspeed_tpu.ops.quant.qdense import QDense
+    return QDense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                  kernel_init=nn.with_partitioning(
+                      nn.initializers.normal(0.02), axes),
+                  name=name)
 
 
 class BertSelfAttention(nn.Module):
@@ -116,6 +117,8 @@ class Bert(nn.Module):
     """Returns MLM logits [b, l, vocab] (the pretraining objective the
     reference's BERT benchmarks train)."""
     cfg: BertConfig
+
+    qtensor_params = True   # QDense consumes QTensor kernels (int8 serving)
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, attention_mask=None,

@@ -81,7 +81,8 @@ class GPTConfig:
 
 
 def _dense(features, cfg, kernel_axes, name=None, use_bias=None):
-    return nn.Dense(
+    from deepspeed_tpu.ops.quant.qdense import QDense
+    return QDense(
         features,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
@@ -224,8 +225,10 @@ class Block(nn.Module):
     window: int = 0
 
     @nn.compact
-    def __call__(self, x, deterministic=True, cache=None, positions=None):
+    def __call__(self, x, deterministic=True, cache=None, positions=None,
+                 pld_keep=None):
         cfg = self.cfg
+        x_in = x
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            name="ln_1")(x)
         attn_out, new_cache = SelfAttention(cfg, self.window, name="attn")(
@@ -237,24 +240,33 @@ class Block(nn.Module):
                 epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_2")(x)
             assert not self.use_moe, "parallel residual + MoE unsupported"
             mlp_out = MLP(cfg, name="mlp")(h, deterministic)
-            return x + attn_out + mlp_out, new_cache
-        x = x + attn_out
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         name="ln_2")(x)
-        if self.use_moe:
-            from deepspeed_tpu.moe import MoE
-            h, _, _ = MoE(hidden_size=cfg.hidden_size,
-                          num_experts=cfg.moe_num_experts,
-                          ffn_hidden_size=cfg.mlp_ratio * cfg.hidden_size,
-                          k=cfg.moe_top_k,
-                          capacity_factor=cfg.moe_capacity_factor,
-                          min_capacity=cfg.moe_min_capacity,
-                          use_residual=cfg.moe_use_residual,
-                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                          name="moe")(h, deterministic)
+            out = x + attn_out + mlp_out
         else:
-            h = MLP(cfg, name="mlp")(h, deterministic)
-        return x + h, new_cache
+            x = x + attn_out
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="ln_2")(x)
+            if self.use_moe:
+                from deepspeed_tpu.moe import MoE
+                h, _, _ = MoE(hidden_size=cfg.hidden_size,
+                              num_experts=cfg.moe_num_experts,
+                              ffn_hidden_size=cfg.mlp_ratio * cfg.hidden_size,
+                              k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              min_capacity=cfg.moe_min_capacity,
+                              use_residual=cfg.moe_use_residual,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              name="moe")(h, deterministic)
+            else:
+                h = MLP(cfg, name="mlp")(h, deterministic)
+            out = x + h
+        if pld_keep is not None:
+            # progressive layer drop (reference
+            # runtime/progressive_layer_drop.py + the PLD paper's
+            # stochastic depth): with prob 1 - pld_keep the whole block
+            # is skipped this step — the residual stream passes through
+            keep = jax.random.bernoulli(self.make_rng("pld"), pld_keep)
+            out = jnp.where(keep, out, x_in)
+        return out, new_cache
 
 
 def _make_embed_tables(mdl, cfg):
@@ -303,9 +315,13 @@ class GPT2(nn.Module):
     (logits, new_cache) — same decode contract as models/llama.py."""
     cfg: GPTConfig
 
+    # QDense layers consume QTensor kernel leaves directly (int8 serving
+    # without whole-tree dequantization; inference/engine._materialize)
+    qtensor_params = True
+
     @nn.compact
     def __call__(self, input_ids, deterministic=True, positions=None,
-                 cache=None):
+                 cache=None, pld_theta=None):
         cfg = self.cfg
         b, l = input_ids.shape
         if positions is None:
@@ -317,6 +333,16 @@ class GPT2(nn.Module):
         if cfg.embed_layernorm:
             x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                              name="ln_embed")(x)
+
+        # progressive layer drop: keep prob shrinks with depth,
+        # keep_l = 1 - (l/L) * (1 - theta) (PLD paper's progressive
+        # schedule; theta from runtime/progressive_layer_drop.py via the
+        # engine). Needs an apply-time "pld" rng.
+        pld_keeps = None
+        if pld_theta is not None and cache is None:
+            fracs = (jnp.arange(cfg.num_layers) + 1.0) / cfg.num_layers
+            pld_keeps = (1.0 - fracs * (1.0 - pld_theta)).astype(
+                jnp.float32)
 
         block = Block
         if cfg.remat and cache is None:
@@ -332,16 +358,21 @@ class GPT2(nn.Module):
             # memory (ZeRO-3 param offload) XLA's scan streams one layer
             # slice to HBM per step — the partitioned_param_coordinator's
             # prefetch loop (reference :218) as a compiler schedule.
-            scanned = nn.scan(
-                block,
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = scanned(cfg, False, name="h_scan")(
-                x, deterministic, None, positions)
+            sc = dict(variable_axes={"params": 0},
+                      split_rngs={"params": True, "dropout": True,
+                                  "pld": True},
+                      length=cfg.num_layers,
+                      metadata_params={nn.PARTITION_NAME: "layers"})
+            if pld_keeps is None:
+                scanned = nn.scan(block, in_axes=(
+                    nn.broadcast, nn.broadcast, nn.broadcast), **sc)
+                x, _ = scanned(cfg, False, name="h_scan")(
+                    x, deterministic, None, positions)
+            else:   # per-layer keep prob rides the scan axis
+                scanned = nn.scan(block, in_axes=(
+                    nn.broadcast, nn.broadcast, nn.broadcast, 0), **sc)
+                x, _ = scanned(cfg, False, name="h_scan")(
+                    x, deterministic, None, positions, pld_keeps)
         else:
             if cfg.scan_layers:
                 raise ValueError(
@@ -355,7 +386,8 @@ class GPT2(nn.Module):
                 win = cfg.attn_windows[i] if i < len(cfg.attn_windows) else 0
                 layer_cache = cache["layers"][i] if cache is not None else None
                 x, new_c = block(cfg, use_moe, win, name=f"h_{i}")(
-                    x, deterministic, layer_cache, positions)
+                    x, deterministic, layer_cache, positions,
+                    None if pld_keeps is None else pld_keeps[i])
                 new_layer_caches.append(new_c)
 
         logits = _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)

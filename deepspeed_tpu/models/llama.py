@@ -64,11 +64,12 @@ class RMSNorm(nn.Module):
 
 
 def _proj(cfg, features, axes, name):
-    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
-                    param_dtype=cfg.param_dtype,
-                    kernel_init=nn.with_partitioning(
-                        nn.initializers.normal(0.02), axes),
-                    name=name)
+    from deepspeed_tpu.ops.quant.qdense import QDense
+    return QDense(features, use_bias=False, dtype=cfg.dtype,
+                  param_dtype=cfg.param_dtype,
+                  kernel_init=nn.with_partitioning(
+                      nn.initializers.normal(0.02), axes),
+                  name=name)
 
 
 from deepspeed_tpu.ops.attention.decode import _repeat_kv  # GQA expansion
@@ -168,6 +169,8 @@ class LlamaBlock(nn.Module):
 class Llama(nn.Module):
     """Returns logits [b, l, vocab]; with ``cache`` returns (logits, cache)."""
     cfg: LlamaConfig
+
+    qtensor_params = True   # QDense consumes QTensor kernels (int8 serving)
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, positions=None,

@@ -132,15 +132,18 @@ class MoE(nn.Module):
 
         if self.use_residual:
             # PR-MoE: dense MLP branch mixed by a learned 2-way coefficient
-            # (reference layer.py forward, use_residual branch)
-            dense = nn.Dense(ffn, dtype=self.dtype,
-                             param_dtype=self.param_dtype, name="res_fc_in")(x)
+            # (reference layer.py forward, use_residual branch). QDense so
+            # int8 serving can quantize these kernels like every other
+            # Dense in the models (qtensor_params contract).
+            from deepspeed_tpu.ops.quant.qdense import QDense
+            dense = QDense(ffn, dtype=self.dtype,
+                           param_dtype=self.param_dtype, name="res_fc_in")(x)
             dense = self.activation(dense)
-            dense = nn.Dense(m, dtype=self.dtype,
-                             param_dtype=self.param_dtype,
-                             name="res_fc_out")(dense)
-            coef = nn.Dense(2, dtype=jnp.float32, param_dtype=jnp.float32,
-                            name="coefficient")(x.astype(jnp.float32))
+            dense = QDense(m, dtype=self.dtype,
+                           param_dtype=self.param_dtype,
+                           name="res_fc_out")(dense)
+            coef = QDense(2, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="coefficient")(x.astype(jnp.float32))
             coef = jax.nn.softmax(coef, axis=-1)
             out = (out * coef[..., 0:1] + dense * coef[..., 1:2]).astype(x.dtype)
 

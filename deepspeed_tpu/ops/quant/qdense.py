@@ -1,0 +1,87 @@
+"""QTensor-aware Dense layer — the serving-side "kernel-injected Linear".
+
+Reference: the quantized Linear the GPU inference kernels swap in during
+module injection (``module_inject/replace_module.py:138`` GroupQuantizer
++ ``csrc/transformer/inference/csrc/pt_binding.cpp`` int8 GEMM). The TPU
+design keeps ONE module for both regimes: the param tree decides. A
+float ``kernel`` leaf reproduces ``nn.Dense`` numerics bit-for-bit (same
+promote_dtype + dot_general), and a :class:`QTensor` leaf routes through
+the int8 path, so quantization is a pure tree transformation
+(``quantize_tree``) with no module surgery.
+
+Quantized matmul implementation is chosen at trace time:
+
+* ``pallas`` — the tiled dequant-in-VMEM kernel (kernels.int8_matmul);
+  the int8 weight streams from HBM, halving decode bandwidth (measured
+  1.8x faster than the bf16 matmul at HBM-streaming decode shapes on
+  v5e).
+* ``xla`` — ``x @ dequant`` under jit. XLA materializes the bf16 weight
+  (measured 2-4x slower than bf16 at decode), but every op is standard,
+  so it partitions under SPMD sharding.
+* ``auto`` (default) — pallas on a single TPU device, xla otherwise
+  (pallas_call does not auto-partition under jit SPMD; multi-chip
+  quantized serving takes the xla path until the kernel grows a
+  custom_partitioning rule).
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quant.quantizer import QTensor
+
+
+def _quant_impl(impl):
+    if impl != "auto":
+        return impl
+    return "pallas" if (jax.default_backend() == "tpu"
+                        and jax.device_count() == 1) else "xla"
+
+
+def quant_matmul(x, qt, impl="auto"):
+    """x [..., k] @ dequant(qt) -> [..., n], impl per module docstring."""
+    from deepspeed_tpu.ops.quant.kernels import int8_matmul
+    if _quant_impl(impl) == "xla":
+        return x @ qt.dequant().astype(x.dtype)
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    y = int8_matmul(x.reshape(m, x.shape[-1]), qt.q, qt.scale)
+    return y.reshape(*lead, y.shape[-1])
+
+
+class QDense(nn.Module):
+    """Drop-in ``nn.Dense`` with a QTensor fast path (see module doc)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    quant_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, inputs):
+        kernel = self.param("kernel", self.kernel_init,
+                            (jnp.shape(inputs)[-1], self.features),
+                            self.param_dtype)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          self.param_dtype) if self.use_bias else None
+        if isinstance(kernel, QTensor):
+            x = inputs.astype(self.dtype or kernel.dtype)
+            y = quant_matmul(x, kernel, impl=self.quant_impl)
+            if bias is not None:
+                y = y + jnp.asarray(bias, y.dtype)
+            return y
+        # float path: exactly nn.Dense (promote + dot_general + bias)
+        inputs, kernel, bias = nn.dtypes.promote_dtype(
+            inputs, kernel, bias, dtype=self.dtype)
+        y = jax.lax.dot_general(inputs, kernel,
+                                (((inputs.ndim - 1,), (0,)), ((), ())))
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y

@@ -91,14 +91,15 @@ def resolve_policy(cfg, mesh=None):
 
 
 def wrap_loss_fn(loss_fn, cfg, mesh=None):
-    """Wrap a ``loss_fn(params, batch, rng)`` with jax.checkpoint per the
-    config section; returns loss_fn unchanged when the section requests
-    nothing."""
+    """Wrap a ``loss_fn(params, batch, rng, **kw)`` with jax.checkpoint
+    per the config section; returns loss_fn unchanged when the section
+    requests nothing. Extra kwargs (e.g. the engine's ``pld_theta``)
+    pass through as traced positionals via closure conversion."""
     policy = resolve_policy(cfg, mesh)
     if policy is None:
         return loss_fn
     inner = jax.checkpoint(
-        lambda params, batch, rng: loss_fn(params, batch, rng),
+        lambda params, batch, rng, **kw: loss_fn(params, batch, rng, **kw),
         policy=policy, prevent_cse=False)
     inner.__wrapped_by_activation_checkpointing__ = True
     return inner

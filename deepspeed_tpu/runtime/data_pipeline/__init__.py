@@ -1,8 +1,12 @@
 """Data-efficiency pipeline (reference ``deepspeed/runtime/data_pipeline/``:
-curriculum learning on sequence length + random layerwise token dropping).
+curriculum learning on sequence length, difficulty-indexed data sampling
+(v2), and random layerwise token dropping).
 """
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
     CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (  # noqa: F401
+    CurriculumIndexLoader, DataAnalyzer, DeepSpeedDataSampler, MetricIndex,
+    find_fit_int_dtype)
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (  # noqa: F401
     RandomLTDScheduler, random_ltd_gather, random_ltd_scatter)

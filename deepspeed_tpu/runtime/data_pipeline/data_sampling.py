@@ -1,0 +1,466 @@
+"""Data-efficiency v2 sampling suite: difficulty-metric analysis +
+curriculum data sampler with deterministic mid-epoch resume.
+
+Reference: ``deepspeed/runtime/data_pipeline/data_sampling/`` —
+``data_analyzer.py:20`` (map/reduce metric pass over the dataset),
+``data_sampler.py:36`` (``DeepSpeedDataSampler``: per-step difficulty
+thresholds -> sample clusters -> weighted cluster sampling),
+``indexed_dataset.py:1`` (Megatron mmap bin/idx container).
+
+TPU/numpy redesign (same capability, different data model):
+
+* Index files are plain ``.npy`` arrays opened with ``mmap_mode="r"`` —
+  no Megatron bin/idx container needed. Per metric the analyzer emits
+  three aligned files under one prefix:
+    ``{prefix}_sample_to_metric.npy``  value per sample, dataset order
+    ``{prefix}_sorted_samples.npy``    sample ids ascending by value
+    ``{prefix}_sorted_values.npy``     the values, same order
+  The sorted pair replaces the reference's value-bucketed
+  ``metric_to_sample`` rows: value-range selection is two
+  ``np.searchsorted`` calls on the memmap instead of a scan over every
+  bucket, and percentile selection is a slice.
+
+* The sampler needs no collective: JAX training here is
+  single-controller (the engine feeds GLOBAL batches and shards them
+  over the mesh), and the batch stream is a pure function of the config
+  seed, so any process that needs the stream recomputes it — the
+  reference's rank-0 + ``dist.broadcast`` protocol
+  (data_sampler.py:278-290) becomes deterministic replay.
+
+* ``state_dict``/``load_state_dict`` carry the np Generator state, the
+  in-flight batch remainder, cluster descriptors and read positions —
+  resuming mid-epoch reproduces the exact uninterrupted sample stream
+  (tested in tests/unit/test_data_sampling.py).
+"""
+
+import os
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+
+
+def find_fit_int_dtype(min_value, max_value):
+    """Smallest numpy integer dtype covering [min_value, max_value]
+    (reference data_sampling/utils.py:21)."""
+    if min_value >= 0:
+        for dt in (np.uint8, np.uint16, np.uint32, np.uint64):
+            if max_value <= np.iinfo(dt).max:
+                return dt
+    else:
+        for dt in (np.int8, np.int16, np.int32, np.int64):
+            if np.iinfo(dt).min <= min_value and \
+                    max_value <= np.iinfo(dt).max:
+                return dt
+    raise ValueError((min_value, max_value))
+
+
+# --------------------------------------------------------------------------
+# analyzer
+# --------------------------------------------------------------------------
+class DataAnalyzer:
+    """Map/reduce difficulty-metric pass over an indexable dataset
+    (reference data_analyzer.py:20).
+
+    ``metric_functions`` get a LIST of raw samples (this worker's batch)
+    and return one value per sample (``single_value_per_sample``) or one
+    aggregate (``accumulate_value_over_samples``). Values must be
+    integers — ties and exact threshold comparisons stay exact (the
+    reference enforces the same, data_analyzer.py:64).
+
+    Workers split the dataset into contiguous shards; each writes
+    ``{prefix}_worker{W}.npy``. ``run_reduce`` concatenates the shards
+    in worker order (so the final file is dataset-ordered) and builds
+    the sorted index.
+    """
+
+    def __init__(self, dataset, num_workers=1, worker_id=0, batch_size=64,
+                 metric_names=(), metric_functions=(), metric_types=(),
+                 save_path="./", collate_fn=None):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types) or \
+            ["single_value_per_sample"] * len(self.metric_names)
+        self.save_path = save_path
+        self.collate_fn = collate_fn
+
+    def _prefix(self, metric):
+        return os.path.join(self.save_path, metric)
+
+    def _worker_range(self, worker_id):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return worker_id * per, min((worker_id + 1) * per, n)
+
+    def run_map(self):
+        os.makedirs(self.save_path, exist_ok=True)
+        start, end = self._worker_range(self.worker_id)
+        results = [[] for _ in self.metric_names]
+        for s in range(start, end, self.batch_size):
+            batch = [self.dataset[i] for i in range(
+                s, min(s + self.batch_size, end))]
+            if self.collate_fn is not None:
+                batch = self.collate_fn(batch)
+            for m, fn in enumerate(self.metric_functions):
+                vals = np.asarray(fn(batch))
+                if self.metric_types[m] == "single_value_per_sample":
+                    assert np.issubdtype(vals.dtype, np.integer), \
+                        f"metric {self.metric_names[m]} must be integer-" \
+                        "valued (reference data_analyzer.py:64)"
+                    results[m].append(vals.reshape(-1))
+                else:  # accumulate_value_over_samples
+                    results[m].append(vals)
+        for m, name in enumerate(self.metric_names):
+            if self.metric_types[m] == "single_value_per_sample":
+                out = np.concatenate(results[m]) if results[m] else \
+                    np.zeros(0, np.int64)
+            else:
+                out = np.sum(np.stack(results[m]), axis=0) if results[m] \
+                    else np.zeros(0, np.int64)
+            np.save(f"{self._prefix(name)}_worker{self.worker_id}.npy", out)
+
+    def run_reduce(self):
+        for m, name in enumerate(self.metric_names):
+            parts = []
+            for w in range(self.num_workers):
+                f = f"{self._prefix(name)}_worker{w}.npy"
+                assert os.path.exists(f), \
+                    f"missing worker shard {f}: run_map all workers first"
+                parts.append(np.load(f))
+            if self.metric_types[m] == "single_value_per_sample":
+                s2m = np.concatenate(parts)
+                assert len(s2m) == len(self.dataset)
+                dt = find_fit_int_dtype(int(s2m.min(initial=0)),
+                                        int(s2m.max(initial=0)))
+                np.save(f"{self._prefix(name)}_sample_to_metric.npy",
+                        s2m.astype(dt))
+                order = np.argsort(s2m, kind="stable")
+                idt = find_fit_int_dtype(0, len(s2m))
+                np.save(f"{self._prefix(name)}_sorted_samples.npy",
+                        order.astype(idt))
+                np.save(f"{self._prefix(name)}_sorted_values.npy",
+                        s2m[order].astype(dt))
+            else:
+                np.save(f"{self._prefix(name)}_metric_value.npy",
+                        np.sum(np.stack(parts), axis=0))
+            for w in range(self.num_workers):
+                os.remove(f"{self._prefix(name)}_worker{w}.npy")
+
+    def run_map_reduce(self):
+        assert self.num_workers == 1 or self.worker_id == 0, \
+            "run_map_reduce is the single-process convenience path"
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.num_workers, w, self.batch_size,
+                         self.metric_names, self.metric_functions,
+                         self.metric_types, self.save_path,
+                         self.collate_fn).run_map()
+        self.run_reduce()
+
+
+class MetricIndex:
+    """Memmapped view over one metric's analyzer output."""
+
+    def __init__(self, prefix):
+        self.sample_to_metric = np.load(
+            prefix + "_sample_to_metric.npy", mmap_mode="r")
+        self.sorted_samples = np.load(
+            prefix + "_sorted_samples.npy", mmap_mode="r")
+        self.sorted_values = np.load(
+            prefix + "_sorted_values.npy", mmap_mode="r")
+
+    def __len__(self):
+        return len(self.sample_to_metric)
+
+    def samples_in_value_range(self, lo, hi):
+        """Sample ids with metric value in (lo, hi] — the reference's
+        get_sample_based_on_metric_value (data_sampler.py:127) as two
+        binary searches on the sorted index."""
+        a = np.searchsorted(self.sorted_values, lo, side="right")
+        b = np.searchsorted(self.sorted_values, hi, side="right")
+        return np.asarray(self.sorted_samples[a:b])
+
+    def samples_in_percentile_range(self, p_start, p_end, max_percentile):
+        """Reference get_sample_based_on_metric_percentile
+        (data_sampler.py:137): count-based slices of the sorted order."""
+        n = len(self)
+        per = n // max_percentile
+        a = per * p_start
+        b = n if p_end == max_percentile else per * p_end
+        return np.asarray(self.sorted_samples[a:b])
+
+
+# --------------------------------------------------------------------------
+# sampler
+# --------------------------------------------------------------------------
+class DeepSpeedDataSampler:
+    """Curriculum data sampler (reference data_sampler.py:36).
+
+    ``data_efficiency_config`` mirrors the reference json keys::
+
+        {"seed": 1234,
+         "data_sampling": {"num_epochs": N,
+           "curriculum_learning": {"enabled": true,
+             "data_cluster_path": dir,
+             "curriculum_metrics": {
+               "<metric>": {"index_prefix": path-prefix,
+                            "difficulty_type": "value"|"percentile",
+                            "clustering_type": "cluster"|"single_cluster",
+                            "min_difficulty": ..., "max_difficulty": ...,
+                            "schedule_type": ..., "schedule_config": {...}}}}}}
+
+    (``index_prefix`` replaces the reference's ``index_to_sample_path``/
+    ``index_to_metric_path`` pair — one prefix names all three npy files
+    the analyzer wrote.)
+
+    Yields per-data-parallel-rank lists of sample indices, one micro
+    batch per ``__iter__`` step; a new GLOBAL batch is drawn (and the
+    curriculum stepped) every ``micro_batch x dp_size x gas`` samples.
+    """
+
+    def __init__(self, data_efficiency_config, one_epoch_total_samples,
+                 micro_batch_size, data_parallel_rank=0,
+                 data_parallel_size=1, data_parallel_group=None,
+                 gradient_accumulation_steps=1, global_rank=0,
+                 drop_last=True):
+        self.config = data_efficiency_config
+        self.one_epoch_total_samples = int(one_epoch_total_samples)
+        ds_cfg = data_efficiency_config.get("data_sampling", {})
+        self.total_samples = self.one_epoch_total_samples * \
+            int(ds_cfg.get("num_epochs", 1000))
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.micro_batch_times_data_parallel_size = \
+            micro_batch_size * data_parallel_size
+        self.global_batch_size = (self.micro_batch_times_data_parallel_size
+                                  * gradient_accumulation_steps)
+        self.global_rank = global_rank
+        self.drop_last = drop_last
+        self.index_dtype = find_fit_int_dtype(0, one_epoch_total_samples)
+        self.np_rng = np.random.default_rng(
+            int(data_efficiency_config.get("seed", 1234)))
+        self.batch = []
+        self.consumed_samples = 0
+
+        cl = ds_cfg.get("curriculum_learning", {})
+        self.curriculum_enabled = bool(cl.get("enabled", False))
+        if self.curriculum_enabled:
+            self.cluster_path = cl["data_cluster_path"]
+            os.makedirs(self.cluster_path, exist_ok=True)
+            self.curriculum_step = 0
+            self.current_difficulties = {}
+            self.data_cluster_paths = []
+            self.data_cluster_current_position = []
+            self.data_clusters = []       # in-memory index arrays
+            self.data_cluster_sizes = []
+            self.curriculum_schedulers = {}
+            self.difficulty_type = {}
+            self.clustering_type = {}
+            self.metric_index = {}
+            for metric, mcfg in cl["curriculum_metrics"].items():
+                self.curriculum_schedulers[metric] = \
+                    CurriculumScheduler(mcfg)
+                self.difficulty_type[metric] = mcfg["difficulty_type"]
+                self.clustering_type[metric] = \
+                    mcfg.get("clustering_type", "cluster")
+                if self.clustering_type[metric] != "single_cluster":
+                    self.metric_index[metric] = MetricIndex(
+                        mcfg["index_prefix"])
+
+        assert self.total_samples > 0
+        assert self.micro_batch_size > 0
+        assert data_parallel_size > 0
+        assert self.data_parallel_rank < data_parallel_size
+
+    def __len__(self):
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, fns):
+        for metric, sched in self.curriculum_schedulers.items():
+            if metric in fns:
+                sched.set_custom_get_difficulty(fns[metric])
+
+    # ------------------------------------------------------------- clusters
+    def _admitted(self, metric, lo, hi):
+        if self.difficulty_type[metric] == "value":
+            return self.metric_index[metric].samples_in_value_range(lo, hi)
+        maxd = self.curriculum_schedulers[metric].max_difficulty
+        return self.metric_index[metric].samples_in_percentile_range(
+            lo, hi, maxd)
+
+    def _new_cluster(self, previous_difficulties):
+        fname = "cluster"
+        for metric in self.curriculum_schedulers:
+            fname += f"_{metric}{self.current_difficulties[metric]}"
+        path = os.path.join(self.cluster_path, fname + ".npy")
+
+        multi = sum(1 for m, t in self.clustering_type.items()
+                    if t != "single_cluster") > 1
+        if multi:
+            # intersect each metric's full admitted set, minus what
+            # earlier clusters already hold (reference
+            # data_sampler.py:178-195)
+            new = None
+            for metric in self.curriculum_schedulers:
+                if self.clustering_type[metric] == "single_cluster":
+                    part = np.arange(self.one_epoch_total_samples,
+                                     dtype=self.index_dtype)
+                else:
+                    lo = float("-inf") \
+                        if self.difficulty_type[metric] == "value" else 0
+                    part = self._admitted(
+                        metric, lo, self.current_difficulties[metric])
+                new = part if new is None else np.intersect1d(
+                    new, part, assume_unique=True)
+            for cluster in self.data_clusters:
+                new = np.setdiff1d(new, cluster, assume_unique=True)
+        else:
+            new = None
+            if not self.data_clusters:
+                new = np.arange(self.one_epoch_total_samples,
+                                dtype=self.index_dtype)
+            for metric in self.curriculum_schedulers:
+                if self.clustering_type[metric] != "single_cluster":
+                    new = self._admitted(metric,
+                                         previous_difficulties[metric],
+                                         self.current_difficulties[metric])
+        if new is not None and len(new):
+            new = np.array(new, dtype=self.index_dtype)
+            self.np_rng.shuffle(new)
+            if self.global_rank == 0:
+                np.save(path, new)
+            self.data_clusters.append(new)
+            self.data_cluster_sizes.append(len(new))
+            self.data_cluster_paths.append(fname)
+            self.data_cluster_current_position.append(0)
+
+    def _sample_from_clusters(self):
+        sizes = np.asarray(self.data_cluster_sizes, np.float64)
+        if sizes.sum() == 0:
+            raise ValueError(
+                "curriculum admitted zero samples at step "
+                f"{self.curriculum_step} (difficulties "
+                f"{self.current_difficulties}): no metric value falls at "
+                "or below the current threshold — raise min_difficulty "
+                "or speed up the schedule")
+        weights = sizes / sizes.sum()
+        picks = self.np_rng.choice(len(self.data_clusters),
+                                   self.global_batch_size, replace=True,
+                                   p=weights)
+        return np.bincount(picks, minlength=len(self.data_clusters))
+
+    def _take_from_cluster(self, cidx, num):
+        pos = self.data_cluster_current_position[cidx]
+        cluster = self.data_clusters[cidx]
+        out = list(cluster[pos:pos + num])
+        self.data_cluster_current_position[cidx] = pos + num
+        if len(out) < num:   # exhausted: reshuffle and wrap (reference
+            remain = num - len(out)      # get_sample_from_cluster :246)
+            reshuffled = np.array(cluster)
+            self.np_rng.shuffle(reshuffled)
+            self.data_clusters[cidx] = reshuffled
+            if self.global_rank == 0:
+                np.save(os.path.join(
+                    self.cluster_path,
+                    self.data_cluster_paths[cidx] + ".npy"), reshuffled)
+            out += list(reshuffled[:remain])
+            self.data_cluster_current_position[cidx] = remain
+        return out
+
+    def _next_global_batch(self):
+        if self.curriculum_enabled:
+            self.curriculum_step += 1
+            changed = False
+            previous = {}
+            for metric, sched in self.curriculum_schedulers.items():
+                nxt = sched.update_difficulty(self.curriculum_step)
+                if metric not in self.current_difficulties or \
+                        nxt != self.current_difficulties[metric]:
+                    changed = True
+                previous[metric] = self.current_difficulties.get(
+                    metric,
+                    float("-inf")
+                    if self.difficulty_type[metric] == "value" else 0)
+                self.current_difficulties[metric] = nxt
+            if changed:
+                self._new_cluster(previous)
+            per_cluster = self._sample_from_clusters()
+            batch = []
+            for cidx, num in enumerate(per_cluster):
+                batch += self._take_from_cluster(cidx, int(num))
+            self.np_rng.shuffle(batch)
+            self.batch = [int(i) for i in batch]
+        else:
+            self.batch = [
+                int(i) for i in self.np_rng.integers(
+                    0, self.one_epoch_total_samples, self.global_batch_size)]
+
+    def __iter__(self):
+        while self.consumed_samples <= self.total_samples:
+            if len(self.batch) == 0:
+                self._next_global_batch()
+            cur = self.batch[:self.micro_batch_times_data_parallel_size]
+            self.batch = self.batch[
+                self.micro_batch_times_data_parallel_size:]
+            if len(cur) == self.micro_batch_times_data_parallel_size or \
+                    (len(cur) > 0 and not self.drop_last):
+                a = self.data_parallel_rank * self.micro_batch_size
+                yield cur[a:a + self.micro_batch_size]
+                self.consumed_samples += len(cur)
+
+    # ---------------------------------------------------------------- state
+    def state_dict(self):
+        return {
+            "batch": list(self.batch),
+            "consumed_samples": self.consumed_samples,
+            "curriculum_step": getattr(self, "curriculum_step", 0),
+            "current_difficulties": dict(
+                getattr(self, "current_difficulties", {})),
+            "data_cluster_paths": list(
+                getattr(self, "data_cluster_paths", [])),
+            "data_cluster_current_position": list(
+                getattr(self, "data_cluster_current_position", [])),
+            "np_rng_state": self.np_rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, sd):
+        self.batch = list(sd["batch"])
+        self.consumed_samples = sd["consumed_samples"]
+        self.np_rng.bit_generator.state = sd["np_rng_state"]
+        if self.curriculum_enabled:
+            self.curriculum_step = sd["curriculum_step"]
+            self.current_difficulties = dict(sd["current_difficulties"])
+            self.data_cluster_paths = list(sd["data_cluster_paths"])
+            self.data_cluster_current_position = list(
+                sd["data_cluster_current_position"])
+            self.data_clusters = []
+            self.data_cluster_sizes = []
+            for fname in self.data_cluster_paths:
+                arr = np.load(os.path.join(self.cluster_path,
+                                           fname + ".npy"))
+                self.data_clusters.append(arr)
+                self.data_cluster_sizes.append(len(arr))
+
+
+class CurriculumIndexLoader:
+    """Loader over (dataset, DeepSpeedDataSampler): each sampler yield is
+    a list of sample ids collated into one batch (the deepspeed_io
+    integration point, reference engine.py:1561)."""
+
+    def __init__(self, dataset, sampler, collate_fn=None):
+        from deepspeed_tpu.runtime.dataloader import default_collate
+        self.dataset = dataset
+        self.data_sampler = sampler
+        self.collate_fn = collate_fn or default_collate
+
+    def __len__(self):
+        return len(self.data_sampler) // max(
+            self.data_sampler.micro_batch_times_data_parallel_size, 1)
+
+    def __iter__(self):
+        for idxs in self.data_sampler:
+            yield self.collate_fn([self.dataset[int(i)] for i in idxs])

@@ -156,6 +156,9 @@ class DeepSpeedEngine:
                     model.schedule == "1f1b":
                 loss_fn = model.make_loss_fn()
         self.loss_fn = loss_fn or self._default_loss_fn()
+        # pre-wrap reference: the activation-checkpointing wrapper takes
+        # **kw, which would defeat signature checks (e.g. pld_theta)
+        self._raw_loss_fn = self.loss_fn
         # activation checkpointing section (reference checkpointing.py:474):
         # remat the whole loss under a named policy / host-offload the
         # saved dot products (cpu_checkpointing)
@@ -231,6 +234,56 @@ class DeepSpeedEngine:
         self._next_metrics = None
         self._last_metrics = {}
         self.gas = self._config.gradient_accumulation_steps
+
+        self._data_sampler = None        # data-efficiency v2 sampler
+        self._data_sampler_state = None  # restored before deepspeed_io runs
+
+        # progressive layer drop: theta(t) computed host-side per forward
+        # and handed to the model through the loss fn (reference
+        # engine.py:1139 progressive_layer_drop + :2021 update_state)
+        self.progressive_layer_drop = None
+        if self._config.pld.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self._config.pld.theta, gamma=self._config.pld.gamma)
+            import inspect
+            try:
+                ps = inspect.signature(self._raw_loss_fn).parameters
+                accepts = "pld_theta" in ps or any(
+                    p.kind == p.VAR_KEYWORD for p in ps.values())
+            except (TypeError, ValueError):
+                accepts = True
+            if not accepts:
+                raise ValueError(
+                    "progressive_layer_drop is enabled but the loss_fn "
+                    "does not accept a pld_theta kwarg — add "
+                    "`pld_theta=None` to its signature and pass it into "
+                    "the model call (models/gpt2.py consumes it)")
+        # compression-aware training: runtime built once params exist
+        # (_ensure_initialized); strengths ride the batch as traced
+        # scalars so schedule changes never recompile
+        self._compression = None
+        # MoQ: eigenvalue-scheduled quantization periods (reference
+        # engine.py:2014-2026)
+        self.eigenvalue = None
+        self._gas_boundary_ctr = 0
+        if self._config.eigenvalue.enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            ev = self._config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                stability=ev.stability,
+                gas_boundary_resolution=ev.gas_boundary_resolution)
+        if getattr(self, "_compressed_axis", None) and (
+                self.progressive_layer_drop is not None
+                or self._config.compression_training):
+            raise ValueError(
+                "progressive_layer_drop / compression_training do not "
+                "compose with the 1-bit compressed gradient path yet "
+                "(its shard_map shards every batch leaf over 'data', "
+                "which the reserved scalar keys cannot satisfy) — "
+                "disable one of the two")
 
         self.timers = SynchronizedWallClockTimer() \
             if self._config.wall_clock_breakdown else NoopTimer()
@@ -330,11 +383,19 @@ class DeepSpeedEngine:
         coef = getattr(getattr(module, "cfg", None), "moe_loss_coef", None)
         moe_coef = 0.01 if coef is None else float(coef)
 
-        def loss_fn(params, batch, rng):
+        def loss_fn(params, batch, rng, pld_theta=None):
+            rngs = None
+            kw = {}
+            if rng is not None:
+                rngs = {"dropout": rng}
+            if pld_theta is not None:   # progressive layer drop active
+                r = rng if rng is not None else jax.random.PRNGKey(0)
+                rngs = dict(rngs or {})
+                rngs["pld"] = jax.random.fold_in(r, 1)
+                kw["pld_theta"] = pld_theta
             logits, mut = module.apply(
-                {"params": params}, batch["input_ids"],
-                rngs={"dropout": rng} if rng is not None else None,
-                mutable=["intermediates"])
+                {"params": params}, batch["input_ids"], rngs=rngs,
+                mutable=["intermediates"], **kw)
             loss = gpt2_loss_fn(logits, batch)
             aux = [v for path, v in
                    flax.traverse_util.flatten_dict(
@@ -520,6 +581,15 @@ class DeepSpeedEngine:
 
             self._onebit_we = jax.tree.map(we_leaf, shapes)
             self._onebit_se = jax.tree.map(se_leaf, shapes)
+        if self._config.compression_training:
+            from deepspeed_tpu.compression.compress import CompressionRuntime
+            self._compression = CompressionRuntime(
+                self._config.compression_training, self.state.params,
+                num_heads=getattr(getattr(self.module, "cfg", None),
+                                  "num_heads", None))
+            log_dist("compression-aware training: "
+                     f"{len(self._compression)} config groups active",
+                     ranks=[0])
         self._build_jitted_fns()
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
         log_dist(f"engine initialized: {n_params / 1e6:.2f}M params, mesh="
@@ -590,15 +660,39 @@ class DeepSpeedEngine:
         # forward-only pipeline AND the backward's forward slots
         loss_and_grads = getattr(loss_fn, "loss_and_grads", None)
 
+        comp = self._compression
+
         def fwd_bwd(params, scale, batch, rng):
+            # reserved keys injected by forward(): compression strengths
+            # and pld theta ride the batch as TRACED scalars, so their
+            # per-step values never trigger a recompile
+            extras = {}
+            if isinstance(batch, dict) and (
+                    "_ds_pld_theta" in batch or "_ds_comp" in batch):
+                batch = dict(batch)
+                for k in ("_ds_pld_theta", "_ds_comp"):
+                    if k in batch:
+                        extras[k] = batch.pop(k)
+            loss_kw = {"pld_theta": extras["_ds_pld_theta"]} \
+                if "_ds_pld_theta" in extras else {}
+
+            def prep(p):
+                p = cast(materialize(p))
+                if comp is not None and "_ds_comp" in extras:
+                    p = comp.apply(p, extras["_ds_comp"])
+                return p
+
             if loss_and_grads is not None:
+                assert not extras, \
+                    "compression/pld do not compose with the fused 1F1B " \
+                    "pipeline loss yet"
                 loss, grads = loss_and_grads(cast(materialize(params)), batch)
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) * (scale / gas), grads)
                 return loss, grads
 
             def scaled_loss(p):
-                loss = loss_fn(cast(materialize(p)), batch, rng)
+                loss = loss_fn(prep(p), batch, rng, **loss_kw)
                 return loss.astype(jnp.float32) * scale / gas, loss
 
             (s_loss, loss), grads = jax.value_and_grad(
@@ -984,7 +1078,7 @@ class DeepSpeedEngine:
             "use eval_batch)"
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._last_batch = batch   # for flops_profile / diagnostics
-        dev_batch = self._put_batch(batch)
+        dev_batch = self._inject_reserved_keys(self._put_batch(batch))
         if rng is None:
             rng, self._rng = jax.random.split(self._rng)
         if self._offload is not None:
@@ -1111,6 +1205,7 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
+        self._maybe_update_moq()
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._maybe_log_flops()
 
@@ -1122,6 +1217,80 @@ class DeepSpeedEngine:
                  ("Train/Samples/loss_scale", float(m["loss_scale"]),
                   self.global_samples)])
         return metrics
+
+    def _inject_reserved_keys(self, dev_batch, n_micro=None):
+        """Add the compression/pld reserved keys to a device batch
+        (fwd_bwd pops them): scalars for the per-micro path, stacked
+        [n_micro, ...] for the fused window so the per-micro slice
+        ``x[i]`` works. One theta/strength set per optimizer step,
+        matching the reference's per-boundary updates."""
+        if self._compression is None and \
+                self.progressive_layer_drop is None:
+            return dev_batch
+        assert isinstance(dev_batch, dict), \
+            "compression/pld need dict batches (reserved keys ride the " \
+            "batch into the jitted step)"
+        dev_batch = dict(dev_batch)
+        if self.progressive_layer_drop is not None:
+            theta = self.progressive_layer_drop.update_state(
+                self.global_steps)
+            dev_batch["_ds_pld_theta"] = jnp.float32(theta) \
+                if n_micro is None else jnp.full((n_micro,), theta,
+                                                 jnp.float32)
+        if self._compression is not None:
+            vec = jnp.asarray(
+                self._compression.strength_vector(self.global_steps))
+            dev_batch["_ds_comp"] = vec if n_micro is None else \
+                jnp.tile(vec, (n_micro, 1))
+        return dev_batch
+
+    def _maybe_update_moq(self):
+        """At a gas boundary: recompute MoQ eigenvalue factors every
+        ``gas_boundary_resolution`` boundaries."""
+        self._gas_boundary_ctr += 1
+        if self.eigenvalue is not None and self._compression is not None \
+                and self._gas_boundary_ctr % \
+                self.eigenvalue.gas_boundary_resolution == 0:
+            self._update_moq_eigenvalues()
+
+    def _update_moq_eigenvalues(self):
+        """MoQ: per-group Hessian max-eigenvalues stretch each
+        weight-quantization group's period, so high-curvature parameters
+        quantize slower (reference engine.py:2014-2026 computing
+        block_eigenvalue at gas boundaries + quantize.py:70 factor)."""
+        import flax.traverse_util
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        wq = [gi for gi, g in enumerate(self._compression.groups)
+              if g[0] == "weight_quantization"]
+        if not wq or self._last_batch is None:
+            return
+        batch = self._put_batch(self._last_batch)
+        params = self._live_state().params
+        flat = flax.traverse_util.flatten_dict(params, sep="/")
+        keys, vals = list(flat.keys()), list(flat.values())
+
+        # STABLE loss identity across boundaries/groups: the batch rides
+        # extra_args so the eigenvalue's jitted power step caches
+        if not hasattr(self, "_eig_loss"):
+            self._eig_loss = lambda p, b: self.loss_fn(p, b, None)
+
+        evs = []
+        rng = jax.random.PRNGKey(self.global_steps)
+        for gi in wq:
+            posset = set(self._compression.groups[gi][4])
+            mask = flax.traverse_util.unflatten_dict(
+                {k: (jnp.ones(jnp.shape(v), jnp.float32) if i in posset
+                     else jnp.zeros(jnp.shape(v), jnp.float32))
+                 for i, (k, v) in enumerate(zip(keys, vals))}, sep="/")
+            ev, _ = self.eigenvalue.compute_eigenvalue(
+                self._eig_loss, params, rng=rng, mask=mask,
+                extra_args=(batch,))
+            evs.append(ev)
+        normed = Eigenvalue.normalize_eigenvalues(evs)
+        self._compression.set_eigenvalue_factors(dict(zip(wq, normed)))
+        log_dist(f"MoQ eigenvalues (normalized): "
+                 f"{dict(zip(wq, [round(v, 3) for v in normed]))}",
+                 ranks=[0])
 
     def _join_offload(self):
         """Drain the grad-accumulation worker queue (exceptions surface
@@ -1258,7 +1427,8 @@ class DeepSpeedEngine:
             raise RuntimeError("fused window requires an aligned boundary")
         self.tput_timer.start()
         self._last_batch = batches[0]
-        dev = self._stack_batches(batches)
+        dev = self._inject_reserved_keys(self._stack_batches(batches),
+                                         n_micro=self.gas)
         rng, self._rng = jax.random.split(self._rng)
         mean_loss_dev, new_state, metrics = self._step_gasN(
             self.state.params, self.state.opt_state,
@@ -1272,6 +1442,7 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
+        self._maybe_update_moq()
         self.tput_timer.stop(global_step=True)
         self._maybe_log_flops()
         if self.global_steps % self._config.steps_per_print == 0:
@@ -1312,6 +1483,30 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------- io
     def deepspeed_io(self, dataset, collate_fn=None, route="train"):
+        de = self._config.data_efficiency or {}
+        ds_cfg = de.get("data_sampling", {}) if de.get("enabled") else {}
+        if route == "train" and ds_cfg.get("enabled") and \
+                ds_cfg.get("curriculum_learning", {}).get("enabled"):
+            # data-efficiency v2: difficulty-indexed curriculum sampling
+            # (reference data_sampler.py:36, wired at engine.py:1561).
+            # Single-controller JAX: the sampler emits the GLOBAL micro
+            # batch (dp_rank 0 of 1); the jitted step shards it over the
+            # data axis. Sampler state rides in the checkpoint for exact
+            # mid-epoch resume.
+            from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+                CurriculumIndexLoader, DeepSpeedDataSampler)
+            sampler = DeepSpeedDataSampler(
+                de, one_epoch_total_samples=len(dataset),
+                micro_batch_size=self.train_micro_batch_size_per_gpu()
+                * self.dp_world_size,
+                gradient_accumulation_steps=self.gas,
+                drop_last=self._config.dataloader_drop_last)
+            if self._data_sampler_state is not None:
+                sampler.load_state_dict(self._data_sampler_state)
+                self._data_sampler_state = None
+            self._data_sampler = sampler
+            return CurriculumIndexLoader(dataset, sampler,
+                                         collate_fn=collate_fn)
         return DeepSpeedDataLoader(
             dataset,
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
@@ -1337,6 +1532,8 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "lr_scheduler": self.lr_scheduler.state_dict()
             if isinstance(self.lr_scheduler, LRScheduler) else None,
+            "data_sampler": self._data_sampler.state_dict()
+            if self._data_sampler is not None else None,
         })
         self.wait_checkpoint()
 
@@ -1418,6 +1615,13 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and client.get("lr_scheduler") and \
                 isinstance(self.lr_scheduler, LRScheduler):
             self.lr_scheduler.load_state_dict(client["lr_scheduler"])
+        if client.get("data_sampler") is not None:
+            # restore into the live sampler, or stash for the sampler a
+            # later deepspeed_io() builds
+            if self._data_sampler is not None:
+                self._data_sampler.load_state_dict(client["data_sampler"])
+            else:
+                self._data_sampler_state = client["data_sampler"]
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client
 

@@ -57,6 +57,23 @@ def test_int8_matmul_odd_m():
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+def test_int8_matmul_awkward_tilings():
+    """Shapes that stress the block chooser: k-splits must land on
+    128-multiples (or whole k), n with no 128-multiple divisor uses the
+    full axis, small-group large-k forces gpb reduction."""
+    rng = np.random.default_rng(5)
+    for m, k, n, g, bn in [(4, 4800, 512, 32, 512),   # k-split alignment
+                           (8, 768, 4800, 128, None),  # n: no 128-divisor
+                           (1, 512, 384, 64, 256),     # tiny decode m
+                           (5, 256, 128, 32, None)]:   # full-axis m block
+        x = rng.normal(size=(m, k)).astype(np.float32)
+        w = rng.normal(size=(k, n)).astype(np.float32)
+        q, s = quantize(jnp.asarray(w), group_size=g)
+        got = np.asarray(int8_matmul(jnp.asarray(x), q, s, block_n=bn))
+        ref = x @ np.asarray(dequantize(q, s, jnp.float32))
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-2)
+
+
 def test_quantize_tree_predicate_and_memory():
     rng = np.random.default_rng(4)
     params = {
@@ -93,6 +110,58 @@ def test_qtensor_jit_transparent():
     np.testing.assert_allclose(np.asarray(f(qt, x)),
                                np.asarray(x @ dequantize(q, s, jnp.float32)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_qdense_qtensor_parity():
+    """QDense with a QTensor kernel == nn.Dense with the dequantized
+    float kernel, on both quant_impl paths (the model-side contract that
+    lets _materialize skip whole-tree dequantization)."""
+    import flax.linen as nn
+    from deepspeed_tpu.ops.quant import QTensor, quantize
+    from deepspeed_tpu.ops.quant.qdense import QDense
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((128, 96)).astype(np.float32)
+    b = rng.standard_normal(96).astype(np.float32)
+    x = jnp.asarray(rng.standard_normal((2, 5, 128)), jnp.float32)
+    q, s = quantize(jnp.asarray(w), group_size=32)
+    ref = x @ dequantize(q, s, jnp.float32) + b
+
+    for impl in ("xla", "pallas"):
+        mod = QDense(96, dtype=jnp.float32, quant_impl=impl)
+        out = mod.apply(
+            {"params": {"kernel": QTensor(q, s, jnp.float32), "bias": b}}, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    # float kernel path is bit-identical to nn.Dense
+    dense = nn.Dense(96, dtype=jnp.float32)
+    got = QDense(96, dtype=jnp.float32).apply(
+        {"params": {"kernel": w, "bias": b}}, x)
+    want = dense.apply({"params": {"kernel": w, "bias": b}}, x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gpt2_qtensor_params_logits_parity():
+    """A GPT2 forward with QTensor kernel leaves matches the same forward
+    with dequantized float kernels (QDense routing, serving contract)."""
+    from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+    from deepspeed_tpu.ops.quant import dequantize_tree, quantize_tree
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32)
+    mod = GPT2(cfg)
+    assert mod.qtensor_params
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 8)), "i4")
+    params = mod.init(jax.random.PRNGKey(0), ids)["params"]
+    from deepspeed_tpu.parallel import sharding as shd
+    params = shd.unbox(params)
+    qparams = quantize_tree(params, group_size=32,
+                            predicate=lambda p, l: "kernel" in p)
+    ref = mod.apply({"params": dequantize_tree(qparams)}, ids)
+    got = mod.apply({"params": qparams}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_int8_inference_end_to_end():

@@ -1,0 +1,254 @@
+"""Data-efficiency v2: analyzer index files, curriculum-threshold
+sampling, and exact mid-epoch resume (reference
+data_sampling/data_analyzer.py:20, data_sampler.py:36)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+    CurriculumIndexLoader, DataAnalyzer, DeepSpeedDataSampler, MetricIndex,
+    find_fit_int_dtype)
+
+
+class SeqlenDataset:
+    """Samples are token lists of varying length; difficulty = length."""
+
+    def __init__(self, n=256, seed=0):
+        rng = np.random.default_rng(seed)
+        self.lengths = rng.integers(4, 64, n)
+
+    def __len__(self):
+        return len(self.lengths)
+
+    def __getitem__(self, i):
+        L = int(self.lengths[i])
+        ids = np.full(64, -1, np.int32)
+        ids[:L] = np.arange(L)
+        return {"input_ids": ids, "sample_id": np.int64(i)}
+
+
+def seqlen_metric(batch):
+    return np.asarray([int((s["input_ids"] >= 0).sum()) for s in batch])
+
+
+def _cfg(tmp_path, prefix, **over):
+    base = {
+        "enabled": True,
+        "seed": 42,
+        "data_sampling": {
+            "enabled": True,
+            "num_epochs": 100,
+            "curriculum_learning": {
+                "enabled": True,
+                "data_cluster_path": str(tmp_path / "clusters"),
+                "curriculum_metrics": {
+                    "seqlen": {
+                        "index_prefix": prefix,
+                        "difficulty_type": "value",
+                        "clustering_type": "cluster",
+                        "min_difficulty": 8,
+                        "max_difficulty": 64,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 10,
+                                            "difficulty_step": 8},
+                    }}}}}
+    base.update(over)
+    return base
+
+
+def _analyze(tmp_path, ds, num_workers=1):
+    an = DataAnalyzer(ds, num_workers=num_workers,
+                      metric_names=["seqlen"],
+                      metric_functions=[seqlen_metric],
+                      metric_types=["single_value_per_sample"],
+                      save_path=str(tmp_path / "idx"))
+    an.run_map_reduce()
+    return str(tmp_path / "idx" / "seqlen")
+
+
+def test_find_fit_int_dtype():
+    assert find_fit_int_dtype(0, 200) == np.uint8
+    assert find_fit_int_dtype(0, 70000) == np.uint32
+    assert find_fit_int_dtype(-5, 100) == np.int8
+
+
+def test_analyzer_index_files(tmp_path):
+    ds = SeqlenDataset(100)
+    prefix = _analyze(tmp_path, ds, num_workers=3)
+    idx = MetricIndex(prefix)
+    assert len(idx) == 100
+    np.testing.assert_array_equal(np.asarray(idx.sample_to_metric),
+                                  ds.lengths)
+    vals = np.asarray(idx.sorted_values)
+    assert (np.diff(vals) >= 0).all()
+    samples = np.asarray(idx.sorted_samples)
+    assert sorted(samples.tolist()) == list(range(100))
+    np.testing.assert_array_equal(ds.lengths[samples], vals)
+    # value-range query == oracle
+    got = set(idx.samples_in_value_range(10, 30).tolist())
+    want = {i for i, L in enumerate(ds.lengths) if 10 < L <= 30}
+    assert got == want
+
+
+def test_sampler_respects_difficulty_threshold(tmp_path):
+    ds = SeqlenDataset(256)
+    prefix = _analyze(tmp_path, ds)
+    cfg = _cfg(tmp_path, prefix)
+    sampler = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=8)
+    it = iter(sampler)
+    # curriculum steps once per global batch (gas=1 -> per micro batch);
+    # every sampled id's difficulty must be <= that step's difficulty
+    for step in range(1, 20):
+        idxs = next(it)
+        assert len(idxs) == 8
+        d = sampler.current_difficulties["seqlen"]
+        assert max(ds.lengths[i] for i in idxs) <= d, (step, d)
+    # late in the schedule the hard samples appear
+    seen = set()
+    for _ in range(200):
+        seen.update(next(it))
+    assert max(ds.lengths[list(seen)]) > 56
+
+
+def test_sampler_epoch_coverage_and_reshuffle(tmp_path):
+    """All admitted samples are consumed before any repeats (cluster
+    position + reshuffle-on-wrap, reference data_sampler.py:246)."""
+    ds = SeqlenDataset(64)
+    prefix = _analyze(tmp_path, ds)
+    cfg = _cfg(tmp_path, prefix)
+    # freeze the curriculum at max difficulty: one cluster of everything
+    m = cfg["data_sampling"]["curriculum_learning"]["curriculum_metrics"]
+    m["seqlen"]["min_difficulty"] = 64
+    m["seqlen"]["schedule_config"]["total_curriculum_step"] = 1
+    sampler = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=8)
+    it = iter(sampler)
+    seen = []
+    for _ in range(8):      # exactly one epoch worth
+        seen += next(it)
+    assert sorted(seen) == list(range(64))   # no repeats before wrap
+    more = []
+    for _ in range(8):
+        more += next(it)
+    assert sorted(more) == list(range(64))   # second pass reshuffled
+    assert more != seen
+
+
+def test_empty_curriculum_raises_loudly(tmp_path):
+    """A threshold that admits nothing fails with a config hint, not a
+    NaN-weights crash inside rng.choice."""
+    ds = SeqlenDataset(64)      # lengths are all >= 4
+    prefix = _analyze(tmp_path, ds)
+    cfg = _cfg(tmp_path, prefix)
+    m = cfg["data_sampling"]["curriculum_learning"]["curriculum_metrics"]
+    m["seqlen"]["min_difficulty"] = 1    # admits zero samples at step 1
+    m["seqlen"]["schedule_config"] = {"total_curriculum_step": 100000,
+                                      "difficulty_step": 1}
+    sampler = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=8)
+    with pytest.raises(ValueError, match="admitted zero samples"):
+        next(iter(sampler))
+
+
+def test_mid_epoch_resume_exact_stream(tmp_path):
+    ds = SeqlenDataset(128)
+    prefix = _analyze(tmp_path, ds)
+
+    cfg = _cfg(tmp_path, prefix)
+    ref = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=4)
+    ref_it = iter(ref)
+    full = [next(ref_it) for _ in range(40)]
+
+    cfg2 = _cfg(tmp_path, prefix)
+    cfg2["data_sampling"]["curriculum_learning"]["data_cluster_path"] = \
+        str(tmp_path / "clusters2")
+    s1 = DeepSpeedDataSampler(cfg2, len(ds), micro_batch_size=4)
+    it1 = iter(s1)
+    first = [next(it1) for _ in range(17)]
+    state = s1.state_dict()
+    import json
+    state = json.loads(json.dumps(state))   # checkpoint round-trip shape
+
+    s2 = DeepSpeedDataSampler(cfg2, len(ds), micro_batch_size=4)
+    s2.load_state_dict(state)
+    it2 = iter(s2)
+    rest = [next(it2) for _ in range(23)]
+    assert first + rest == full
+
+
+def test_curriculum_index_loader_collates(tmp_path):
+    ds = SeqlenDataset(64)
+    prefix = _analyze(tmp_path, ds)
+    sampler = DeepSpeedDataSampler(_cfg(tmp_path, prefix), len(ds),
+                                   micro_batch_size=8)
+    loader = CurriculumIndexLoader(ds, sampler)
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (8, 64)
+    assert batch["sample_id"].shape == (8,)
+    d = sampler.current_difficulties["seqlen"]
+    assert ((batch["input_ids"] >= 0).sum(1) <= d).all()
+
+
+def test_engine_e2e_config_driven_resume(tmp_path):
+    """Config-only e2e: train with data_efficiency enabled, checkpoint,
+    resume in a FRESH engine — the post-resume sample stream equals the
+    uninterrupted one (VERDICT r3 done-criterion)."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, simple_loss_fn
+
+    ds = SeqlenDataset(128)
+    prefix = _analyze(tmp_path, ds)
+
+    class RegressionView:
+        """Same sampler stream, regression-shaped samples."""
+
+        def __len__(self):
+            return len(ds)
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(1000 + i)
+            return {"x": rng.normal(size=(16,)).astype(np.float32),
+                    "y": rng.normal(size=(8,)).astype(np.float32),
+                    "sample_id": np.int64(i)}
+
+    def make_cfg(cluster_dir):
+        de = _cfg(tmp_path, prefix)
+        de["data_sampling"]["curriculum_learning"]["data_cluster_path"] = \
+            str(tmp_path / cluster_dir)
+        return {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "data_efficiency": de,
+        }
+
+    def steps(engine, loader_iter, n):
+        ids = []
+        for _ in range(n):
+            batch = next(loader_iter)
+            ids.append(batch.pop("sample_id").tolist())
+            engine.forward(batch)
+            engine.backward()
+            engine.step()
+        return ids
+
+    model = SimpleModel(hidden_dim=16)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=make_cfg("cl_a"),
+        loss_fn=simple_loss_fn(model))
+    loader = e1.deepspeed_io(RegressionView())
+    assert e1._data_sampler is not None
+    it = iter(loader)
+    ids_a = steps(e1, it, 5)
+    e1.save_checkpoint(str(tmp_path / "ckpt"))
+    ids_b = steps(e1, it, 5)
+
+    model2 = SimpleModel(hidden_dim=16)
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, config=make_cfg("cl_a"),
+        loss_fn=simple_loss_fn(model2))
+    e2.load_checkpoint(str(tmp_path / "ckpt"),
+                       example_batch={"x": np.zeros((8, 16), np.float32),
+                                      "y": np.zeros((8, 8), np.float32)})
+    loader2 = e2.deepspeed_io(RegressionView())
+    it2 = iter(loader2)
+    ids_b2 = steps(e2, it2, 5)
+    assert ids_b2 == ids_b

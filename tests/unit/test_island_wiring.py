@@ -1,0 +1,231 @@
+"""Config-only engine integration for the three formerly-island
+subsystems: compression-aware training, progressive layer drop, and
+eigenvalue-scheduled MoQ (VERDICT r3 item 3; reference
+compression/compress.py:95, runtime/engine.py:1139 + :2014)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel, simple_loss_fn
+
+
+def _base_cfg(**extra):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def _train(cfg, steps, seed=0, loss_hook=None, model=None, loss_fn=None):
+    model = model or SimpleModel(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        loss_fn=loss_fn if loss_fn is not None else simple_loss_fn(model))
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward({"x": x, "y": y},
+                              rng=jax.random.PRNGKey(0))
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+        if loss_hook:
+            loss_hook(engine)
+    return engine, losses
+
+
+COMP = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 3},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 4, "target_bits": 4,
+                               "quantization_period": 1},
+                    "modules": ["Dense_0"]}}},
+}
+
+
+def test_compression_changes_training_from_offset():
+    """Identical runs with/without compression_training: losses match
+    bit-for-bit before schedule_offset, diverge after (the STE quant
+    path engages exactly at the offset)."""
+    _, base = _train(_base_cfg(), 7)
+    engine, comp = _train(_base_cfg(compression_training=COMP), 7)
+    assert engine._compression is not None and len(engine._compression) == 1
+    # steps 0,1,2 use step<offset strengths (inactive)
+    np.testing.assert_array_equal(base[:3], comp[:3])
+    assert any(abs(a - b) > 1e-7 for a, b in zip(base[3:], comp[3:])), \
+        (base, comp)
+
+
+def test_compression_group_must_match():
+    cfg = _base_cfg(compression_training={
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {
+                "wq1": {"params": {}, "modules": ["no_such_module"]}}}})
+    with pytest.raises(ValueError, match="no kernel matches"):
+        _train(cfg, 1)
+
+
+def test_sparse_pruning_masks_forward():
+    cfg = _base_cfg(compression_training={
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.3},
+                        "modules": ["Dense_0"]}}}})
+    _, base = _train(_base_cfg(), 4)
+    _, pruned = _train(cfg, 4)
+    assert all(abs(a - b) > 1e-9 for a, b in zip(base, pruned))
+
+
+def test_redundancy_clean_bakes_quantization():
+    from deepspeed_tpu.compression import redundancy_clean
+    engine, _ = _train(_base_cfg(compression_training=COMP), 5)
+    params = jax.device_get(engine.get_params())
+    cleaned = redundancy_clean(params, {"compression_training": COMP})
+    w = np.asarray(jax.device_get(
+        cleaned["Dense_0"]["kernel"]), np.float32)
+    raw = np.asarray(params["Dense_0"]["kernel"], np.float32)
+    assert not np.array_equal(w, raw)
+    assert len(np.unique(w)) <= 2 ** 4 + 1      # a 4-bit grid
+    # untouched leaves pass through
+    np.testing.assert_array_equal(
+        np.asarray(cleaned["Dense_1"]["kernel"]),
+        np.asarray(params["Dense_1"]["kernel"]))
+
+
+def test_student_initialization_layer_mapping():
+    from deepspeed_tpu.compression import student_initialization
+    t = {"wte": np.arange(4.0),
+         "h_0": {"k": np.full(2, 0.0)}, "h_1": {"k": np.full(2, 1.0)},
+         "h_2": {"k": np.full(2, 2.0)}, "h_3": {"k": np.full(2, 3.0)}}
+    s = {"wte": np.zeros(4), "h_0": {"k": np.zeros(2)},
+         "h_1": {"k": np.zeros(2)}}
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2,
+        "module_name_prefix": "h_", "teacher_layer": [1, 3]}}}
+    out = student_initialization(s, t, cfg)
+    np.testing.assert_array_equal(out["h_0"]["k"], [1.0, 1.0])
+    np.testing.assert_array_equal(out["h_1"]["k"], [3.0, 3.0])
+    np.testing.assert_array_equal(out["wte"], t["wte"])
+
+
+def _lm_batch(vocab=64, b=8, l=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (b, l)).astype("i4")}
+
+
+def _gpt2_cfg(**kw):
+    from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+    return GPT2(GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                          num_heads=4, max_seq_len=32, **kw))
+
+
+def test_pld_config_drives_model():
+    """pld in the json config reaches the GPT2 forward: dropped blocks
+    change the loss vs an identical run without pld, theta anneals, and
+    theta=1.0 (gamma huge step... baseline) reproduces no-pld losses."""
+    cfg_off = _base_cfg()
+    cfg_on = _base_cfg(progressive_layer_drop={
+        "enabled": True, "theta": 0.2, "gamma": 0.01})
+
+    def run(cfg, seed=0):
+        model = _gpt2_cfg()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        batch = _lm_batch(seed=seed)
+        losses = []
+        for _ in range(4):
+            loss = engine.forward(batch, rng=jax.random.PRNGKey(7))
+            engine.backward()
+            engine.step()
+            losses.append(float(loss))
+        return engine, losses
+
+    e_off, base = run(cfg_off)
+    e_on, pld = run(cfg_on)
+    assert e_on.progressive_layer_drop is not None
+    assert any(abs(a - b) > 1e-7 for a, b in zip(base, pld))
+    # theta annealed from 1.0 toward theta_bar
+    assert e_on.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_pld_custom_loss_without_kwarg_fails_loudly():
+    model = SimpleModel(hidden_dim=16)
+    with pytest.raises(ValueError, match="pld_theta"):
+        deepspeed_tpu.initialize(
+            model=model,
+            config=_base_cfg(progressive_layer_drop={"enabled": True,
+                                                     "theta": 0.5}),
+            loss_fn=simple_loss_fn(model))
+
+
+def test_compression_engages_in_fused_gas_window():
+    """gas>1 takes the fused step_gasN path (train_batch with a full
+    window) — compression must still engage there, not only in the
+    per-micro forward() path."""
+    def run(extra):
+        model = SimpleModel(hidden_dim=16)
+        cfg = {"train_batch_size": 16,
+               "train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               **extra}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, loss_fn=simple_loss_fn(model))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+        losses = [engine.train_batch(batches=[{"x": x, "y": y}] * 2)
+                  for _ in range(5)]
+        assert engine.global_steps == 5
+        return losses
+
+    comp = {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 4, "target_bits": 4,
+                               "quantization_period": 1},
+                    "modules": ["Dense_0"]}}}}
+    base = run({})
+    quant = run({"compression_training": comp})
+    np.testing.assert_array_equal(base[:2], quant[:2])
+    assert any(abs(a - b) > 1e-7 for a, b in zip(base[2:], quant[2:]))
+
+
+def test_eigenvalue_moq_scales_period():
+    """eigenvalue.enabled + compression: after the first boundary the
+    runtime holds per-group period factors in 1..5 (reference
+    quantize.py:70), and the factor delays bit halving."""
+    comp = {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "a": {"params": {"start_bits": 16, "target_bits": 4,
+                                 "quantization_period": 2},
+                      "modules": ["Dense_0"]},
+                "b": {"params": {"start_bits": 16, "target_bits": 4,
+                                 "quantization_period": 2},
+                      "modules": ["Dense_1"]}}}}
+    cfg = _base_cfg(compression_training=comp,
+                    eigenvalue={"enabled": True, "max_iter": 8,
+                                "gas_boundary_resolution": 1})
+    engine, losses = _train(cfg, 3)
+    assert engine.eigenvalue is not None
+    factors = engine._compression._eig_factor
+    assert set(factors) == {0, 1}
+    assert all(1 <= f <= 5 for f in factors.values())
+    # a stretched period yields more bits (slower halving) at a given step
+    rt = engine._compression
+    rt.set_eigenvalue_factors({0: 0.0, 1: 1.0})  # factors 1 and 5
+    v = rt.strength_vector(8)
+    assert v[0] <= v[1] or v[1] == 16

@@ -91,6 +91,12 @@ class CompressionRuntime:
                 "not the engine param transform (see compress.py docs)")
         self.num_heads = num_heads
         self._eig_factor = {}          # group index -> period multiplier
+        # monotone bit ratchet: an eigenvalue factor stretching the
+        # period must never RAISE a group's bits after a halving already
+        # happened (the reference quantizer's bit state only decreases,
+        # runtime/quantize.py q_start_bits mutation). Derived state: on
+        # restart it re-ratchets from the current step's schedule.
+        self._bits_floor = {}
 
     def __len__(self):
         return len(self.groups)
@@ -124,6 +130,8 @@ class CompressionRuntime:
                     if bits <= target:
                         break
                     bits = max(bits // 2, target)
+                bits = min(bits, self._bits_floor.get(gi, bits))
+                self._bits_floor[gi] = bits
                 out[gi] = bits
             else:
                 out[gi] = 1.0 - float(gp.get("dense_ratio", 1.0))

@@ -263,9 +263,13 @@ class Block(nn.Module):
             # progressive layer drop (reference
             # runtime/progressive_layer_drop.py + the PLD paper's
             # stochastic depth): with prob 1 - pld_keep the whole block
-            # is skipped this step — the residual stream passes through
+            # is skipped this step — the residual stream passes through.
+            # Kept branches scale by 1/keep (inverted-dropout
+            # convention) so the eval-time full-depth forward matches
+            # the training-time expectation without a rescale pass.
             keep = jax.random.bernoulli(self.make_rng("pld"), pld_keep)
-            out = jnp.where(keep, out, x_in)
+            scaled = x_in + (out - x_in) / pld_keep.astype(out.dtype)
+            out = jnp.where(keep, scaled, x_in)
         return out, new_cache
 
 

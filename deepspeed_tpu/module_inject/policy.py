@@ -517,15 +517,128 @@ class MegatronGPT2Policy(InjectionPolicy):
                     "qkv": {"kernel": qkv_w, "bias": qkv_b},
                     "proj": {"kernel": _t(sd[h + "attention.dense.weight"]),
                              "bias": _np(sd[h + "attention.dense.bias"])}},
-                "mlp": {
-                    "fc_in": {
-                        "kernel": _t(sd[h + "mlp.dense_h_to_4h.weight"]),
-                        "bias": _np(sd[h + "mlp.dense_h_to_4h.bias"])},
-                    "fc_out": {
-                        "kernel": _t(sd[h + "mlp.dense_4h_to_h.weight"]),
-                        "bias": _np(sd[h + "mlp.dense_4h_to_h.bias"])}},
+                **cls._layer_mlp(hf_config, sd, h, i),
             }
         return p
+
+    @classmethod
+    def _layer_mlp(cls, hf_config, sd, h, i):
+        """The layer's FFN subtree — the MoE subclass swaps this per
+        layer (reference megatron_gpt_moe.py replaces the container's
+        mlp with deepspeed_moe experts)."""
+        return {"mlp": {
+            "fc_in": {
+                "kernel": _t(sd[h + "mlp.dense_h_to_4h.weight"]),
+                "bias": _np(sd[h + "mlp.dense_h_to_4h.bias"])},
+            "fc_out": {
+                "kernel": _t(sd[h + "mlp.dense_4h_to_h.weight"]),
+                "bias": _np(sd[h + "mlp.dense_4h_to_h.bias"])}}}
+
+
+class MegatronGPTMoEPolicy(MegatronGPT2Policy):
+    """Megatron-DeepSpeed MoE checkpoints (reference
+    ``module_inject/containers/megatron_gpt_moe.py:1`` DS_MegatronGPTMoE
+    + ``policy.mlp(moe_type)`` extracting per-expert
+    ``mlp.deepspeed_moe.experts.deepspeed_experts.<i>.*`` weights and the
+    ``gate.wg`` projection).
+
+    TPU form: the per-expert torch Linears stack into ExpertsMLP's
+    ``[e, ...]`` leaves (moe/layer.py:52 — "expert" is a sharding axis,
+    so expert-parallel serving needs no process groups; the decode
+    all-to-alls are XLA collectives at the sharding constraints). MoE
+    layer placement is DETECTED from the state dict (which layers carry
+    ``deepspeed_moe`` keys) and must match the model's every-Nth-block
+    pattern (GPTConfig.moe_every). PR-MoE residual branches
+    (``mlp.mlp.*`` + ``coefficient``) map onto the QDense residual pair.
+    Attention/layernorm/embedding conversion inherits MegatronGPT2Policy
+    (same fused-qkv layout rules)."""
+
+    model_type = "megatron-moe"
+
+    @classmethod
+    def matches(cls, hf_config):
+        return getattr(hf_config, "model_type", None) in (
+            "megatron-moe", "megatron_gpt_moe", "megatron-deepspeed-moe")
+
+    @staticmethod
+    def _moe_layers(sd):
+        import re
+        layers = set()
+        for k in sd:
+            m = re.search(r"layers\.(\d+)\..*deepspeed_moe", k)
+            if m:
+                layers.add(int(m.group(1)))
+        return sorted(layers)
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPTConfig
+        c = hf_config
+        inter = getattr(c, "ffn_hidden_size", None) or 4 * c.hidden_size
+        assert inter % c.hidden_size == 0
+        cfg = GPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_layers=c.num_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            mlp_ratio=inter // c.hidden_size,
+            layer_norm_eps=getattr(c, "layernorm_epsilon", 1e-5),
+            activation="gelu",
+            moe_num_experts=c.num_experts,
+            moe_top_k=getattr(c, "moe_top_k", 1),
+            moe_every=getattr(c, "moe_every", 2),
+            moe_use_residual=getattr(c, "moe_use_residual", False),
+            tie_embeddings=True, dtype=dtype, param_dtype=dtype)
+        return GPT2(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        moe_layers = cls._moe_layers(sd)
+        every = getattr(hf_config, "moe_every", 2)
+        want = [i for i in range(hf_config.num_layers)
+                if i % every == every - 1]
+        if moe_layers != want:
+            raise ValueError(
+                f"MoE layers in checkpoint {moe_layers} do not match the "
+                f"every-{every}th-block pattern {want}; set moe_every on "
+                "the config to the checkpoint's expert interval")
+        return super().convert(hf_config, sd)
+
+    @classmethod
+    def _layer_mlp(cls, hf_config, sd, h, i):
+        every = getattr(hf_config, "moe_every", 2)
+        if i % every != every - 1:     # dense block
+            return super()._layer_mlp(hf_config, sd, h, i)
+        e = hf_config.num_experts
+        moe = h + "mlp.deepspeed_moe."
+        ex = moe + "experts.deepspeed_experts."
+        out = {
+            "gate": _t(sd[moe + "gate.wg.weight"]).astype(np.float32),
+            "experts": {
+                "wi": np.stack([_t(sd[f"{ex}{j}.dense_h_to_4h.weight"])
+                                for j in range(e)]),
+                "bi": np.stack([_np(sd[f"{ex}{j}.dense_h_to_4h.bias"])
+                                for j in range(e)]),
+                "wo": np.stack([_t(sd[f"{ex}{j}.dense_4h_to_h.weight"])
+                                for j in range(e)]),
+                "bo": np.stack([_np(sd[f"{ex}{j}.dense_4h_to_h.bias"])
+                                for j in range(e)])},
+        }
+        if getattr(hf_config, "moe_use_residual", False):
+            # PR-MoE residual branch (reference megatron_gpt_moe.py:27
+            # moe_type != standard: mlp.mlp.* + coefficient)
+            out["res_fc_in"] = {
+                "kernel": _t(sd[h + "mlp.mlp.dense_h_to_4h.weight"]),
+                "bias": _np(sd[h + "mlp.mlp.dense_h_to_4h.bias"])}
+            out["res_fc_out"] = {
+                "kernel": _t(sd[h + "mlp.mlp.dense_4h_to_h.weight"]),
+                "bias": _np(sd[h + "mlp.mlp.dense_4h_to_h.bias"])}
+            out["coefficient"] = {
+                "kernel": _t(sd[h + "mlp.coefficient.weight"]).astype(
+                    np.float32),
+                "bias": _np(sd[h + "mlp.coefficient.bias"]).astype(
+                    np.float32)}
+        return {"moe": out}
 
 
 class LlamaPolicy(InjectionPolicy):

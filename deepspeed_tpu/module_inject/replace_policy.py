@@ -8,10 +8,12 @@ from deepspeed_tpu.module_inject.policy import (AutoTPPolicy, BertPolicy,
                                                 GPTNeoXPolicy,
                                                 LlamaPolicy,
                                                 MegatronGPT2Policy,
+                                                MegatronGPTMoEPolicy,
                                                 OPTPolicy)
 
 POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, GPTJPolicy, GPTNeoPolicy,
-            GPTNeoXPolicy, LlamaPolicy, MegatronGPT2Policy, BertPolicy,
+            GPTNeoXPolicy, LlamaPolicy, MegatronGPTMoEPolicy,
+            MegatronGPT2Policy, BertPolicy,
             DistilBertPolicy]
 
 

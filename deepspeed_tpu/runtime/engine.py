@@ -1238,10 +1238,15 @@ class DeepSpeedEngine:
                 if n_micro is None else jnp.full((n_micro,), theta,
                                                  jnp.float32)
         if self._compression is not None:
-            vec = jnp.asarray(
-                self._compression.strength_vector(self.global_steps))
-            dev_batch["_ds_comp"] = vec if n_micro is None else \
-                jnp.tile(vec, (n_micro, 1))
+            vec = self._compression.strength_vector(self.global_steps)
+            # while every group is still inactive (pre-offset) skip the
+            # key entirely: comp.apply would sort/quantize every matched
+            # kernel only to return it unchanged. The structure change
+            # costs one recompile when the schedule activates.
+            if np.any(vec):
+                vec = jnp.asarray(vec)
+                dev_batch["_ds_comp"] = vec if n_micro is None else \
+                    jnp.tile(vec, (n_micro, 1))
         return dev_batch
 
     def _maybe_update_moq(self):
@@ -1266,24 +1271,36 @@ class DeepSpeedEngine:
             return
         batch = self._put_batch(self._last_batch)
         params = self._live_state().params
-        flat = flax.traverse_util.flatten_dict(params, sep="/")
-        keys, vals = list(flat.keys()), list(flat.values())
+        if self._offload is not None and \
+                getattr(self, "_param_mat_sh", None) is not None:
+            # ZeRO-3 param offload: power-iterate on a device copy (the
+            # pinned-host at-rest tree can't feed the jitted HVP on
+            # backends without in-program memory-space moves)
+            params = jax.device_put(params, self._param_mat_sh)
 
         # STABLE loss identity across boundaries/groups: the batch rides
         # extra_args so the eigenvalue's jitted power step caches
         if not hasattr(self, "_eig_loss"):
             self._eig_loss = lambda p, b: self.loss_fn(p, b, None)
+        if not hasattr(self, "_moq_masks"):
+            # group membership never changes after init: build the 0/1
+            # mask trees once, in the param dtype (a f32 mask would
+            # promote the bf16 tangents and break jvp)
+            flat = flax.traverse_util.flatten_dict(params, sep="/")
+            keys, vals = list(flat.keys()), list(flat.values())
+            self._moq_masks = {}
+            for gi in wq:
+                posset = set(self._compression.groups[gi][4])
+                self._moq_masks[gi] = flax.traverse_util.unflatten_dict(
+                    {k: ((jnp.ones if i in posset else jnp.zeros)(
+                        jnp.shape(v), jnp.asarray(v).dtype))
+                     for i, (k, v) in enumerate(zip(keys, vals))}, sep="/")
 
         evs = []
         rng = jax.random.PRNGKey(self.global_steps)
         for gi in wq:
-            posset = set(self._compression.groups[gi][4])
-            mask = flax.traverse_util.unflatten_dict(
-                {k: (jnp.ones(jnp.shape(v), jnp.float32) if i in posset
-                     else jnp.zeros(jnp.shape(v), jnp.float32))
-                 for i, (k, v) in enumerate(zip(keys, vals))}, sep="/")
             ev, _ = self.eigenvalue.compute_eigenvalue(
-                self._eig_loss, params, rng=rng, mask=mask,
+                self._eig_loss, params, rng=rng, mask=self._moq_masks[gi],
                 extra_args=(batch,))
             evs.append(ev)
         normed = Eigenvalue.normalize_eigenvalues(evs)
@@ -1332,6 +1349,7 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
+        self._maybe_update_moq()
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._maybe_log_flops()
         if self.monitor.enabled and self.global_steps % \
@@ -1454,11 +1472,16 @@ class DeepSpeedEngine:
             else mean_loss_dev
 
     def eval_batch(self, batch, _retried=False):
-        """Loss-only forward (no grads)."""
+        """Loss-only forward (no grads). Compression-aware training
+        evaluates the COMPRESSED model (same strengths the train step
+        uses) — validation tracks the network redundancy_clean will
+        bake, not the raw fp weights. PLD evaluates at full depth
+        (theta=1 semantics), matching the reference."""
         self._ensure_initialized(batch)
         if not hasattr(self, "_eval_fn"):
             loss_fn = self.loss_fn
             compute_dtype = self.compute_dtype
+            comp = self._compression
             mat_sh = self._param_mat_sh \
                 if getattr(self, "_injit_materialize", False) else None
 
@@ -1469,13 +1492,23 @@ class DeepSpeedEngine:
                     lambda x: x.astype(compute_dtype)
                     if x.dtype == jnp.float32 and compute_dtype != jnp.float32
                     else x, params)
+                if isinstance(batch, dict) and "_ds_comp" in batch:
+                    batch = dict(batch)
+                    p = comp.apply(p, batch.pop("_ds_comp"))
                 return loss_fn(p, batch, None)
 
             self._eval_fn = jax.jit(ev)
+        dev_batch = self._put_batch(batch)
+        if self._compression is not None:
+            vec = self._compression.strength_vector(self.global_steps)
+            if np.any(vec):
+                assert isinstance(dev_batch, dict)
+                dev_batch = dict(dev_batch)
+                dev_batch["_ds_comp"] = jnp.asarray(vec)
         try:
             return jax.block_until_ready(self._eval_fn(
                 self._materialize_params(self._live_state().params),
-                self._put_batch(batch)))
+                dev_batch))
         except jax.errors.JaxRuntimeError as e:
             if _retried or not self._fallback_to_eager_streaming(e):
                 raise

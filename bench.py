@@ -123,6 +123,10 @@ def main():
              "final_loss": loss}
     if gas4_tps is not None:
         extra["gas4_tokens_per_sec"] = round(gas4_tps, 1)
+        # remaining gas4 gap is the fp32 grad accumulator's HBM traffic
+        # (3 read+add+write passes over a params-sized tree per window)
+        # plus micro-batch-2 matmul shapes; both shrink as micro batch
+        # grows on real workloads
         extra["gas4_over_gas1"] = round(gas4_tps / tokens_per_sec, 4)
         extra["gas4_final_loss"] = gas4_loss
     print(json.dumps({

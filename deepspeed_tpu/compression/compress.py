@@ -101,6 +101,20 @@ class CompressionRuntime:
     def __len__(self):
         return len(self.groups)
 
+    def state_dict(self):
+        """Schedule state that must survive a restart: without it a
+        resume would recompute halvings with unstretched periods and the
+        bit ratchet would lock in over-aggressive quantization."""
+        return {"eig_factor": dict(self._eig_factor),
+                "bits_floor": dict(self._bits_floor)}
+
+    def load_state_dict(self, sd):
+        # JSON round-trips stringify int keys
+        self._eig_factor = {int(k): int(v)
+                            for k, v in (sd.get("eig_factor") or {}).items()}
+        self._bits_floor = {int(k): int(v)
+                            for k, v in (sd.get("bits_floor") or {}).items()}
+
     # ------------------------------------------------------------- schedule
     def set_eigenvalue_factors(self, eigenvalues):
         """eigenvalues: {group_index: normalized |ev| in [0, 1]} ->

@@ -402,17 +402,22 @@ class GPT2(nn.Module):
 
 def gpt2_loss_fn(logits, batch):
     """Mean next-token cross-entropy; expects batch['labels'] (already
-    shifted) or computes shift from input_ids."""
+    shifted) or computes shift from input_ids.
+
+    HBM note: the label gather reads the RAW (bf16) logits and only the
+    gathered [b, l] column upcasts — converting the whole tensor first
+    would force XLA to materialize a full fp32 copy as the gather
+    operand (1.6 GB at gpt2-small bench shapes). The logsumexp's upcast
+    fuses into its reduction, so no fp32 tensor ever lands in HBM."""
     labels = batch.get("labels")
     if labels is None:
         labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)),
                          constant_values=-100)
-    logits = logits.astype(jnp.float32)
-    vocab = logits.shape[-1]
     valid = labels >= 0
     safe_labels = jnp.where(valid, labels, 0)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1)[..., 0].astype(jnp.float32)
     nll = (logz - ll) * valid
     return nll.sum() / jnp.maximum(valid.sum(), 1)
 

@@ -355,11 +355,15 @@ def flash_attention_with_lse(q3, k3, v3, *, causal, scale, block,
     return op(q3, k3, v3)
 
 
-def _pick_block(seq_len, target=512):
-    """Largest block <= target that divides seq_len (grid-step overhead on
-    the Mosaic pipeline dominates below ~256x256 blocks: a (bh,8,8) grid of
-    128-blocks at seq 1024 measured ~4x slower than (bh,2,2) of 512s)."""
-    for b in (target, 384, 256, 128):
+def _pick_block(seq_len, target=1024):
+    """Largest block <= target that divides seq_len. Grid-step overhead
+    on the Mosaic pipeline dominates small blocks: at seq 1024 on v5e,
+    128-blocks measured ~4x slower than 512s and 512s ~1.7x slower than
+    one whole-seq 1024 block (fwd 811us -> 471us, fwd+bwd 1423us ->
+    994us), so the target is 1024; longer sequences tile at 1024 where
+    the fp32 score block (1024x1024 = 4 MB) still fits VMEM comfortably
+    alongside the double-buffered operands."""
+    for b in (target, 512, 384, 256, 128):
         if b <= seq_len and seq_len % b == 0:
             return b
     return seq_len

@@ -207,11 +207,6 @@ class DeepSpeedEngine:
                 logger.warning(
                     "comm_backend_name: compressed grad sync supports pure "
                     f"data parallelism; mesh has {_other} — using XLA psum")
-            elif self._config.gradient_accumulation_steps > 1:
-                logger.warning(
-                    "comm_backend_name: compressed grad sync currently "
-                    "applies at gradient_accumulation_steps=1 — using "
-                    "XLA psum")
             elif self._offload_cfg is not None:
                 logger.warning(
                     "comm_backend_name: compressed grad sync does not "
@@ -860,8 +855,14 @@ class DeepSpeedEngine:
             # cost a host round trip per step on relayed devices
             return jnp.mean(jnp.stack(losses)), new_state, metrics
 
+        # params donated too: _train_batch_fused commits the new state
+        # before control returns, so no caller can observe the donated
+        # buffer, and the old tree hosts the new one instead of a fresh
+        # params-sized allocation per window. The forward()/step() split
+        # paths do NOT donate params — users legitimately read
+        # state.params between backward() and step().
         self._step_gasN = jax.jit(
-            step_gasN, donate_argnums=(1,),
+            step_gasN, donate_argnums=(0, 1),
             out_shardings=(None, self._state_sh, None))
 
         if self._compressed_axis:
@@ -879,6 +880,21 @@ class DeepSpeedEngine:
             ca = self._compressed_axis
             mesh = self.mesh
 
+            def compress_sync(grads, we, se):
+                """Error-feedback sign-allreduce over a grad tree; the
+                we/se buffers carry a leading per-worker axis inside the
+                shard_map ([0] strips it, [None] restores it)."""
+                outs = [compressed_allreduce(g, w[0], s_[0], ca)
+                        for g, w, s_ in zip(jax.tree.leaves(grads),
+                                            jax.tree.leaves(we),
+                                            jax.tree.leaves(se))]
+                tdef = jax.tree.structure(grads)
+                return (jax.tree.unflatten(tdef, [o[0] for o in outs]),
+                        jax.tree.unflatten(tdef,
+                                           [o[1][None] for o in outs]),
+                        jax.tree.unflatten(tdef,
+                                           [o[2][None] for o in outs]))
+
             def local_fwd_bwd(params, scale, batch, rng, we, se):
                 def scaled_loss(p):
                     loss = loss_fn(cast(p), batch, rng)
@@ -886,14 +902,7 @@ class DeepSpeedEngine:
 
                 (_, loss), grads = jax.value_and_grad(
                     scaled_loss, has_aux=True)(params)
-                g_flat = jax.tree.leaves(grads)
-                outs = [compressed_allreduce(g, w[0], s_[0], ca)
-                        for g, w, s_ in zip(g_flat, jax.tree.leaves(we),
-                                            jax.tree.leaves(se))]
-                tdef = jax.tree.structure(grads)
-                g_sync = jax.tree.unflatten(tdef, [o[0] for o in outs])
-                new_we = jax.tree.unflatten(tdef, [o[1][None] for o in outs])
-                new_se = jax.tree.unflatten(tdef, [o[2][None] for o in outs])
+                g_sync, new_we, new_se = compress_sync(grads, we, se)
                 return lax.pmean(loss, ca), g_sync, new_we, new_se
 
             sm = shard_map(
@@ -914,6 +923,56 @@ class DeepSpeedEngine:
             self._step_onebit = jax.jit(
                 step_onebit, donate_argnums=(1, 6, 7),
                 out_shardings=(None, self._state_sh, None, None, None))
+
+            if n_micro > 1:
+                # 1-bit x gradient accumulation (reference
+                # fp16/onebit/adam.py:13 semantics: error feedback per
+                # OPTIMIZER step): micro grads accumulate LOCALLY inside
+                # the shard_map — no per-micro sync of any kind — and
+                # ONE compressed allreduce fires at the boundary over
+                # the accumulated grads
+                def local_fwd_bwd_gasN(params, scale, batches, rng,
+                                       we, se):
+                    rngs = jax.random.split(rng, n_micro)
+                    acc, losses = None, []
+                    for i in range(n_micro):
+                        b = jax.tree.map(lambda x: x[i], batches)
+
+                        def scaled_loss(p, b=b, r=rngs[i]):
+                            loss = loss_fn(cast(p), b, r)
+                            return (loss.astype(jnp.float32) * scale / gas,
+                                    loss)
+
+                        (_, loss), grads = jax.value_and_grad(
+                            scaled_loss, has_aux=True)(params)
+                        acc = grads if acc is None else \
+                            jax.tree.map(jnp.add, acc, grads)
+                        losses.append(loss)
+                    g_sync, new_we, new_se = compress_sync(acc, we, se)
+                    return (lax.pmean(jnp.mean(jnp.stack(losses)), ca),
+                            g_sync, new_we, new_se)
+
+                sm_n = shard_map(
+                    local_fwd_bwd_gasN, mesh=mesh,
+                    in_specs=(P(), P(), P(None, "data"), P(), P(ca),
+                              P(ca)),
+                    out_specs=(P(), P(), P(ca), P(ca)),
+                    check_vma=False)
+
+                def step_onebit_gasN(params, opt_state, rest, batches,
+                                     rng, lr, we, se):
+                    state = rest.replace(params=params,
+                                         opt_state=opt_state)
+                    loss, grads, we, se = sm_n(
+                        params, state.scaler.loss_scale, batches, rng,
+                        we, se)
+                    new_state, metrics = apply_grads(state, grads, lr)
+                    return loss, new_state, metrics, we, se
+
+                self._step_onebit_gasN = jax.jit(
+                    step_onebit_gasN, donate_argnums=(1, 6, 7),
+                    out_shardings=(None, self._state_sh, None, None,
+                                   None))
 
     # -------------------------------------------------------------- profiling
     def flops_profile(self, batch=None):
@@ -1098,6 +1157,13 @@ class DeepSpeedEngine:
             self._pending = ("offload", loss, grads)
             self.timers(FORWARD_GLOBAL_TIMER).stop()
             return loss
+        if self._compressed_axis and self.gas > 1:
+            raise RuntimeError(
+                "1-bit compressed sync with gradient accumulation runs "
+                "through train_batch(batches=[...]) — the fused window "
+                "accumulates micro grads locally and compresses ONCE at "
+                "the boundary; the per-micro forward() path would psum "
+                "every micro batch, defeating the compression")
         boundary = (self.micro_steps + 1) % self.gas == 0
         rest = self.state.replace(params=None, opt_state=None)
         if self.gas == 1 and self._compressed_axis:
@@ -1282,25 +1348,23 @@ class DeepSpeedEngine:
         # extra_args so the eigenvalue's jitted power step caches
         if not hasattr(self, "_eig_loss"):
             self._eig_loss = lambda p, b: self.loss_fn(p, b, None)
-        if not hasattr(self, "_moq_masks"):
-            # group membership never changes after init: build the 0/1
-            # mask trees once, in the param dtype (a f32 mask would
-            # promote the bf16 tangents and break jvp)
-            flat = flax.traverse_util.flatten_dict(params, sep="/")
-            keys, vals = list(flat.keys()), list(flat.values())
-            self._moq_masks = {}
-            for gi in wq:
-                posset = set(self._compression.groups[gi][4])
-                self._moq_masks[gi] = flax.traverse_util.unflatten_dict(
-                    {k: ((jnp.ones if i in posset else jnp.zeros)(
-                        jnp.shape(v), jnp.asarray(v).dtype))
-                     for i, (k, v) in enumerate(zip(keys, vals))}, sep="/")
 
+        flat = flax.traverse_util.flatten_dict(params, sep="/")
+        keys, vals = list(flat.keys()), list(flat.values())
         evs = []
         rng = jax.random.PRNGKey(self.global_steps)
         for gi in wq:
+            # masks are TRANSIENT device fills (freed after the group's
+            # power iteration — caching them would pin groups x
+            # model-size of HBM), in the param dtype so the bf16
+            # tangents aren't promoted inside jvp
+            posset = set(self._compression.groups[gi][4])
+            mask = flax.traverse_util.unflatten_dict(
+                {k: ((jnp.ones if i in posset else jnp.zeros)(
+                    jnp.shape(v), jnp.asarray(v).dtype))
+                 for i, (k, v) in enumerate(zip(keys, vals))}, sep="/")
             ev, _ = self.eigenvalue.compute_eigenvalue(
-                self._eig_loss, params, rng=rng, mask=self._moq_masks[gi],
+                self._eig_loss, params, rng=rng, mask=mask,
                 extra_args=(batch,))
             evs.append(ev)
         normed = Eigenvalue.normalize_eigenvalues(evs)
@@ -1448,10 +1512,18 @@ class DeepSpeedEngine:
         dev = self._inject_reserved_keys(self._stack_batches(batches),
                                          n_micro=self.gas)
         rng, self._rng = jax.random.split(self._rng)
-        mean_loss_dev, new_state, metrics = self._step_gasN(
-            self.state.params, self.state.opt_state,
-            self.state.replace(params=None, opt_state=None),
-            dev, rng, float(self.get_lr()[0]))
+        if self._compressed_axis:
+            mean_loss_dev, new_state, metrics, self._onebit_we, \
+                self._onebit_se = self._step_onebit_gasN(
+                    self.state.params, self.state.opt_state,
+                    self.state.replace(params=None, opt_state=None),
+                    dev, rng, float(self.get_lr()[0]),
+                    self._onebit_we, self._onebit_se)
+        else:
+            mean_loss_dev, new_state, metrics = self._step_gasN(
+                self.state.params, self.state.opt_state,
+                self.state.replace(params=None, opt_state=None),
+                dev, rng, float(self.get_lr()[0]))
         self.state = new_state
         self.micro_steps += self.gas
         self.global_samples += self.train_micro_batch_size_per_gpu() * \
@@ -1567,6 +1639,8 @@ class DeepSpeedEngine:
             if isinstance(self.lr_scheduler, LRScheduler) else None,
             "data_sampler": self._data_sampler.state_dict()
             if self._data_sampler is not None else None,
+            "compression": self._compression.state_dict()
+            if self._compression is not None else None,
         })
         self.wait_checkpoint()
 
@@ -1655,6 +1729,9 @@ class DeepSpeedEngine:
                 self._data_sampler.load_state_dict(client["data_sampler"])
             else:
                 self._data_sampler_state = client["data_sampler"]
+        if client.get("compression") is not None and \
+                self._compression is not None:
+            self._compression.load_state_dict(client["compression"])
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client
 

@@ -98,6 +98,67 @@ def test_engine_compressed_grad_sync(opt_type):
     assert float(jnp.abs(we0).sum()) > 0.0
 
 
+def test_engine_compressed_gas4_converges():
+    """1-bit x gradient accumulation (VERDICT r3 item 7): the fused
+    window accumulates micro grads locally and compresses ONCE at each
+    boundary (reference onebit/adam.py error feedback per optimizer
+    step); training converges at gas=4."""
+    model = SimpleModel()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "OnebitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": 4,
+                                 "comm_backend_name": "nccl"}},
+        "mesh": {"data": 8},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, loss_fn=simple_loss_fn(model))
+    batch = random_regression_data(n=8)
+    losses = [engine.train_batch(batches=[batch] * 4) for _ in range(12)]
+    assert engine._compressed_axis == "data"
+    assert hasattr(engine, "_step_onebit_gasN")
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))
+    we0 = jax.tree.leaves(engine._onebit_we)[0]
+    assert float(jnp.abs(we0).sum()) > 0.0
+    # the per-micro forward() path refuses (it would psum every micro)
+    with pytest.raises(RuntimeError, match="train_batch"):
+        engine.forward(batch)
+
+
+def test_engine_compressed_gas4_matches_psum_direction():
+    """One gas=4 window of the compressed engine moves params in the
+    same direction as the plain-psum gas=4 engine."""
+    model = SimpleModel()
+
+    def mk(comm):
+        params = {"lr": 1e-2, "freeze_step": 1000}
+        if comm:
+            params["comm_backend_name"] = "nccl"
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "OnebitAdam", "params": params},
+            "mesh": {"data": 8},
+        }
+        e, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, loss_fn=simple_loss_fn(model))
+        return e
+
+    batches = [random_regression_data(n=8, seed=s) for s in range(4)]
+    e_c, e_p = mk(True), mk(False)
+    assert e_c._compressed_axis == "data" and e_p._compressed_axis is None
+    for e in (e_c, e_p):
+        e.train_batch(batches=batches)
+    pc = np.concatenate([np.ravel(jax.device_get(l))
+                         for l in jax.tree.leaves(e_c.state.params)])
+    pp = np.concatenate([np.ravel(jax.device_get(l))
+                         for l in jax.tree.leaves(e_p.state.params)])
+    cos = np.dot(pc, pp) / (np.linalg.norm(pc) * np.linalg.norm(pp))
+    assert cos > 0.99, cos
+
+
 def test_engine_compressed_matches_psum_direction():
     """One step of the compressed engine moves params in (approximately)
     the same direction as the plain-psum engine: the compressed

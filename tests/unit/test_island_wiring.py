@@ -169,6 +169,30 @@ def test_pld_custom_loss_without_kwarg_fails_loudly():
             loss_fn=simple_loss_fn(model))
 
 
+def test_compression_schedule_state_survives_checkpoint(tmp_path):
+    """The MoQ eigenvalue factors and the monotone bit ratchet ride the
+    checkpoint: a resumed run keeps the stretched periods instead of
+    silently re-quantizing on the unstretched schedule."""
+    engine, _ = _train(_base_cfg(compression_training=COMP), 5)
+    engine._compression.set_eigenvalue_factors({0: 1.0})   # factor 5
+    engine._compression.strength_vector(engine.global_steps)
+    state = engine._compression.state_dict()
+    assert state["eig_factor"] == {0: 5}
+    engine.save_checkpoint(str(tmp_path / "ck"))
+
+    model2 = SimpleModel(hidden_dim=16)
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, config=_base_cfg(compression_training=COMP),
+        loss_fn=simple_loss_fn(model2))
+    e2.load_checkpoint(
+        str(tmp_path / "ck"),
+        example_batch={"x": np.zeros((8, 16), np.float32),
+                       "y": np.zeros((8, 8), np.float32)})
+    assert e2._compression._eig_factor == {0: 5}
+    assert e2._compression._bits_floor == \
+        engine._compression._bits_floor
+
+
 def test_compression_engages_in_fused_gas_window():
     """gas>1 takes the fused step_gasN path (train_batch with a full
     window) — compression must still engage there, not only in the

@@ -1,1 +1,2 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401
+from deepspeed_tpu.autotuning.cost_model import FirstOrderCostModel  # noqa: F401

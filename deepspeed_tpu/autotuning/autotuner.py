@@ -39,13 +39,24 @@ class Autotuner:
     default run_fn builds an engine from (model, loss_fn, batch_fn)."""
 
     def __init__(self, base_config, tuning_space=None, metric="throughput",
-                 warmup_steps=2, measure_steps=5, max_trials=32):
+                 warmup_steps=2, measure_steps=5, max_trials=32,
+                 cost_model=None, prune_top_k=None, results_path=None):
         self.base_config = dict(base_config)
         self.space = dict(tuning_space or DEFAULT_TUNING_SPACE)
         self.metric = metric
         self.warmup_steps = warmup_steps
         self.measure_steps = measure_steps
         self.max_trials = max_trials
+        # cost_model (autotuning/cost_model.py FirstOrderCostModel):
+        # drops predicted-OOM candidates and, with prune_top_k, measures
+        # only the predicted-top configs — the reference
+        # model_based_tuner.py:58 flow with an analytic estimator
+        self.cost_model = cost_model
+        self.prune_top_k = prune_top_k
+        # per-trial records persist like the reference's experiment logs
+        # (autotuning/scheduler.py writes exp_<n>.json); one json file
+        # with every measured/failed/pruned trial
+        self.results_path = results_path
         self.results = []
 
     def candidates(self):
@@ -85,25 +96,50 @@ class Autotuner:
         return run
 
     def tune(self, run_fn):
-        """Measure every candidate (bounded by max_trials); returns
+        """Measure the candidates (cost-model-pruned when configured,
+        bounded by max_trials); returns
         (best_overrides, best_config, best_metric)."""
+        if self.cost_model is not None:
+            kept, dropped = self.cost_model.prune(
+                list(self.candidates()), top_k=self.prune_top_k)
+            self.results.extend(dropped)
+            trials = [(ov, cfg) for ov, cfg, est in kept]
+        else:
+            trials = list(self.candidates())
         best = (None, None, -1.0)
-        for i, (overrides, cfg) in enumerate(self.candidates()):
+        for i, (overrides, cfg) in enumerate(trials):
             if i >= self.max_trials:
                 logger.warning(f"autotuner: stopping at max_trials="
                                f"{self.max_trials}")
                 break
             try:
+                t0 = time.time()
                 value = run_fn(cfg)
             except Exception as e:  # OOM / invalid combo: record and skip
                 logger.warning(f"autotuner: candidate {overrides} failed: "
                                f"{type(e).__name__}: {e}")
                 self.results.append({"overrides": overrides, "error": str(e)})
                 continue
-            self.results.append({"overrides": overrides, "metric": value})
+            self.results.append({"overrides": overrides, "metric": value,
+                                 "trial_seconds": round(time.time() - t0,
+                                                        3)})
             logger.info(f"autotuner: {overrides} -> {value:.1f}")
             if value > best[2]:
                 best = (overrides, cfg, value)
+        self._persist()
         if best[0] is None:
             raise RuntimeError("autotuner: every candidate failed")
         return best
+
+    def _persist(self):
+        if not self.results_path:
+            return
+        import json
+        import os
+        os.makedirs(os.path.dirname(self.results_path) or ".",
+                    exist_ok=True)
+        with open(self.results_path, "w") as f:
+            json.dump({"space": {k: list(v) for k, v in self.space.items()},
+                       "trials": self.results}, f, indent=2, default=str)
+        logger.info(f"autotuner: wrote {len(self.results)} trial records "
+                    f"to {self.results_path}")

@@ -1,0 +1,104 @@
+"""First-order autotuning cost model: prune the grid before measuring.
+
+Reference: ``deepspeed/autotuning/tuner/cost_model.py:1`` (XGBoost
+fitted on measured trials) + ``tuner/model_based_tuner.py:58``
+(estimate, measure only the predicted-top configs). The TPU redesign is
+ANALYTIC rather than learned: a roofline throughput bound and a
+first-order memory model are computable from the candidate config and
+model dimensions alone — no measurements needed before pruning, and the
+estimates calibrate against the first measured trial (the measured /
+predicted ratio carries over to the survivors' ranking).
+
+Memory model (per chip, bytes):
+  master+moments fp32: 12 N / dp     (ZeRO stage >= 1 shards it)
+  compute params bf16:  2 N          (stage 3 shards: / dp)
+  grads fp32:           4 N          (stage >= 2 shards: / dp)
+  activations:          A * micro * seq * hidden * layers
+with everything optimizer-side dropped when offload_optimizer is on.
+
+Throughput bound: min(flops_per_step / peak_flops,
+                      bytes_per_step / hbm_bw) per optimizer step.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+_ACT_BYTES_PER_TOKEN_PER_LAYER = 34   # bf16 tensors/blk (measured gpt2)
+
+
+class FirstOrderCostModel:
+    def __init__(self, n_params, hidden, num_layers, seq,
+                 device_memory=16e9, peak_flops=197e12, hbm_gbps=700.0,
+                 dp=1):
+        self.n = int(n_params)
+        self.hidden = hidden
+        self.layers = num_layers
+        self.seq = seq
+        self.device_memory = device_memory
+        self.peak_flops = peak_flops
+        self.hbm_bw = hbm_gbps * 1e9
+        self.dp = max(dp, 1)
+
+    def _knob(self, cfg, dotted, default):
+        node = cfg
+        for k in dotted.split("."):
+            if not isinstance(node, dict) or k not in node:
+                return default
+            node = node[k]
+        return node
+
+    def estimate(self, cfg):
+        micro = int(self._knob(cfg, "train_micro_batch_size_per_gpu", 1))
+        gas = int(self._knob(cfg, "gradient_accumulation_steps", 1))
+        stage = int(self._knob(cfg, "zero_optimization.stage", 0))
+        off_opt = self._knob(
+            cfg, "zero_optimization.offload_optimizer", None) is not None
+        shard = self.dp if stage >= 1 else 1
+        g_shard = self.dp if stage >= 2 else 1
+        p_shard = self.dp if stage >= 3 else 1
+
+        n = self.n
+        mem = 2 * n / p_shard                    # bf16 compute copy
+        if off_opt:
+            mem += 0                             # states live on host
+        else:
+            mem += 12 * n / shard                # fp32 master + m + v
+        mem += 4 * n / g_shard                   # fp32 grads/accumulator
+        act = (_ACT_BYTES_PER_TOKEN_PER_LAYER * micro * self.seq
+               * self.hidden * self.layers)
+        mem += act
+
+        tokens = micro * gas * self.seq * self.dp
+        flops = 6 * n * tokens
+        # bytes: weights touched ~3x fwd/bwd + optimizer pass + acts 2x
+        bytes_ = (6 * n + (0 if off_opt else 16 * n) + 2 * act * gas)
+        t_step = max(flops / (self.peak_flops * self.dp),
+                     bytes_ / (self.hbm_bw * self.dp))
+        if off_opt:
+            # host link round trip dominates offload configs; model it
+            # as 2N bf16 over a nominal 10 GB/s host link
+            t_step = max(t_step, 4 * n / 10e9)
+        return {"memory_bytes": mem, "tokens_per_sec": tokens / t_step,
+                "fits": mem < self.device_memory}
+
+    def prune(self, candidates, top_k=None):
+        """candidates: [(overrides, cfg), ...] -> (kept, dropped_records).
+        Drops predicted-OOM configs outright; with ``top_k`` keeps only
+        the top-k by predicted throughput (measurement order = ranked)."""
+        scored = []
+        dropped = []
+        for ov, cfg in candidates:
+            est = self.estimate(cfg)
+            if not est["fits"]:
+                dropped.append({"overrides": ov, "pruned": "memory",
+                                "estimate": est})
+                continue
+            scored.append((est["tokens_per_sec"], ov, cfg, est))
+        scored.sort(key=lambda t: -t[0])
+        if top_k is not None and len(scored) > top_k:
+            for s in scored[top_k:]:
+                dropped.append({"overrides": s[1], "pruned": "ranked_out",
+                                "estimate": s[3]})
+            scored = scored[:top_k]
+        logger.info(f"cost model: measuring {len(scored)} of "
+                    f"{len(scored) + len(dropped)} candidates")
+        return [(ov, cfg, est) for _, ov, cfg, est in scored], dropped

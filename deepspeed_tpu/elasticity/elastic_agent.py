@@ -41,7 +41,7 @@ class DSElasticAgent:
     def __init__(self, training_script, script_args=(), num_workers=1,
                  num_nodes=1, node_rank=0, master_addr="127.0.0.1",
                  master_port=None, max_restarts=3, monitor_interval=0.25,
-                 force_cpu_devices=0):
+                 force_cpu_devices=0, rdzv_port=None):
         self.training_script = training_script
         self.script_args = list(script_args)
         self.num_workers = num_workers
@@ -52,8 +52,11 @@ class DSElasticAgent:
         self.max_restarts = max_restarts
         self.monitor_interval = monitor_interval
         self.force_cpu_devices = force_cpu_devices
+        self.rdzv_port = rdzv_port
         self.restart_count = 0
         self._procs = []
+        self._store = None
+        self._rdzv = None
 
     # ----------------------------------------------------------- workers
     def _spawn(self):
@@ -98,36 +101,48 @@ class DSElasticAgent:
                 p.kill()
                 p.wait()
 
-    def _monitor(self):
-        """Block until the group finishes or a worker dies. Returns
-        ("ok", 0) | ("failed", rc)."""
+    def _monitor(self, watch_epoch=None):
+        """Block until the group finishes, a worker dies, or (multi-node)
+        the rendezvous epoch advances because ANOTHER node's worker
+        died. Returns ("ok", 0) | ("failed", rc) | ("peer_restart", 0)."""
         while True:
             states = [p.poll() for p in self._procs]
+            # clean exit first: never touch the store once the local
+            # group finished (the node-0 agent may already be tearing
+            # the store down during a skewed shutdown)
+            if all(rc == 0 for rc in states):
+                return "ok", 0
+            # then the epoch: when a peer restarts the group our local
+            # workers also die (their coordinator vanished) — prefer
+            # classifying that as peer_restart. The residual race
+            # (local death observed before the peer's signal lands) is
+            # closed by signal_restart's compare-and-swap: a stale bump
+            # for an already-advanced round is a no-op.
+            if self._rdzv is not None and \
+                    self._rdzv.current_epoch() != watch_epoch:
+                return "peer_restart", 0
             if any(rc is not None and rc != 0 for rc in states):
                 bad = next(rc for rc in states if rc is not None and rc != 0)
                 return "failed", bad
-            if all(rc == 0 for rc in states):
-                return "ok", 0
             time.sleep(self.monitor_interval)
 
     # --------------------------------------------------------------- run
     def run(self):
         """Supervise until success or restart budget exhausted; returns
-        the exit code (0 = the whole group finished cleanly)."""
-        if self.num_nodes > 1:
-            # a re-rendezvous after failure needs every node's agent to
-            # agree on the new coordinator port; without a cross-node
-            # control channel the surviving nodes would keep waiting on
-            # the old port forever
-            raise ValueError(
-                "elastic restart currently supports single-node groups; "
-                "multi-node recovery needs an external supervisor that "
-                "relaunches all nodes (e.g. the pod scheduler)")
+        the exit code (0 = the whole group finished cleanly).
+
+        Multi-node: agents coordinate through the node-0 agent's
+        rendezvous store (elasticity/rendezvous.py, reference torch
+        store-based rendezvous) — a worker loss on ANY node bumps the
+        epoch, every agent tears down and re-joins, and node 0 publishes
+        the new coordinator port for the round."""
         handled = {}
         for sig in (signal.SIGINT, signal.SIGTERM):
             handled[sig] = signal.signal(
                 sig, lambda s, f: (self._terminate(), sys.exit(128 + s)))
         try:
+            if self.num_nodes > 1:
+                return self._run_multinode()
             while True:
                 self._spawn()
                 state, rc = self._monitor()
@@ -149,3 +164,46 @@ class DSElasticAgent:
         finally:
             for sig, old in handled.items():
                 signal.signal(sig, old)
+            if self._store is not None:
+                self._store.close()
+
+    def _run_multinode(self):
+        from deepspeed_tpu.elasticity.rendezvous import (
+            ElasticRendezvous, RendezvousClient, RendezvousStore)
+        assert self.rdzv_port, \
+            "multi-node elastic needs rdzv_port (the node-0 agent's " \
+            "rendezvous store port, shared by every agent)"
+        if self.node_rank == 0:
+            self._store = RendezvousStore(port=self.rdzv_port)
+        client = RendezvousClient(self.master_addr, self.rdzv_port)
+        self._rdzv = ElasticRendezvous(client, self.node_rank,
+                                       self.num_nodes, self.master_addr)
+        last_rc = 1
+        min_epoch = 0
+        while True:
+            epoch, port = self._rdzv.next_round(min_epoch=min_epoch)
+            min_epoch = epoch + 1   # never re-join a finished round
+            if epoch > self.max_restarts:
+                logger.error(f"elastic agent[{self.node_rank}]: restart "
+                             f"budget ({self.max_restarts}) exhausted")
+                return last_rc
+            self.restart_count = epoch
+            self.master_port = port
+            self._spawn()
+            state, rc = self._monitor(watch_epoch=epoch)
+            if state == "ok":
+                # barrier before the node-0 agent closes the store:
+                # peers may still be mid-shutdown polling the epoch
+                self._rdzv.signal_done()
+                return 0
+            self._terminate()
+            if state == "failed":
+                last_rc = rc
+                new_epoch = self._rdzv.signal_restart(from_epoch=epoch)
+                logger.warning(
+                    f"elastic agent[{self.node_rank}]: worker failed "
+                    f"(rc={rc}); restart round is now {new_epoch}")
+            else:
+                logger.warning(
+                    f"elastic agent[{self.node_rank}]: peer node "
+                    "restarted the group; re-joining")

@@ -35,6 +35,10 @@ def parse_args(args=None):
                    help="supervise workers with restart-on-failure "
                         "(reference elastic_agent.py)")
     p.add_argument("--max_elastic_restarts", type=int, default=3)
+    p.add_argument("--rdzv_port", type=int, default=None,
+                   help="multi-node elastic: the node-0 agent's "
+                        "rendezvous-store port (all agents connect to "
+                        "master_addr:rdzv_port)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(args)
@@ -50,7 +54,8 @@ def main(args=None):
             node_rank=args.node_rank, master_addr=args.master_addr,
             master_port=args.master_port,
             max_restarts=args.max_elastic_restarts,
-            force_cpu_devices=args.force_cpu_devices)
+            force_cpu_devices=args.force_cpu_devices,
+            rdzv_port=args.rdzv_port)
         sys.exit(agent.run())
     world_size = args.num_nodes * args.num_workers
     procs = []

@@ -5,34 +5,32 @@ Reference: the Triton block-sparse attention kernels
 ``softmax.py`` :123) driven by SparsityConfig layouts — the reference's
 long-sequence story (10x longer sequences, ~6x faster; BASELINE.md).
 
-Design — compacted look-up tables with scalar prefetch:
-  * the [heads, nq, nk] block layout is compiled (at trace time, on host)
-    into a LUT of active column blocks per query row: ``lut[h, qi, t]``
-    and ``count[h, qi]``. The grid is ``(b*h, nq, max_active)`` — grid
-    steps exist ONLY for (padded) active blocks, so both the MXU work
-    AND the k/v block DMA scale with the layout density. This is the
-    Pallas equivalent of the Triton kernels' ``make_lut``.
-  * the LUT rides as *scalar prefetch* operands (SMEM), so BlockSpec
-    index maps can read it — the pipeline knows the next block's address
-    ahead of time and keeps prefetching (a data-dependent ``pl.when``
-    skip would serialize Mosaic's double buffering; measured 5x slower).
-  * padding steps (t >= count) re-point the DMA at the row's last active
-    block (no new traffic) and skip compute.
+Design — RAGGED (CSR-style) grids with scalar prefetch:
+  * the [heads, nq, nk] block layout is compiled (at trace time, on
+    host) into per-head step lists: step s touches (row[h,s], col[h,s])
+    with first/last flags marking row boundaries. The grid is
+    ``(b*h, S)`` where ``S = nnz`` — one grid step per ACTIVE block, so
+    both the MXU work and the k/v block DMA scale with the layout
+    density. This is the Pallas equivalent of the Triton ``make_lut``.
+    (An earlier revision padded every ROW to the max row population —
+    one dense global row, as in BigBird/Longformer, then inflated the
+    whole grid to dense size and measured SLOWER than dense at 32k.)
+  * the step arrays ride as *scalar prefetch* operands (SMEM), so
+    BlockSpec index maps can read them — the pipeline knows the next
+    block's address ahead of time and keeps prefetching (a
+    data-dependent ``pl.when`` skip would serialize Mosaic's double
+    buffering).
+  * with ``different_layout_per_head`` the per-head step counts differ;
+    shorter heads pad to S with no-op steps that re-point the DMA at
+    the previous block (no new traffic, no compute).
+  * rows with no active blocks still emit one no-op step flagged
+    first+last so their output block finalizes (to zeros, matching the
+    dense kernel's fully-masked-row behavior).
   * causal masking stays in-kernel for diagonal blocks; callers pass
     layouts already lower-triangular for unidirectional patterns
     (flash_attention ANDs tril in).
-  * backward follows flash-attention-2: dq over the same row LUT; dk/dv
-    over the transposed (column -> active rows) LUT.
-
-Measured (1 v5e chip via the dev relay, seq 8k, 4 heads, d=64, block
-512, in-dispatch chained timing, 3 runs): window+global layout at ~12%
-density runs ~1.35x faster than the dense layout through the same
-kernel (3.4ms vs 4.5ms/iter). Both share a ~3ms fixed per-invocation
-floor in this environment; subtracting it, the marginal per-block cost
-scales with density as designed (~1.3us/step). The floor is an
-environment/dispatch artifact of the small-batch d=64 regime, not the
-kernel loop — re-profile on directly-attached chips at production
-head counts.
+  * backward follows flash-attention-2: dq over the same row-major
+    steps; dk/dv over the transposed (column-major) steps.
 """
 
 import functools
@@ -54,31 +52,35 @@ from deepspeed_tpu.ops.attention.flash import (NEG_INF, _bwd_p_ds,
                                                _online_softmax_step)
 
 
-def build_luts(layout):
-    """layout [H, nq, nk] int -> row LUT + transposed (column) LUT.
+def build_csr(layout):
+    """layout [H, n_rows, n_cols] -> per-head ragged step arrays.
 
-    Returns (lut [H, nq, A], count [H, nq], lut_t [H, nk, At],
-    count_t [H, nk]); padding entries repeat the last active index so
-    padded grid steps re-fetch an already-resident block."""
-    layout = np.asarray(layout) != 0
-    H, nq, nk = layout.shape
-
-    def compact(mat, n_rows, n_cols):
-        counts = mat.sum(axis=-1).astype(np.int32)        # [H, rows]
-        A = max(int(counts.max()), 1)
-        lut = np.zeros((H, n_rows, A), np.int32)
-        for h in range(H):
-            for r in range(n_rows):
-                idx = np.nonzero(mat[h, r])[0]
-                if len(idx) == 0:
-                    continue
-                lut[h, r, :len(idx)] = idx
-                lut[h, r, len(idx):] = idx[-1]
-        return lut, counts
-
-    lut, count = compact(layout, nq, nk)
-    lut_t, count_t = compact(layout.transpose(0, 2, 1), nk, nq)
-    return lut, count, lut_t, count_t
+    Returns (row, col, first, last, run), each [H, S] int32 with
+    S = max over heads of (nnz + empty-row placeholders). Steps walk the
+    layout row-major; ``first``/``last`` flag each row's boundary steps
+    (scratch init / output finalize), ``run`` is 0 on placeholder and
+    padding steps."""
+    H, n_rows, n_cols = layout.shape
+    heads = []
+    for h in range(H):
+        steps = []   # (row, col, first, last, run)
+        for r in range(n_rows):
+            idx = np.nonzero(layout[h, r])[0]
+            if len(idx) == 0:
+                steps.append((r, 0, 1, 1, 0))
+                continue
+            n = len(idx)
+            for t, c in enumerate(idx):
+                steps.append((r, int(c), int(t == 0), int(t == n - 1), 1))
+        heads.append(np.array(steps, np.int32))
+    S = max(len(s) for s in heads)
+    out = np.zeros((5, H, S), np.int32)
+    for h, arr in enumerate(heads):
+        out[:, h, :len(arr)] = arr.T
+        if len(arr) < S:    # pad: re-point at the last block, all flags 0
+            out[0, h, len(arr):] = arr[-1, 0]
+            out[1, h, len(arr):] = arr[-1, 1]
+    return tuple(out)
 
 
 def _head(i, num_heads, layout_heads):
@@ -86,21 +88,22 @@ def _head(i, num_heads, layout_heads):
 
 
 # --------------------------------------------------------------------- fwd
-def _fwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(row_ref, col_ref, first_ref, last_ref, run_ref,
+                q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, block, causal, num_heads,
-                layout_heads, n_active):
-    qi = pl.program_id(1)
-    t = pl.program_id(2)
+                layout_heads):
+    s = pl.program_id(1)
     h = _head(pl.program_id(0), num_heads, layout_heads)
 
-    @pl.when(t == 0)
+    @pl.when(first_ref[h, s] == 1)
     def _init():
         m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    ki = lut_ref[h, qi, t]
-    run = t < cnt_ref[h, qi]
+    qi = row_ref[h, s]
+    ki = col_ref[h, s]
+    run = run_ref[h, s] == 1
     if causal:
         run = jnp.logical_and(run, ki <= qi)
 
@@ -109,38 +112,41 @@ def _fwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(
+        sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_block_mask(s, qi, ki, block, block, 0)
-        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
+            sc = _causal_block_mask(sc, qi, ki, block, block, 0)
+        _online_softmax_step(sc, v, m_scr, l_scr, acc_scr)
 
-    @pl.when(t == n_active - 1)
+    @pl.when(last_ref[h, s] == 1)
     def _finalize():
         _finalize_softmax(o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
-def _sparse_fwd(q3, k3, v3, lut, cnt, *, scale, block, causal, num_heads,
+def _sparse_fwd(q3, k3, v3, csr, *, scale, block, causal, num_heads,
                 interpret):
     bh, q_len, d = q3.shape
-    nq = q_len // block
-    A = lut.shape[2]
-    H = lut.shape[0]
+    row, col, first, last, run = csr
+    H, S = row.shape
+
+    def at_row(i, s, row, col, first, last, run):
+        return (i, row[_head(i, num_heads, H), s], 0)
+
+    def at_col(i, s, row, col, first, last, run):
+        return (i, col[_head(i, num_heads, H), s], 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, A),
+        num_scalar_prefetch=5,
+        grid=(bh, S),
         in_specs=[
-            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt: (i, j, 0)),
-            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt:
-                         (i, lut[_head(i, num_heads, H), j, t], 0)),
-            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt:
-                         (i, lut[_head(i, num_heads, H), j, t], 0)),
+            pl.BlockSpec((1, block, d), at_row),
+            pl.BlockSpec((1, block, d), at_col),
+            pl.BlockSpec((1, block, d), at_col),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), lambda i, j, t, lut, cnt: (i, j, 0)),
-            pl.BlockSpec((1, block, 1), lambda i, j, t, lut, cnt: (i, j, 0)),
+            pl.BlockSpec((1, block, d), at_row),
+            pl.BlockSpec((1, block, 1), at_row),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, 128), jnp.float32),
@@ -150,7 +156,7 @@ def _sparse_fwd(q3, k3, v3, lut, cnt, *, scale, block, causal, num_heads,
     )
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block=block, causal=causal,
-        num_heads=num_heads, layout_heads=H, n_active=A)
+        num_heads=num_heads, layout_heads=H)
     o, lse = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=[
@@ -158,24 +164,25 @@ def _sparse_fwd(q3, k3, v3, lut, cnt, *, scale, block, causal, num_heads,
             jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(lut, cnt, q3, k3, v3)
+    )(row, col, first, last, run, q3, k3, v3)
     return o, lse
 
 
 # --------------------------------------------------------------------- bwd
-def _bwd_dq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_scr, *, scale, block, causal,
-                   num_heads, layout_heads, n_active):
-    qi = pl.program_id(1)
-    t = pl.program_id(2)
+def _bwd_dq_kernel(row_ref, col_ref, first_ref, last_ref, run_ref,
+                   q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, block, causal, num_heads,
+                   layout_heads):
+    s = pl.program_id(1)
     h = _head(pl.program_id(0), num_heads, layout_heads)
 
-    @pl.when(t == 0)
+    @pl.when(first_ref[h, s] == 1)
     def _init():
         dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    ki = lut_ref[h, qi, t]
-    run = t < cnt_ref[h, qi]
+    qi = row_ref[h, s]
+    ki = col_ref[h, s]
+    run = run_ref[h, s] == 1
     if causal:
         run = jnp.logical_and(run, ki <= qi)
 
@@ -191,25 +198,27 @@ def _bwd_dq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(t == n_active - 1)
+    @pl.when(last_ref[h, s] == 1)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                    block, causal, num_heads, layout_heads, n_active):
-    ki = pl.program_id(1)
-    t = pl.program_id(2)
+def _bwd_dkv_kernel(row_ref, col_ref, first_ref, last_ref, run_ref,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block,
+                    causal, num_heads, layout_heads):
+    s = pl.program_id(1)
     h = _head(pl.program_id(0), num_heads, layout_heads)
 
-    @pl.when(t == 0)
+    @pl.when(first_ref[h, s] == 1)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    qi = lut_ref[h, ki, t]
-    run = t < cnt_ref[h, ki]
+    # transposed walk: "row" is the k/v column block, "col" the q row
+    ki = row_ref[h, s]
+    qi = col_ref[h, s]
+    run = run_ref[h, s] == 1
     if causal:
         run = jnp.logical_and(run, ki <= qi)
 
@@ -228,65 +237,66 @@ def _bwd_dkv_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(t == n_active - 1)
+    @pl.when(last_ref[h, s] == 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _sparse_bwd(q3, k3, v3, o3, lse, do3, lut, cnt, lut_t, cnt_t, *, scale,
-                block, causal, num_heads, interpret):
+def _sparse_bwd(q3, k3, v3, o3, lse, do3, csr, csr_t, *, scale, block,
+                causal, num_heads, interpret):
     bh, q_len, d = q3.shape
-    nq = q_len // block
-    A, At = lut.shape[2], lut_t.shape[2]
-    H = lut.shape[0]
+    row, col, first, last, run = csr
+    row_t, col_t, first_t, last_t, run_t = csr_t
+    H, S = row.shape
+    St = row_t.shape[1]
 
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    def row(i, j, t, lut, cnt):
-        return (i, j, 0)
+    def at_row(i, s, row, col, *_rest):
+        return (i, row[_head(i, num_heads, H), s], 0)
 
-    def col_from_lut(i, j, t, lut, cnt):
-        return (i, lut[_head(i, num_heads, H), j, t], 0)
+    def at_col(i, s, row, col, *_rest):
+        return (i, col[_head(i, num_heads, H), s], 0)
 
     grid_dq = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, A),
+        num_scalar_prefetch=5,
+        grid=(bh, S),
         in_specs=[
-            pl.BlockSpec((1, block, d), row),
-            pl.BlockSpec((1, block, d), col_from_lut),
-            pl.BlockSpec((1, block, d), col_from_lut),
-            pl.BlockSpec((1, block, d), row),
-            pl.BlockSpec((1, block, 1), row),
-            pl.BlockSpec((1, block, 1), row),
+            pl.BlockSpec((1, block, d), at_row),     # q
+            pl.BlockSpec((1, block, d), at_col),     # k
+            pl.BlockSpec((1, block, d), at_col),     # v
+            pl.BlockSpec((1, block, d), at_row),     # do
+            pl.BlockSpec((1, block, 1), at_row),     # lse
+            pl.BlockSpec((1, block, 1), at_row),     # delta
         ],
-        out_specs=pl.BlockSpec((1, block, d), row),
+        out_specs=pl.BlockSpec((1, block, d), at_row),
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block=block,
                           causal=causal, num_heads=num_heads,
-                          layout_heads=H, n_active=A),
+                          layout_heads=H),
         grid_spec=grid_dq,
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
         interpret=interpret,
-    )(lut, cnt, q3, k3, v3, do3, lse, delta)
+    )(row, col, first, last, run, q3, k3, v3, do3, lse, delta)
 
     grid_dkv = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, k3.shape[1] // block, At),
+        num_scalar_prefetch=5,
+        grid=(bh, St),
         in_specs=[
-            pl.BlockSpec((1, block, d), col_from_lut),   # q rows via lut_t
-            pl.BlockSpec((1, block, d), row),            # k fixed column
-            pl.BlockSpec((1, block, d), row),
-            pl.BlockSpec((1, block, d), col_from_lut),   # do rows
-            pl.BlockSpec((1, block, 1), col_from_lut),
-            pl.BlockSpec((1, block, 1), col_from_lut),
+            pl.BlockSpec((1, block, d), at_col),     # q rows (transposed)
+            pl.BlockSpec((1, block, d), at_row),     # k fixed column
+            pl.BlockSpec((1, block, d), at_row),     # v
+            pl.BlockSpec((1, block, d), at_col),     # do rows
+            pl.BlockSpec((1, block, 1), at_col),     # lse
+            pl.BlockSpec((1, block, 1), at_col),     # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), row),
-            pl.BlockSpec((1, block, d), row),
+            pl.BlockSpec((1, block, d), at_row),
+            pl.BlockSpec((1, block, d), at_row),
         ],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
@@ -294,39 +304,38 @@ def _sparse_bwd(q3, k3, v3, o3, lse, do3, lut, cnt, lut_t, cnt_t, *, scale,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block=block,
                           causal=causal, num_heads=num_heads,
-                          layout_heads=H, n_active=At),
+                          layout_heads=H),
         grid_spec=grid_dkv,
         out_shape=[
             jax.ShapeDtypeStruct((bh, q_len, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, q_len, d), v3.dtype),
         ],
         interpret=interpret,
-    )(lut_t, cnt_t, q3, k3, v3, do3, lse, delta)
+    )(row_t, col_t, first_t, last_t, run_t, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 
 # ------------------------------------------------------------------- entry
 def make_sparse_op(layout, *, causal, scale, block, num_heads, interpret):
-    """custom_vjp closing over the (static) layout's LUTs."""
-    lut, cnt, lut_t, cnt_t = build_luts(layout)
-    lut, cnt = jnp.asarray(lut), jnp.asarray(cnt)
-    lut_t, cnt_t = jnp.asarray(lut_t), jnp.asarray(cnt_t)
+    """custom_vjp closing over the (static) layout's CSR step arrays."""
+    csr = tuple(jnp.asarray(a) for a in build_csr(layout))
+    csr_t = tuple(jnp.asarray(a)
+                  for a in build_csr(layout.transpose(0, 2, 1)))
     kw = dict(scale=scale, block=block, causal=causal, num_heads=num_heads,
               interpret=interpret)
 
     @jax.custom_vjp
     def op(q3, k3, v3):
-        o, _ = _sparse_fwd(q3, k3, v3, lut, cnt, **kw)
+        o, _ = _sparse_fwd(q3, k3, v3, csr, **kw)
         return o
 
     def fwd(q3, k3, v3):
-        o, lse = _sparse_fwd(q3, k3, v3, lut, cnt, **kw)
+        o, lse = _sparse_fwd(q3, k3, v3, csr, **kw)
         return o, (q3, k3, v3, o, lse)
 
     def bwd(res, do):
         q3, k3, v3, o, lse = res
-        return _sparse_bwd(q3, k3, v3, o, lse, do, lut, cnt, lut_t, cnt_t,
-                           **kw)
+        return _sparse_bwd(q3, k3, v3, o, lse, do, csr, csr_t, **kw)
 
     op.defvjp(fwd, bwd)
     return op
@@ -347,8 +356,9 @@ def sparse_flash_attention(q, k, v, sparsity_config, *, causal=True,
                            scale=None, interpret=None):
     """Block-sparse attention on [batch, len, heads, head_dim] inputs,
     pattern from a SparsityConfig (ops/sparse_attention). Ops (and their
-    host-built LUTs) are cached per (config, seq, heads, ...) so repeated
-    calls/retraces skip the O(heads * blocks^2) layout compaction."""
+    host-built step arrays) are cached per (config, seq, heads, ...) so
+    repeated calls/retraces skip the O(heads * blocks^2) layout
+    compaction."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
             "block-sparse attention needs the Pallas TPU backend "

@@ -1217,6 +1217,7 @@ class DeepSpeedEngine:
             jax.tree.map(lambda g: g.copy_to_host_async(), grads)
 
             def drain(ls=grads):
+                t0 = time.perf_counter()
                 host = []
                 for g in ls:
                     if isinstance(g, tuple):
@@ -1233,6 +1234,9 @@ class DeepSpeedEngine:
                     else:
                         host.append(np.asarray(g))
                 self._offload.accumulate(host)
+                self._offload.phase["d2h_accum_s"] += \
+                    time.perf_counter() - t0
+                self._offload.phase["accum_calls"] += 1
 
             # backpressure: each queued future pins a device grad tree;
             # bound in-flight trees to 2 (double buffer) so a long gas
@@ -1375,10 +1379,31 @@ class DeepSpeedEngine:
 
     def _join_offload(self):
         """Drain the grad-accumulation worker queue (exceptions surface
-        here)."""
+        here). The measured wait is the portion of the D2H/accumulate
+        work NOT hidden behind device compute."""
         futs, self._offload_futs = self._offload_futs, []
+        t0 = time.perf_counter()
         for f in futs:
             f.result()
+        if self._offload is not None:
+            self._offload.phase.setdefault("join_stall_s", 0.0)
+            self._offload.phase["join_stall_s"] += \
+                time.perf_counter() - t0
+
+    def offload_phase_stats(self):
+        """Per-phase wall-time breakdown since the last call (ZeRO-
+        Offload instrumentation; bench embeds it). ``overlap_fraction``
+        = share of the D2H+accumulate host work hidden behind device
+        compute (1 - join_stall / d2h_accum)."""
+        if self._offload is None:
+            return {}
+        st = self._offload.pop_phase_stats()
+        d2h = st.get("d2h_accum_s", 0.0)
+        stall = st.get("join_stall_s", 0.0)
+        st["overlap_fraction"] = round(max(1.0 - stall / d2h, 0.0), 4) \
+            if d2h else None
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in st.items()}
 
     def _offload_step(self):
         """Boundary step in ZeRO-Offload mode: host Adam over the

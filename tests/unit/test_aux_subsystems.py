@@ -406,6 +406,57 @@ def test_autotuner_picks_best():
     assert any("error" in r for r in tuner.results)
 
 
+def test_autotuner_cost_model_prunes_and_keeps_winner(tmp_path):
+    """VERDICT r3 item 9: the analytic cost model drops predicted-OOM
+    configs and measures only the predicted-top candidates; the winner
+    matches the unpruned measured search, trials are fewer, and the
+    per-trial records persist (reference tuner/cost_model.py:1 +
+    model_based_tuner.py:58 + scheduler experiment logs)."""
+    import json
+    from deepspeed_tpu.autotuning import Autotuner, FirstOrderCostModel
+
+    space = {"zero_optimization.stage": [0, 1],
+             "train_micro_batch_size_per_gpu": [2, 4, 8, 64]}
+
+    measured = []
+
+    def fake_run(cfg):
+        # throughput grows with micro batch; micro=64 would OOM on the
+        # real device (the cost model must prune it BEFORE measurement)
+        mb = cfg["train_micro_batch_size_per_gpu"]
+        stage = cfg["zero_optimization"]["stage"]
+        if mb == 64:
+            raise MemoryError("oom (should have been pruned)")
+        measured.append((stage, mb))
+        return mb * 10 + stage
+
+    # device sized so micro=64's activations don't fit
+    cm = FirstOrderCostModel(n_params=1e6, hidden=256, num_layers=4,
+                             seq=512, device_memory=1.1e9)
+    assert not cm.estimate({"train_micro_batch_size_per_gpu": 64})["fits"]
+    assert cm.estimate({"train_micro_batch_size_per_gpu": 8})["fits"]
+
+    baseline = Autotuner({}, tuning_space=space)
+    b_over, _, b_val = baseline.tune(fake_run)
+    n_baseline = len(measured)
+    measured.clear()
+
+    tuner = Autotuner({}, tuning_space=space, cost_model=cm,
+                      prune_top_k=4,
+                      results_path=str(tmp_path / "trials.json"))
+    overrides, _, val = tuner.tune(fake_run)
+    assert (overrides, val) == (b_over, b_val)     # same winner
+    assert len(measured) < n_baseline              # fewer trials
+    assert all(mb != 64 for _, mb in measured)     # OOM never measured
+
+    rec = json.loads((tmp_path / "trials.json").read_text())
+    pruned = [t for t in rec["trials"] if t.get("pruned")]
+    ran = [t for t in rec["trials"] if "metric" in t]
+    assert any(t["pruned"] == "memory" for t in pruned)
+    assert len(ran) == len(measured)
+    assert all("trial_seconds" in t for t in ran)
+
+
 def test_autotuner_real_engine_trial():
     from deepspeed_tpu.autotuning import Autotuner
     from tests.unit.simple_model import (SimpleModel, simple_loss_fn,

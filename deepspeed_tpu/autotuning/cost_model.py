@@ -59,10 +59,13 @@ class FirstOrderCostModel:
         n = self.n
         mem = 2 * n / p_shard                    # bf16 compute copy
         if off_opt:
-            mem += 0                             # states live on host
+            # ZeRO-Offload: fp32 master/moments AND the fp32 grad
+            # accumulators live on host (zero/offload.py); the chip only
+            # holds transient compute-dtype grads in flight
+            mem += 2 * n / g_shard
         else:
             mem += 12 * n / shard                # fp32 master + m + v
-        mem += 4 * n / g_shard                   # fp32 grads/accumulator
+            mem += 4 * n / g_shard               # fp32 grads/accumulator
         act = (_ACT_BYTES_PER_TOKEN_PER_LAYER * micro * self.seq
                * self.hidden * self.layers)
         mem += act

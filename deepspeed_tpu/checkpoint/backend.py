@@ -1,0 +1,105 @@
+"""Pluggable checkpoint-engine backends.
+
+Reference: ``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py:9``
+— the ``CheckpointEngine`` ABC (create/save/load/commit) with swappable
+backends (TorchCheckpointEngine, the Nebula async engine). The TPU
+repo's native format is the sharded npz-chunk layout in
+``checkpoint/engine.py``; this module is the SEAM that lets a
+deployment swap it (e.g. a GCS/tensorstore backend on pods, where
+checkpoints should stream to object storage rather than a filesystem)
+without touching DeepSpeedEngine.
+
+Select via config::
+
+    {"checkpoint_engine": {"type": "npz"}}                      # default
+    {"checkpoint_engine": {"type": "my_pkg.my_mod:MyEngine",
+                           "params": {...}}}                    # custom
+
+A custom class implements :class:`CheckpointEngine`; ``save`` may
+return a writer object with ``wait()`` for async backends (the engine
+calls ``wait_checkpoint`` through it, same contract as the native
+async writer).
+
+Known seam limit: the training engine's AUXILIARY artifacts — host
+optimizer states under ZeRO-Offload (``host_optim_states.npz``) and
+the 16-bit consolidation file — still write as numpy files next to the
+backend's payload; a fully remote backend must handle (or disable)
+those paths.
+"""
+
+import abc
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class CheckpointEngine(abc.ABC):
+    """The backend contract DeepSpeedEngine saves/loads through."""
+
+    def __init__(self, params=None):
+        self.params = dict(params or {})
+
+    def create(self, tag):
+        """Hook before a save of ``tag`` begins (reference: logging /
+        transaction open)."""
+
+    @abc.abstractmethod
+    def save(self, path, state, client_state=None, async_write=False,
+             on_done=None):
+        """Persist ``state`` (pytree) + ``client_state`` under ``path``.
+        Returns None or an async writer exposing ``wait()``."""
+
+    @abc.abstractmethod
+    def load(self, path, target, mesh=None):
+        """Restore into ``target``'s structure/shardings; returns
+        (state, client_state)."""
+
+    def load_subtree(self, path, target, prefix):
+        """Partial restore (inference engines pull only ``.params``);
+        backends that cannot do better may load everything and slice."""
+        raise NotImplementedError
+
+    def commit(self, tag):
+        """Hook after the save of ``tag`` is durable (reference: the
+        Nebula engine publishes the checkpoint here)."""
+
+
+class NpzCheckpointEngine(CheckpointEngine):
+    """The native sharded npz-chunk format (checkpoint/engine.py):
+    per-process shard files, async writer thread, durability barrier,
+    reshape-on-load across mesh/stage changes."""
+
+    def save(self, path, state, client_state=None, async_write=False,
+             on_done=None):
+        from deepspeed_tpu.checkpoint.engine import save_state
+        return save_state(path, state, client_state,
+                          async_write=async_write, on_done=on_done)
+
+    def load(self, path, target, mesh=None):
+        from deepspeed_tpu.checkpoint.engine import load_state
+        return load_state(path, target, mesh=mesh)
+
+    def load_subtree(self, path, target, prefix):
+        from deepspeed_tpu.checkpoint.engine import load_subtree
+        return load_subtree(path, target, prefix=prefix)
+
+
+def get_checkpoint_engine(section):
+    """``checkpoint_engine`` config section -> backend instance."""
+    section = dict(section or {})
+    kind = section.get("type", "npz")
+    params = section.get("params") or {}
+    if kind in ("npz", "native", "default"):
+        return NpzCheckpointEngine(params)
+    if ":" not in kind:
+        raise ValueError(
+            f"checkpoint_engine.type {kind!r}: use 'npz' or a "
+            "'package.module:ClassName' path to a CheckpointEngine "
+            "subclass")
+    mod_name, cls_name = kind.split(":", 1)
+    import importlib
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    engine = cls(params)
+    assert isinstance(engine, CheckpointEngine), \
+        f"{kind} is not a CheckpointEngine"
+    logger.info(f"checkpoint engine: {kind}")
+    return engine

@@ -178,6 +178,16 @@ class DSElasticAgent:
         client = RendezvousClient(self.master_addr, self.rdzv_port)
         self._rdzv = ElasticRendezvous(client, self.node_rank,
                                        self.num_nodes, self.master_addr)
+        try:
+            return self._multinode_loop()
+        finally:
+            # never orphan workers: a store outage (node-0 host died)
+            # raises out of _monitor/next_round — the local training
+            # processes must die with the agent, not wedge on dead
+            # collectives holding the chips
+            self._terminate()
+
+    def _multinode_loop(self):
         last_rc = 1
         min_epoch = 0
         while True:

@@ -149,7 +149,10 @@ class RendezvousClient:
                     req["txn"] = txn
                 self._sock.sendall((json.dumps(req) + "\n").encode())
                 resp = json.loads(self._file.readline())
-                assert resp.get("ok"), resp
+                if not resp.get("ok"):
+                    # a server-reported protocol error is not retryable
+                    raise RuntimeError(f"rendezvous store rejected "
+                                       f"{op} {key}: {resp}")
                 return resp.get("value")
             except (OSError, json.JSONDecodeError):
                 self.close()

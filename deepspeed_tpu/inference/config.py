@@ -66,6 +66,9 @@ class DeepSpeedInferenceConfig(BaseModel):
     # TPU additions
     mesh: Optional[Dict[str, int]] = None
     kv_cache_dtype: str = "bfloat16"
+    # pluggable checkpoint backend (checkpoint/backend.py) — must match
+    # the backend the training engine saved with
+    checkpoint_engine: Dict[str, Any] = Field(default_factory=dict)
 
     def model_post_init(self, _ctx):
         # normalize torch-style dtype strings ("torch.float16", "fp16", "half")

@@ -292,8 +292,14 @@ class InferenceEngine:
         """Load params saved by the training engine's save_checkpoint.
         For ZeRO-Inference engines the restore streams straight into host
         memory (and quantizes leaf-by-leaf) — peak device memory during
-        the load is at most one parameter."""
-        from deepspeed_tpu.checkpoint.engine import load_subtree
+        the load is at most one parameter. Reads go through the
+        pluggable checkpoint backend (checkpoint/backend.py) so custom
+        training-side engines serve too."""
+        from deepspeed_tpu.checkpoint.backend import get_checkpoint_engine
+        backend = get_checkpoint_engine(self._config.checkpoint_engine)
+
+        def load_subtree(path, target, prefix):
+            return backend.load_subtree(path, target, prefix=prefix)
         if tag is None:
             latest = os.path.join(path, "latest")
             if os.path.exists(latest):

@@ -1,0 +1,107 @@
+"""CLIP text encoder (the diffusers serving stack's text tower).
+
+Reference: ``deepspeed/module_inject/containers/clip.py``
+(HFCLIPLayerPolicy injecting DeepSpeedGPTInference into
+``transformers`` CLIPEncoderLayer) — the text half of the stable
+-diffusion ``generic_injection`` path (replace_module.py:182).
+
+TPU form: a native flax module with the exact HF CLIPTextModel
+numerics — causal text attention, pre-LN blocks, quick_gelu — so
+ingestion (module_inject.policy.CLIPPolicy) is a pure weight relayout
+and attention routes through the same QDense/flash machinery as every
+other family (int8 serving and sharding rules apply unchanged).
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention.reference import mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 12
+    num_heads: int = 8
+    max_seq_len: int = 77
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def quick_gelu(x):
+    return x * nn.sigmoid(1.702 * x)
+
+
+def _dense(cfg, features, axes, name):
+    from deepspeed_tpu.ops.quant.qdense import QDense
+    return QDense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                  kernel_init=nn.with_partitioning(
+                      nn.initializers.normal(0.02), axes), name=name)
+
+
+class CLIPEncoderLayer(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_1")(x)
+        q = _dense(cfg, cfg.hidden_size, ("embed", "kv"), "q_proj")(h)
+        k = _dense(cfg, cfg.hidden_size, ("embed", "kv"), "k_proj")(h)
+        v = _dense(cfg, cfg.hidden_size, ("embed", "kv"), "v_proj")(h)
+        q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, l, cfg.num_heads, cfg.head_dim)
+        o = mha_reference(q, k, v, causal=True)   # CLIP text is causal
+        o = o.reshape(b, l, cfg.hidden_size)
+        x = x + _dense(cfg, cfg.hidden_size, ("heads", "embed"),
+                       "out_proj")(o)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_2")(x)
+        h = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "fc1")(h)
+        h = quick_gelu(h)
+        h = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "fc2")(h)
+        return x + h
+
+
+class CLIPText(nn.Module):
+    """Returns last_hidden_state [b, l, hidden] (HF CLIPTextModel
+    contract; the pooled eot-token output is a gather the caller owns)."""
+    cfg: CLIPTextConfig
+
+    qtensor_params = True   # QDense consumes QTensor kernels (int8)
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        b, l = input_ids.shape
+        tok = self.param(
+            "token_embedding",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param(
+            "position_embedding",
+            nn.with_partitioning(nn.initializers.normal(0.01),
+                                 ("seq", "embed")),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        tok_v = tok.value if hasattr(tok, "value") else tok
+        pos_v = pos.value if hasattr(pos, "value") else pos
+        x = tok_v.astype(cfg.dtype)[input_ids] + \
+            pos_v.astype(cfg.dtype)[None, :l]
+        for i in range(cfg.num_layers):
+            x = CLIPEncoderLayer(cfg, name=f"layers_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="final_layer_norm")(x)

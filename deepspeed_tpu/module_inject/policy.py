@@ -641,6 +641,68 @@ class MegatronGPTMoEPolicy(MegatronGPT2Policy):
         return {"moe": out}
 
 
+class CLIPPolicy(InjectionPolicy):
+    """HF CLIPTextModel (reference containers/clip.py HFCLIPLayerPolicy
+    — the stable-diffusion text tower of the generic_injection path,
+    replace_module.py:182). Separate q/k/v/out projections transpose
+    straight into the native CLIPText layout."""
+
+    model_type = "clip_text_model"
+
+    @classmethod
+    def matches(cls, hf_config):
+        return getattr(hf_config, "model_type", None) in (
+            "clip_text_model", "clip")
+
+    @classmethod
+    def build_module(cls, hf_config, dtype=jnp.float32):
+        from deepspeed_tpu.models.clip import CLIPText, CLIPTextConfig
+        c = hf_config
+        if getattr(c, "text_config", None) is not None:   # full CLIPConfig
+            c = c.text_config
+        assert (getattr(c, "hidden_act", "quick_gelu")
+                == "quick_gelu"), "CLIPText implements quick_gelu"
+        cfg = CLIPTextConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            layer_norm_eps=c.layer_norm_eps, dtype=dtype, param_dtype=dtype)
+        return CLIPText(cfg)
+
+    @classmethod
+    def convert(cls, hf_config, sd):
+        c = hf_config
+        if getattr(c, "text_config", None) is not None:
+            c = c.text_config
+        t = "text_model." if any(k.startswith("text_model.") for k in sd) \
+            else ""
+        p = {"token_embedding":
+                 _np(sd[t + "embeddings.token_embedding.weight"]),
+             "position_embedding":
+                 _np(sd[t + "embeddings.position_embedding.weight"]),
+             "final_layer_norm": {
+                 "scale": _np(sd[t + "final_layer_norm.weight"]),
+                 "bias": _np(sd[t + "final_layer_norm.bias"])}}
+        for i in range(c.num_hidden_layers):
+            h = f"{t}encoder.layers.{i}."
+            p[f"layers_{i}"] = {
+                "ln_1": {"scale": _np(sd[h + "layer_norm1.weight"]),
+                         "bias": _np(sd[h + "layer_norm1.bias"])},
+                "ln_2": {"scale": _np(sd[h + "layer_norm2.weight"]),
+                         "bias": _np(sd[h + "layer_norm2.bias"])},
+                **{name: {"kernel": _t(sd[h + f"self_attn.{name}.weight"]),
+                          "bias": _np(sd[h + f"self_attn.{name}.bias"])}
+                   for name in ("q_proj", "k_proj", "v_proj", "out_proj")},
+                "fc1": {"kernel": _t(sd[h + "mlp.fc1.weight"]),
+                        "bias": _np(sd[h + "mlp.fc1.bias"])},
+                "fc2": {"kernel": _t(sd[h + "mlp.fc2.weight"]),
+                        "bias": _np(sd[h + "mlp.fc2.bias"])},
+            }
+        return p
+
+
 class LlamaPolicy(InjectionPolicy):
     """HF LlamaForCausalLM (the reference gained containers/llama.py in
     later snapshots; built natively here). Rotary convention (rotate-half,

@@ -2,6 +2,7 @@
 ``replace_policies``/``generic_policies`` lists)."""
 
 from deepspeed_tpu.module_inject.policy import (AutoTPPolicy, BertPolicy,
+                                                CLIPPolicy,
                                                 BloomPolicy,
                                                 DistilBertPolicy, GPT2Policy,
                                                 GPTJPolicy, GPTNeoPolicy,
@@ -12,6 +13,7 @@ from deepspeed_tpu.module_inject.policy import (AutoTPPolicy, BertPolicy,
                                                 OPTPolicy)
 
 POLICIES = [GPT2Policy, OPTPolicy, BloomPolicy, GPTJPolicy, GPTNeoPolicy,
+            CLIPPolicy,
             GPTNeoXPolicy, LlamaPolicy, MegatronGPTMoEPolicy,
             MegatronGPT2Policy, BertPolicy,
             DistilBertPolicy]

@@ -193,6 +193,7 @@ class DeepSpeedConfig:
             self.curriculum_learning.get("enabled", False))
         self.data_efficiency = pd.get("data_efficiency") or {}
         self.compression_training = pd.get("compression_training") or {}
+        self.checkpoint_engine = pd.get("checkpoint_engine") or {}
         self.autotuning_config = pd.get("autotuning") or {}
 
         # --- scalars ---
@@ -254,6 +255,7 @@ class DeepSpeedConfig:
         C.MESH, "activation_checkpointing", C.CHECKPOINT, "aio",
         "comms_logger", "flops_profiler", C.PLD, C.EIGENVALUE, "elasticity",
         "curriculum_learning", "data_efficiency", "compression_training",
+        "checkpoint_engine",
         "autotuning", C.GRADIENT_CLIPPING, C.PRESCALE_GRADIENTS,
         C.GRADIENT_PREDIVIDE_FACTOR, C.SPARSE_GRADIENTS, C.STEPS_PER_PRINT,
         C.WALL_CLOCK_BREAKDOWN, C.MEMORY_BREAKDOWN, C.DUMP_STATE,

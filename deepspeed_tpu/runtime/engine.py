@@ -232,6 +232,11 @@ class DeepSpeedEngine:
 
         self._data_sampler = None        # data-efficiency v2 sampler
         self._data_sampler_state = None  # restored before deepspeed_io runs
+        # pluggable checkpoint backend (checkpoint/backend.py; reference
+        # checkpoint_engine.py:9 ABC + Nebula variant)
+        from deepspeed_tpu.checkpoint.backend import get_checkpoint_engine
+        self.checkpoint_engine = get_checkpoint_engine(
+            self._config.checkpoint_engine)
 
         # progressive layer drop: theta(t) computed host-side per forward
         # and handed to the model through the loss fn (reference
@@ -1650,8 +1655,10 @@ class DeepSpeedEngine:
         Each process writes only its own shards (reference per-rank
         ``*_optim_states.pt``); ``async_save`` drains to disk on a
         background thread (the Nebula-engine capability) — call
-        ``wait_checkpoint()`` before relying on the files."""
-        from deepspeed_tpu.checkpoint.engine import save_state
+        ``wait_checkpoint()`` before relying on the files. The backend
+        is pluggable (checkpoint/backend.py, reference
+        checkpoint_engine.py:9): ``checkpoint_engine.type`` in the
+        config swaps the native npz format for a custom engine."""
         assert self.state is not None, "nothing to save before first forward"
         tag = tag or f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag))
@@ -1696,10 +1703,12 @@ class DeepSpeedEngine:
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as f:
                     f.write(str(tag))
+            self.checkpoint_engine.commit(tag)
 
-        self._ckpt_writer = save_state(path, self._live_state(), client,
-                                       async_write=async_save,
-                                       on_done=finalize)
+        self.checkpoint_engine.create(tag)
+        self._ckpt_writer = self.checkpoint_engine.save(
+            path, self._live_state(), client, async_write=async_save,
+            on_done=finalize)
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
 
@@ -1712,7 +1721,6 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, example_batch=None):
-        from deepspeed_tpu.checkpoint.engine import load_state
         self.wait_checkpoint()
         if tag is None:
             latest = os.path.join(load_dir, "latest")
@@ -1728,7 +1736,8 @@ class DeepSpeedEngine:
             assert batch is not None, \
                 "load_checkpoint before init needs example_batch"
             self._ensure_initialized(batch)
-        self.state, client = load_state(path, self.state, mesh=self.mesh)
+        self.state, client = self.checkpoint_engine.load(
+            path, self.state, mesh=self.mesh)
         host_opt = os.path.join(path, "host_optim_states.npz")
         if self._offload is not None and os.path.exists(host_opt):
             if load_optimizer_states:

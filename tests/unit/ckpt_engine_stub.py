@@ -1,0 +1,23 @@
+"""Custom checkpoint-backend stub for the pluggable-engine seam test
+(kept in its own module so the engine's dotted-path import and the test
+resolve the SAME class object)."""
+
+from deepspeed_tpu.checkpoint.backend import NpzCheckpointEngine
+
+CALLS = []
+
+
+class RecordingEngine(NpzCheckpointEngine):
+    def create(self, tag):
+        CALLS.append(("create", tag))
+
+    def save(self, *a, **kw):
+        CALLS.append(("save",))
+        return super().save(*a, **kw)
+
+    def load(self, *a, **kw):
+        CALLS.append(("load",))
+        return super().load(*a, **kw)
+
+    def commit(self, tag):
+        CALLS.append(("commit", tag))

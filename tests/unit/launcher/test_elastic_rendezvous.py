@@ -64,7 +64,9 @@ def test_rendezvous_round_protocol():
         assert res[0] == res[1]
         assert res[(0, "r2")] == res[(1, "r2")]
         assert res[(0, "r2")][0] == res[0][0] + 1    # epoch bumped
-        assert res[(0, "r2")][1] != res[0][1] or True  # fresh port
+        # a fresh round publishes its own coordinator port entry
+        assert isinstance(res[(0, "r2")][1], int)
+        assert res[(0, "r2")][1] > 0
 
 
 def test_two_agents_cross_node_restart(tmp_path):

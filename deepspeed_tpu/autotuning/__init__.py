@@ -1,2 +1,3 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401
 from deepspeed_tpu.autotuning.cost_model import FirstOrderCostModel  # noqa: F401
+from deepspeed_tpu.autotuning.scheduler import ExperimentScheduler  # noqa: F401

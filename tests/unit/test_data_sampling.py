@@ -174,6 +174,54 @@ def test_mid_epoch_resume_exact_stream(tmp_path):
     assert first + rest == full
 
 
+def test_crash_recovery_resume_across_wrap(tmp_path):
+    """Checkpoint at step N, keep running PAST a cluster wrap (which
+    reshuffles and writes a new cluster order), then 'crash' and resume
+    from N in the SAME cluster dir: the resumed stream must replay the
+    original one exactly. Pre-versioning, the wrap overwrote the cluster
+    file in place, so the resume paired pre-wrap rng state with the
+    post-wrap array order and silently diverged (r4 advisor finding)."""
+    ds = SeqlenDataset(64)
+    prefix = _analyze(tmp_path, ds)
+    cfg = _cfg(tmp_path, prefix)
+    m = cfg["data_sampling"]["curriculum_learning"]["curriculum_metrics"]
+    m["seqlen"]["min_difficulty"] = 64     # one frozen cluster: wraps
+    m["seqlen"]["schedule_config"]["total_curriculum_step"] = 1   # early
+
+    s1 = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=8)
+    it1 = iter(s1)
+    pre = [next(it1) for _ in range(5)]          # mid-epoch
+    state = s1.state_dict()
+    import json
+    state = json.loads(json.dumps(state))
+    # run on past the wrap (64 samples / 8 per draw -> wrap inside)
+    post = [next(it1) for _ in range(10)]
+    assert max(s1.data_cluster_wraps) >= 1, "test must cross a wrap"
+
+    s2 = DeepSpeedDataSampler(cfg, len(ds), micro_batch_size=8)
+    s2.load_state_dict(state)
+    it2 = iter(s2)
+    replay = [next(it2) for _ in range(10)]
+    assert replay == post
+
+
+def test_percentile_range_small_dataset():
+    """Datasets smaller than max_percentile must still admit samples at
+    intermediate difficulties (r4 advisor finding: n//max == 0 made
+    every slice empty)."""
+    import numpy as np
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+        MetricIndex)
+    idx = MetricIndex.__new__(MetricIndex)
+    idx.sample_to_metric = np.arange(10)
+    idx.sorted_samples = np.arange(10)
+    idx.sorted_values = np.arange(10)
+    got = idx.samples_in_percentile_range(0, 50, 100)   # first half
+    assert len(got) == 5
+    # full range includes the tail
+    assert len(idx.samples_in_percentile_range(0, 100, 100)) == 10
+
+
 def test_curriculum_index_loader_collates(tmp_path):
     ds = SeqlenDataset(64)
     prefix = _analyze(tmp_path, ds)

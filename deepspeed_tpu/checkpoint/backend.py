@@ -20,14 +20,16 @@ return a writer object with ``wait()`` for async backends (the engine
 calls ``wait_checkpoint`` through it, same contract as the native
 async writer).
 
-Known seam limit: the training engine's AUXILIARY artifacts — host
-optimizer states under ZeRO-Offload (``host_optim_states.npz``) and
-the 16-bit consolidation file — still write as numpy files next to the
-backend's payload; a fully remote backend must handle (or disable)
-those paths.
+The engine routes EVERY checkpoint artifact through the backend: the
+main sharded state, the ZeRO-Offload host optimizer states
+(``save_aux``/``load_aux`` — streamed entry by entry, so the
+ZeRO-Infinity tier never materializes a model-sized dict), and the
+16-bit consolidation (``consolidate_16bit``). A remote backend
+overrides those three to own all bytes.
 """
 
 import abc
+import contextlib
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -58,6 +60,38 @@ class CheckpointEngine(abc.ABC):
         backends that cannot do better may load everything and slice."""
         raise NotImplementedError
 
+    def save_aux(self, path, name, entries):
+        """Persist an auxiliary artifact (ZeRO-Offload host optimizer
+        states). ``entries`` is an ITERATOR of (key, np.ndarray) —
+        consume it streaming; materializing it defeats the ZeRO-Infinity
+        RAM bound. Default: the native streamed-npz file, so existing
+        custom backends keep working; remote backends override."""
+        import os
+        from deepspeed_tpu.checkpoint.engine import _write_npz_streaming
+        _write_npz_streaming(os.path.join(path, name + ".npz"), entries)
+
+    @contextlib.contextmanager
+    def load_aux(self, path, name):
+        """Context manager yielding a lazy mapping of the artifact's
+        entries, or None when absent."""
+        import os
+        import numpy as np
+        full = os.path.join(path, name + ".npz")
+        if not os.path.exists(full):
+            yield None
+            return
+        with np.load(full) as d:    # lazy NpzFile: one entry at a time
+            yield d
+
+    def consolidate_16bit(self, path, out_name, dtype):
+        """Emit the gathered 16-bit weights artifact from the durable
+        checkpoint at ``path`` (reference
+        zero_gather_16bit_weights_on_model_save, engine.py:754).
+        Default: the native consolidate over the npz chunks."""
+        import os
+        from deepspeed_tpu.checkpoint.engine import consolidate
+        consolidate(path, os.path.join(path, out_name), dtype=dtype)
+
     def commit(self, tag):
         """Hook after the save of ``tag`` is durable (reference: the
         Nebula engine publishes the checkpoint here)."""
@@ -81,7 +115,6 @@ class NpzCheckpointEngine(CheckpointEngine):
     def load_subtree(self, path, target, prefix):
         from deepspeed_tpu.checkpoint.engine import load_subtree
         return load_subtree(path, target, prefix=prefix)
-
 
 def get_checkpoint_engine(section):
     """``checkpoint_engine`` config section -> backend instance."""

@@ -82,6 +82,12 @@ def _write_npz_streaming(path, chunk_iter):
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as z:
         for key, arr in chunk_iter:
             arr = np.ascontiguousarray(arr)
+            if arr.dtype.kind == "V" and arr.dtype.itemsize in (1, 2):
+                # ml_dtypes extension dtypes (bfloat16, fp8) have no
+                # portable npy descr: store the raw bits as a uint view;
+                # the reader re-views from the meta's recorded leaf dtype
+                arr = arr.view(np.uint16 if arr.dtype.itemsize == 2
+                               else np.uint8)
             if arr.ndim == 0:
                 # this numpy's NpzFile reads 0-d entries back as (1,);
                 # store scalars as (1,) on purpose and reshape at read
@@ -339,6 +345,32 @@ class _ChunkIndex:
     def read(self, fn, zkey):
         return self._files[fn][zkey]
 
+    def _saved_dtype(self, name):
+        """The dtype the leaf was saved with (None when meta-less)."""
+        info = (self.meta or {}).get("leaves", {}).get(name)
+        if info and "dtype" in info:
+            try:
+                import ml_dtypes
+                return np.dtype(getattr(ml_dtypes, info["dtype"],
+                                        info["dtype"]))
+            except TypeError:
+                return None
+        return None
+
+    def _decode_chunk(self, name, chunk):
+        """Undo the uint-bits storage of ml_dtypes leaves (see
+        _write_npz_streaming): re-view from the meta's recorded dtype;
+        meta-less V2 entries (pre-fix checkpoints) best-effort as bf16."""
+        saved = self._saved_dtype(name)
+        if saved is not None and saved.kind == "V" and \
+                chunk.dtype.kind in "ui" and \
+                chunk.dtype.itemsize == saved.itemsize:
+            return chunk.view(saved)
+        if chunk.dtype.kind == "V" and chunk.dtype.itemsize == 2:
+            import ml_dtypes
+            return chunk.view(ml_dtypes.bfloat16)
+        return chunk
+
     def assemble(self, name, out_index, shape, dtype):
         """Build the sub-array `out_index` (tuple of concrete slices) of
         leaf `name` from whatever chunk rectangles overlap it."""
@@ -356,7 +388,7 @@ class _ChunkIndex:
                 inter.append((lo, hi))
             if inter is None and len(out_index) > 0:
                 continue
-            chunk = self.read(fn, zkey)
+            chunk = self._decode_chunk(name, self.read(fn, zkey))
             if not out_index:  # scalar (stored as (1,), see writer)
                 return chunk.reshape(()).astype(dtype)
             dst = tuple(slice(lo - o.start, hi - o.start)

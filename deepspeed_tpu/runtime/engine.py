@@ -137,6 +137,8 @@ class DeepSpeedEngine:
             self._offload_cfg = DeepSpeedZeroOffloadOptimizerConfig(
                 device=_dev(_pc), nvme_path=_pc.nvme_path)
         self._offload = None
+        self._params_nvme = False   # set by _ensure_initialized when
+        # offload_param.device == "nvme" (ZeRO-Infinity param tier)
         if self._offload_cfg is not None:
             # single worker = FIFO grad accumulation off the main thread
             from concurrent.futures import ThreadPoolExecutor
@@ -478,33 +480,79 @@ class DeepSpeedEngine:
             # ZeRO-Offload: pull the fp32 master to host, keep only the
             # compute-dtype copy on the chip, moments live host/NVMe.
             from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            _pc = self._config.zero_config.offload_param
+            self._params_nvme = bool(
+                self._offload_param and _pc is not None and
+                str(getattr(_pc, "device", "none")) == "nvme")
+            param_nvme_path = None
+            if self._params_nvme:
+                param_nvme_path = _pc.nvme_path or \
+                    getattr(self._offload_cfg, "nvme_path", None)
+                assert param_nvme_path, \
+                    "offload_param.device=nvme needs offload_param." \
+                    "nvme_path (or offload_optimizer.nvme_path)"
             self._offload = HostOffloadOptimizer(
                 self.optimizer_name, self._config.optimizer.params,
                 gradient_clipping=self._config.gradient_clipping,
                 fp16_cfg=self._config.fp16, fp16_enabled=self.fp16_enabled,
                 offload_cfg=self._offload_cfg,
-                aio_config=self._config.aio_config)
+                aio_config=self._config.aio_config,
+                param_nvme_path=param_nvme_path,
+                param_dtype={jnp.bfloat16: "bf16",
+                             jnp.float16: "f16"}.get(self.compute_dtype,
+                                                     "f32"))
             from deepspeed_tpu.checkpoint.engine import param_leaf_names
-            host_leaves = [np.asarray(jax.device_get(l))
-                           for l in jax.tree.leaves(params)]
             leaf_names = param_leaf_names(params)
-            self._offload.init_master(host_leaves, names=leaf_names)
             # sparse embedding grads (reference sparse_gradients +
             # SparseTensor, engine.py:2303): embedding-table leaves ship
             # their grads D2H as (touched-row indices, rows) instead of
-            # the dense [vocab, d] table
+            # the dense [vocab, d] table. Decided from names + shapes of
+            # the (still-device) tree — host_leaves may be a one-shot
+            # generator below.
             self._sparse_positions = frozenset(
-                i for i, (n, l) in enumerate(zip(leaf_names, host_leaves))
+                i for i, (n, l) in enumerate(
+                    zip(leaf_names, jax.tree.leaves(params)))
                 if self._config.sparse_gradients_enabled and l.ndim == 2
                 and any(t in n.lower()
                         for t in ("wte", "wpe", "embed"))) or None
+            if self._params_nvme:
+                # one leaf in RAM at a time: each master streams to NVMe
+                # before the next device_get lands
+                host_leaves = (np.asarray(jax.device_get(l))
+                               for l in jax.tree.leaves(params))
+            else:
+                host_leaves = [np.asarray(jax.device_get(l))
+                               for l in jax.tree.leaves(params)]
+            self._offload.init_master(host_leaves, names=leaf_names)
             compute_dtype = self.compute_dtype
-            cast_fn = jax.jit(
-                lambda p: jax.tree.map(
-                    lambda x: x.astype(compute_dtype), p),
-                out_shardings=param_sh, donate_argnums=(0,))
-            params = cast_fn(params)
-            if self._offload_param:
+            if self._params_nvme:
+                # ZeRO-Infinity param tier: the device/pinned copies are
+                # dropped entirely — state.params becomes the tier's
+                # memmap views over the NVMe files (written in compute
+                # dtype by init_master; no on-device cast needed). Each
+                # dispatch device_puts them to the (device-kind)
+                # shardings, so pages stream NVMe -> page cache -> HBM
+                # on demand and the buffers die with the dispatch; the
+                # optimizer sweep rewrites the files through the SAME
+                # page cache, so the next dispatch reads the updated
+                # bytes. RAM holds the evictable page cache, never a
+                # pinned full copy.
+                treedef = jax.tree.structure(params)
+                del params
+                params = jax.tree_util.tree_unflatten(
+                    treedef, self._offload.param_tier.param_memmaps())
+                self._param_mat_sh = param_sh
+                self._injit_materialize = False
+                log_dist("ZeRO-Infinity: at-rest params on NVMe "
+                         f"({self._offload.param_tier.dir}); per-dispatch "
+                         "page-cached streaming", ranks=[0])
+            else:
+                cast_fn = jax.jit(
+                    lambda p: jax.tree.map(
+                        lambda x: x.astype(compute_dtype), p),
+                    out_shardings=param_sh, donate_argnums=(0,))
+                params = cast_fn(params)
+            if not self._params_nvme and self._offload_param:
                 # at-rest compute copy in pinned host memory; the jitted
                 # step streams leaves to HBM per use (same mechanism the
                 # inference engine proves for ZeRO-Inference,
@@ -557,7 +605,18 @@ class DeepSpeedEngine:
         rep = NamedSharding(mesh, P())
         self._state_sh = jax.tree.map(lambda _: rep, self.state).replace(
             params=param_sh, opt_state=opt_sh)
-        self.state = jax.tree.map(jax.device_put, self.state, self._state_sh)
+        if getattr(self, "_params_nvme", False):
+            # the memmap leaves must NOT be committed to devices here:
+            # they stream per dispatch (a device_put now would pin the
+            # full model in HBM for the run)
+            mm_params = self.state.params
+            scalars = jax.tree.map(
+                jax.device_put, self.state.replace(params=()),
+                self._state_sh.replace(params=()))
+            self.state = scalars.replace(params=mm_params)
+        else:
+            self.state = jax.tree.map(jax.device_put, self.state,
+                                      self._state_sh)
         if self._compressed_axis:
             # per-worker error-feedback buffers for the compressed
             # collective (reference worker_error/server_error,
@@ -1443,6 +1502,15 @@ class DeepSpeedEngine:
         if self._offload is None:
             return {}
         st = self._offload.pop_phase_stats()
+        if self._offload.param_tier is not None:
+            tier = self._offload.param_tier.pop_stats()
+            st.update({f"param_tier_{k}": v for k, v in tier.items()})
+            adam = st.get("host_adam_s", 0.0)
+            # share of the NVMe leaf-state reads hidden behind the
+            # previous leaf's Adam update (prefetch-next-leaf pipeline)
+            st["nvme_prefetch_overlap"] = round(
+                max(1.0 - tier["nvme_wait_s"] / adam, 0.0), 4) \
+                if adam else None
         d2h = st.get("d2h_accum_s", 0.0)
         stall = st.get("join_stall_s", 0.0)
         st["overlap_fraction"] = round(max(1.0 - stall / d2h, 0.0), 4) \
@@ -1459,26 +1527,37 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).start()
         self._join_offload()
         lr = float(self.get_lr()[0])
-        emit_bf16 = self.compute_dtype == jnp.bfloat16
-        if emit_bf16:
-            import ml_dtypes
-
-            def put_leaf(i, flat_u16):
-                return jax.device_put(flat_u16.view(ml_dtypes.bfloat16),
-                                      self._param_sh_flat[i])
-            put, metrics = self._offload.step(lr, on_leaf=put_leaf)
+        if self._params_nvme:
+            # ZeRO-Infinity param tier: the sweep rewrites the NVMe
+            # files in place; state.params (memmap views) read the new
+            # bytes at the next dispatch — nothing to emit or rebuild
+            _, metrics = self._offload.step(lr)
+            self.state = self.state.replace(
+                step=self.state.step + 1,
+                skipped_steps=jnp.int32(self._offload.skipped_steps))
         else:
-            dt = np.dtype(self.compute_dtype)
+            emit_bf16 = self.compute_dtype == jnp.bfloat16
+            if emit_bf16:
+                import ml_dtypes
 
-            def put_leaf(i, _leaf):
-                arr = self._offload.master[i].reshape(
-                    self._offload.shapes[i]).astype(dt)
-                return jax.device_put(arr, self._param_sh_flat[i])
-            put, metrics = self._offload.step(lr, on_leaf=put_leaf)
-        new_params = jax.tree_util.tree_unflatten(self._param_treedef, put)
-        self.state = self.state.replace(
-            params=new_params, step=self.state.step + 1,
-            skipped_steps=jnp.int32(self._offload.skipped_steps))
+                def put_leaf(i, flat_u16):
+                    return jax.device_put(
+                        flat_u16.view(ml_dtypes.bfloat16),
+                        self._param_sh_flat[i])
+                put, metrics = self._offload.step(lr, on_leaf=put_leaf)
+            else:
+                dt = np.dtype(self.compute_dtype)
+
+                def put_leaf(i, _leaf):
+                    arr = self._offload.master[i].reshape(
+                        self._offload.shapes[i]).astype(dt)
+                    return jax.device_put(arr, self._param_sh_flat[i])
+                put, metrics = self._offload.step(lr, on_leaf=put_leaf)
+            new_params = jax.tree_util.tree_unflatten(self._param_treedef,
+                                                      put)
+            self.state = self.state.replace(
+                params=new_params, step=self.state.step + 1,
+                skipped_steps=jnp.int32(self._offload.skipped_steps))
         self.global_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
@@ -1500,7 +1579,14 @@ class DeepSpeedEngine:
         single-dispatch step runs instead of gas separate dispatches
         (identical math: same fp32 accumulation and boundary apply).
         ``sync=False`` returns the loss as a device scalar without
-        blocking on the transfer."""
+        blocking on the transfer.
+
+        NOTE: the fused window DONATES the previous params buffers (they
+        alias the new tree in place). A reference obtained via
+        ``engine.get_params()`` / ``engine.state.params`` BEFORE the call
+        is dead afterwards — re-read it from ``engine.state`` after the
+        window (the per-micro forward()/backward()/step() path does not
+        donate params and has no such hazard)."""
         assert data_iter is not None or batches is not None or \
             self.training_dataloader is not None
         if data_iter is None and batches is None:
@@ -1770,6 +1856,14 @@ class DeepSpeedEngine:
         checkpoint_engine.py:9): ``checkpoint_engine.type`` in the
         config swaps the native npz format for a custom engine."""
         assert self.state is not None, "nothing to save before first forward"
+        if async_save and self._params_nvme:
+            # state.params are live memmap views over the tier's NVMe
+            # files; a background writer racing the next step's in-place
+            # file rewrite would snapshot a torn mix of two steps
+            logger.warning("async_save is unavailable with the NVMe "
+                           "param tier (params are live file views); "
+                           "saving synchronously")
+            async_save = False
         tag = tag or f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag))
         client = dict(client_state or {})
@@ -1786,30 +1880,32 @@ class DeepSpeedEngine:
         })
         self.wait_checkpoint()
 
-        host_optim = None
         if self._offload is not None:
             self._join_offload()   # grads in flight mutate the snapshot
             # fp32 master + moments live host-side (reference per-rank
-            # *_optim_states.pt). Snapshot now — the offload optimizer
-            # mutates these buffers in place on the next step — and write
-            # inside the (possibly async) job, before `latest` flips.
-            host_optim = {k: np.array(v, copy=True)
-                          for k, v in self._offload.state_dict().items()}
+            # *_optim_states.pt). Written NOW, synchronously, THROUGH
+            # the backend (the pluggable-engine seam — a Nebula-style
+            # backend must see every artifact): the offload optimizer
+            # mutates its buffers in place on the next step, and the
+            # entry stream reads one leaf at a time, so the
+            # ZeRO-Infinity tier never materializes a model-sized dict.
+            if jax.process_index() == 0:
+                os.makedirs(path, exist_ok=True)
+                self.checkpoint_engine.save_aux(
+                    path, "host_optim_states",
+                    self._offload.iter_state_entries())
 
         def finalize():
             # save_state runs on_done on PROCESS 0 ONLY, after the
             # durability barrier — single writer for everything below
-            if host_optim is not None:
-                np.savez(os.path.join(path, "host_optim_states.npz"),
-                         **host_optim)
             if self._config.zero_config \
                     .stage3_gather_16bit_weights_on_model_save:
                 # reference engine.py:754: emit one unpartitioned 16-bit
                 # weights file next to the sharded checkpoint (shard files
-                # are durable here — finalize runs after the barrier)
-                from deepspeed_tpu.checkpoint.engine import consolidate
-                consolidate(path, os.path.join(path, "weights_16bit.npz"),
-                            dtype=np.float16)
+                # are durable here — finalize runs after the barrier);
+                # routed through the backend so a remote engine owns it
+                self.checkpoint_engine.consolidate_16bit(
+                    path, "weights_16bit.npz", dtype=np.float16)
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as f:
                     f.write(str(tag))
@@ -1848,18 +1944,39 @@ class DeepSpeedEngine:
             self._ensure_initialized(batch)
         self.state, client = self.checkpoint_engine.load(
             path, self.state, mesh=self.mesh)
-        host_opt = os.path.join(path, "host_optim_states.npz")
-        if self._offload is not None and os.path.exists(host_opt):
-            if load_optimizer_states:
-                with np.load(host_opt) as d:
-                    self._offload.load_state_dict(dict(d))
-            else:
+        have_host_opt = False
+        if self._offload is not None:
+            with self.checkpoint_engine.load_aux(
+                    path, "host_optim_states") as d:
+                have_host_opt = d is not None
+                if d is not None and load_optimizer_states:
+                    # lazy mapping: load_state_dict pulls one entry at
+                    # a time (the tier streams each straight to NVMe)
+                    self._offload.load_state_dict(d)
+            if have_host_opt and not load_optimizer_states:
                 # params are authoritative: refresh the master from them
                 from deepspeed_tpu.checkpoint.engine import param_leaf_names
                 self._offload.init_master(
-                    [np.asarray(jax.device_get(l))
-                     for l in jax.tree.leaves(self.state.params)],
+                    (np.asarray(jax.device_get(l))
+                     for l in jax.tree.leaves(self.state.params)),
                     names=param_leaf_names(self.state.params))
+        if self._params_nvme:
+            if not have_host_opt:
+                # checkpoint without host optimizer state: the restored
+                # params are authoritative — rebuild the tier from them
+                from deepspeed_tpu.checkpoint.engine import \
+                    param_leaf_names
+                self._offload.init_master(
+                    (np.asarray(l)
+                     for l in jax.tree.leaves(self.state.params)),
+                    names=param_leaf_names(self.state.params))
+            # the restore materialized plain arrays; re-point
+            # state.params at the tier's (just-refreshed) memmap views
+            # so dispatches stream from NVMe again
+            self.state = self.state.replace(
+                params=jax.tree_util.tree_unflatten(
+                    self._param_treedef,
+                    self._offload.param_tier.param_memmaps()))
         self.global_steps = client.get("global_steps", 0)
         self.micro_steps = client.get("micro_steps", 0)
         self.global_samples = client.get("global_samples", 0)

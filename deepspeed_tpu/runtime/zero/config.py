@@ -25,10 +25,15 @@ class OffloadDeviceEnum(str, Enum):
 
 
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
-    """ZeRO-3 parameter offload (reference offload_config.py). On TPU the
-    at-rest compute copy lives in pinned host memory and streams to HBM
-    inside the jitted step; `device: nvme` additionally keeps the fp32
-    master + moments on NVMe (via the host optimizer tier)."""
+    """ZeRO-3 parameter offload (reference offload_config.py). On TPU,
+    `device: cpu` keeps the at-rest compute copy in pinned host memory,
+    streamed to HBM inside the jitted step. `device: nvme` is the
+    ZeRO-Infinity parameter tier (reference
+    swap_tensor/partitioned_param_swapper.py): fp32 master, gradient
+    accumulators AND the at-rest compute copy live in per-leaf NVMe
+    files; dispatches stream params NVMe->HBM through the page cache and
+    the optimizer sweep double-buffers leaf state through the aio
+    handles — host RAM never holds a full model-sized buffer."""
     device: OffloadDeviceEnum = "none"
     nvme_path: Optional[str] = None
     buffer_count: int = Field(5, ge=0)

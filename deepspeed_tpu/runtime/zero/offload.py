@@ -95,7 +95,7 @@ class NvmeMomentStore:
     reference's pipeline_read/pipeline_write behavior
     (swap_tensor/optimizer_utils.py)."""
 
-    def __init__(self, nvme_path, sizes, aio_config=None):
+    def __init__(self, nvme_path, sizes, aio_config=None, fresh=True):
         from deepspeed_tpu.ops.aio import AioHandle
         self.dir = os.path.join(nvme_path, "zero_offload_moments")
         os.makedirs(self.dir, exist_ok=True)
@@ -110,7 +110,12 @@ class NvmeMomentStore:
         for i, n in enumerate(sizes):
             for tag in ("m", "v"):
                 path = self._path(i, tag)
-                if not os.path.exists(path):
+                # fresh (the default, matching a newly-constructed
+                # optimizer): ALWAYS zero-fill — a reused nvme_path must
+                # not warm-start Adam from a previous run's moments;
+                # resume goes through load_state_dict, which rewrites
+                # these files anyway
+                if fresh or not os.path.exists(path):
                     np.zeros(n, np.float32).tofile(path)
 
     def _path(self, i, tag):
@@ -134,12 +139,200 @@ class NvmeMomentStore:
         self.write_handle.wait()
 
 
+class NvmeParamTier:
+    """ZeRO-Infinity parameter tier: fp32 master params, fp32 gradient
+    accumulators AND the at-rest compute-dtype copy live in per-leaf
+    NVMe files (reference ``swap_tensor/partitioned_param_swapper.py``
+    semantics: the steady-state working set in RAM is a couple of leaf
+    buffers, not the model).
+
+    Layout: ``<nvme_path>/zero_param_tier/leaf{i}_{master|acc|param}.bin``.
+    The param (compute-copy) files are written with page-cached pwrites so
+    the engine's ``np.memmap`` views — the H2D source at dispatch time —
+    stay coherent; master/acc IO goes through the aio handle pair with
+    prefetch-next-leaf double buffering.
+
+    Gradient accumulation is a read-modify-write per (leaf, micro batch);
+    the first accumulate after a consumed window overwrites instead
+    (``_acc_valid``), so no zero-fill pass is needed. Each RMW also
+    refreshes the leaf's grad-norm/overflow stats, so the optimizer sweep
+    needs no extra pre-pass over the accumulators."""
+
+    def __init__(self, nvme_path, aio_config=None, param_dtype="bf16"):
+        from deepspeed_tpu.ops.aio import AioHandle
+        self.dir = os.path.join(nvme_path, "zero_param_tier")
+        os.makedirs(self.dir, exist_ok=True)
+        kw = {}
+        if aio_config is not None:
+            kw = dict(block_size=aio_config.block_size,
+                      queue_depth=aio_config.queue_depth,
+                      thread_count=aio_config.thread_count)
+        self.read_handle = AioHandle(**kw)
+        self.write_handle = AioHandle(**kw)
+        self.param_dtype = param_dtype          # "bf16" | "f32"
+        self.sizes = []
+        self.shapes = []
+        self._acc_valid = []
+        self._norm_sq = []
+        self._inf = []
+        self.stats = {"nvme_read_bytes": 0, "nvme_write_bytes": 0,
+                      "nvme_wait_s": 0.0}
+        self.peak_buffer_bytes = 0
+        self._live_bytes = 0
+
+    def _p(self, i, tag):
+        return os.path.join(self.dir, f"leaf{i}_{tag}.bin")
+
+    def _track(self, *bufs):
+        self._live_bytes += sum(b.nbytes for b in bufs)
+        self.peak_buffer_bytes = max(self.peak_buffer_bytes,
+                                     self._live_bytes)
+
+    def _untrack(self, *bufs):
+        self._live_bytes -= sum(b.nbytes for b in bufs)
+
+    # ------------------------------------------------------------- init
+    def add_leaf(self, master_f32_flat, shape):
+        """Persist one leaf's master + compute copy; returns its index."""
+        i = len(self.sizes)
+        self.sizes.append(master_f32_flat.size)
+        self.shapes.append(tuple(shape))
+        master_f32_flat.tofile(self._p(i, "master"))
+        self._write_param_file(i, master_f32_flat)
+        self._acc_valid.append(False)
+        self._norm_sq.append(0.0)
+        self._inf.append(False)
+        return i
+
+    def _write_param_file(self, i, master_f32_flat):
+        if self.param_dtype == "bf16":
+            from deepspeed_tpu.ops.adam.cpu_adam import f32_to_bf16
+            buf = f32_to_bf16(master_f32_flat).view(np.uint16)
+        elif self.param_dtype == "f16":
+            buf = master_f32_flat.astype(np.float16)
+        else:
+            buf = master_f32_flat
+        # Page-cached write (no O_DIRECT) keeps the engine's memmap
+        # views of the param files coherent. In-place r+b (never "wb"):
+        # a truncate would yank pages out from under the live mappings
+        # — a concurrent reader (async checkpoint writer faulting a
+        # page) would SIGBUS past the shrunken EOF.
+        path = self._p(i, "param")
+        mode = "r+b" if os.path.exists(path) else "wb"
+        with open(path, mode) as f:
+            f.write(np.ascontiguousarray(buf).tobytes())
+        self.stats["nvme_write_bytes"] += buf.nbytes
+
+    def param_memmaps(self):
+        """The at-rest compute copies as memory-mapped views (mode r+ =
+        MAP_SHARED, so post-step pwrites show through). The engine hands
+        these straight to jax.device_put: pages stream file->H2D on
+        demand and the page cache — not the process — holds what fits."""
+        import ml_dtypes
+        out = []
+        for i, (n, shape) in enumerate(zip(self.sizes, self.shapes)):
+            if self.param_dtype == "bf16":
+                mm = np.memmap(self._p(i, "param"), np.uint16, "r+",
+                               shape=(n,))
+                out.append(mm.view(ml_dtypes.bfloat16).reshape(shape))
+            elif self.param_dtype == "f16":
+                out.append(np.memmap(self._p(i, "param"), np.float16,
+                                     "r+", shape=shape))
+            else:
+                out.append(np.memmap(self._p(i, "param"), np.float32,
+                                     "r+", shape=shape))
+        return out
+
+    # ------------------------------------------------------ accumulation
+    def accumulate(self, i, grad):
+        """RMW one leaf's fp32 accumulator on NVMe. ``grad`` is a dense
+        array (any float dtype) or a sparse ``(indices, values)`` pair."""
+        n = self.sizes[i]
+        if self._acc_valid[i]:
+            acc = np.empty(n, np.float32)
+            self._track(acc)
+            self.read_handle.async_pread(acc, self._p(i, "acc"))
+            self.read_handle.wait()
+            self.stats["nvme_read_bytes"] += acc.nbytes
+        else:
+            acc = np.zeros(n, np.float32)
+            self._track(acc)
+        if isinstance(grad, tuple):
+            idx, vals = grad
+            np.add.at(acc.reshape(self.shapes[i]), np.asarray(idx),
+                      _to_f32(np.asarray(vals)))
+        else:
+            axpy(acc, _to_f32(grad).reshape(-1))
+        self._norm_sq[i] = l2_norm_sq(acc)
+        self._inf[i] = bool(has_inf_nan(acc))
+        self.write_handle.async_pwrite(acc, self._p(i, "acc"))
+        self.write_handle.wait()
+        self.stats["nvme_write_bytes"] += acc.nbytes
+        self._untrack(acc)
+        self._acc_valid[i] = True
+
+    def grad_stats(self):
+        """(sum of squared norms, any-overflow) over valid accumulators."""
+        return sum(self._norm_sq), any(self._inf)
+
+    # -------------------------------------------------------- step sweep
+    def prefetch(self, i):
+        """Submit async reads of leaf i's (master, acc); pair with
+        :meth:`wait_fetched`."""
+        bufs = (np.empty(self.sizes[i], np.float32),
+                np.empty(self.sizes[i], np.float32))
+        self._track(*bufs)
+        self.read_handle.async_pread(bufs[0], self._p(i, "master"))
+        self.read_handle.async_pread(bufs[1], self._p(i, "acc"))
+        self.stats["nvme_read_bytes"] += 2 * bufs[0].nbytes
+        return bufs
+
+    def wait_fetched(self):
+        import time as _t
+        t0 = _t.perf_counter()
+        self.read_handle.wait()
+        self.stats["nvme_wait_s"] += _t.perf_counter() - t0
+
+    def writeback(self, i, master):
+        """Persist leaf i's updated master + compute copy; marks the
+        accumulator consumed."""
+        self.write_handle.async_pwrite(master, self._p(i, "master"))
+        self.stats["nvme_write_bytes"] += master.nbytes
+        self._write_param_file(i, master)
+        self._acc_valid[i] = False
+
+    def read_master(self, i):
+        buf = np.empty(self.sizes[i], np.float32)
+        self.read_handle.async_pread(buf, self._p(i, "master"))
+        self.read_handle.wait()
+        return buf
+
+    def write_master(self, i, master_f32_flat):
+        np.ascontiguousarray(master_f32_flat, np.float32).tofile(
+            self._p(i, "master"))
+        self._write_param_file(i, master_f32_flat)
+
+    def flush(self):
+        self.write_handle.wait()
+
+    def release(self, *bufs):
+        self._untrack(*bufs)
+
+    def pop_stats(self):
+        out = dict(self.stats,
+                   peak_buffer_bytes=self.peak_buffer_bytes)
+        for k in self.stats:
+            self.stats[k] = 0.0 if isinstance(self.stats[k], float) else 0
+        self.peak_buffer_bytes = self._live_bytes
+        return out
+
+
 class HostOffloadOptimizer:
     """Flat-per-leaf host optimizer driving the ZeRO-Offload step."""
 
     def __init__(self, opt_name, opt_params, *, gradient_clipping=0.0,
                  fp16_cfg=None, fp16_enabled=False, offload_cfg=None,
-                 aio_config=None):
+                 aio_config=None, param_nvme_path=None, param_dtype="bf16"):
         p = dict(opt_params or {})
         name = (opt_name or "adamw").lower()
         self.opt = DeepSpeedCPUAdam(
@@ -152,6 +345,11 @@ class HostOffloadOptimizer:
         self.device = getattr(offload_cfg, "device", "cpu")
         self.nvme_path = getattr(offload_cfg, "nvme_path", None)
         self.aio_config = aio_config
+        # ZeRO-Infinity parameter tier (offload_param.device == "nvme"):
+        # masters + accumulators + at-rest compute copies on NVMe
+        self.param_tier = None
+        self._param_nvme_path = param_nvme_path
+        self._param_dtype = param_dtype
         self.master = None       # list of flat fp32 arrays
         self.names = None        # checkpoint leaf names, tree order
         self.moments = None      # list of (m, v) or None when on NVMe
@@ -172,14 +370,39 @@ class HostOffloadOptimizer:
         are the checkpoint leaf names in the same order — persisted with
         the state so consolidation pairs master buffers by name, never by
         enumeration order."""
-        self.master = [_to_f32(a).reshape(-1).copy() for a in host_leaves]
-        self.shapes = [a.shape for a in host_leaves]
         self.names = list(names) if names is not None else None
-        sizes = [m.size for m in self.master]
-        if str(self.device) == "nvme":
-            assert self.nvme_path, "offload_optimizer.nvme_path required"
-            self.nvme = NvmeMomentStore(self.nvme_path, sizes,
-                                        self.aio_config)
+        if self._param_nvme_path:
+            # parameter tier: ``host_leaves`` may be a GENERATOR (the
+            # engine device_gets one leaf at a time) — each master is
+            # persisted and freed before the next leaf lands, so init
+            # RAM is one leaf, not the model
+            self.param_tier = NvmeParamTier(self._param_nvme_path,
+                                            self.aio_config,
+                                            self._param_dtype)
+            sizes, shapes = [], []
+            for a in host_leaves:
+                flat = _to_f32(a).reshape(-1)
+                self.param_tier.add_leaf(flat, a.shape)
+                sizes.append(flat.size)
+                shapes.append(a.shape)
+            self.master = None
+            self.shapes = shapes
+            logger.info(
+                f"ZeRO-Infinity param tier: {len(sizes)} leaves "
+                f"({sum(sizes) * 4 / 1e9:.2f} GB master + "
+                f"{sum(sizes) * 4 / 1e9:.2f} GB accum + compute copies) "
+                f"on NVMe at {self.param_tier.dir}")
+        else:
+            self.master, self.shapes = [], []
+            for a in host_leaves:
+                self.master.append(_to_f32(a).reshape(-1).copy())
+                self.shapes.append(a.shape)
+            sizes = [m.size for m in self.master]
+        self.sizes = sizes
+        if str(self.device) == "nvme" or self.param_tier is not None:
+            path = self.nvme_path or self._param_nvme_path
+            assert path, "offload_optimizer.nvme_path required"
+            self.nvme = NvmeMomentStore(path, sizes, self.aio_config)
             logger.info(f"ZeRO-Infinity: {len(sizes)} moment pairs "
                         f"({2 * sum(sizes) * 4 / 1e9:.2f} GB) on NVMe at "
                         f"{self.nvme.dir}")
@@ -193,6 +416,11 @@ class HostOffloadOptimizer:
         (the engine's sparse_gradients embedding path — reference
         SparseTensor + engine.py:2303): sparse pairs scatter-add into
         the accumulator, so only touched rows crossed the link."""
+        if self.param_tier is not None:
+            for i, g in enumerate(host_grad_leaves):
+                self.param_tier.accumulate(i, g)
+            self.acc = "nvme"      # sentinel: a window is pending
+            return
         if self.acc is None:
             self.acc = [np.zeros(m.size, np.float32) for m in self.master]
         for a, g, shape in zip(self.acc, host_grad_leaves, self.shapes):
@@ -215,6 +443,8 @@ class HostOffloadOptimizer:
         reference overlaps its CPU step with copy streams,
         stage_1_and_2.py:1031)."""
         assert self.acc is not None, "no grads accumulated"
+        if self.param_tier is not None:
+            return self._step_param_tier(lr, on_leaf)
         scale = self.scaler.loss_scale
         overflow = any(has_inf_nan(a) for a in self.acc)
         self.scaler.update(overflow)
@@ -276,6 +506,59 @@ class HostOffloadOptimizer:
             - (self.phase["h2d_emit_s"] - _emit0))
         return leaves, self._metrics(gnorm, overflow)
 
+    def _step_param_tier(self, lr, on_leaf=None):
+        """Optimizer sweep with EVERYTHING on NVMe: per leaf, the
+        (master, accumulator) pair and the Adam moments stream in with
+        prefetch-next-leaf double buffering, the host kernel updates,
+        and master + moments + the compute copy stream back out. RAM
+        holds at most two leaves' buffers (tracked in
+        ``param_tier.peak_buffer_bytes``). ``on_leaf`` is ignored — the
+        engine's next dispatch re-reads the updated compute copies via
+        its memmap views, so nothing is emitted."""
+        import time as _time
+        scale = self.scaler.loss_scale
+        gnorm_sq, overflow = self.param_tier.grad_stats()
+        self.scaler.update(overflow)
+        gnorm = (gnorm_sq ** 0.5) / scale
+        clip_coef = 1.0
+        if self.clip > 0.0 and gnorm > self.clip:
+            clip_coef = self.clip / (gnorm + 1e-6)
+        if overflow:
+            self.skipped_steps += 1
+            # accumulators are consumed (next window overwrites); files
+            # unchanged, so the at-rest copies already hold the params
+            self.param_tier._acc_valid = [False] * len(self.sizes)
+            self.acc = None
+            return [], self._metrics(gnorm, overflow)
+
+        self.step_count += 1
+        t0 = _time.perf_counter()
+        n = len(self.sizes)
+        tier = self.param_tier
+        next_state = tier.prefetch(0)
+        next_moments = self.nvme.prefetch(0)
+        for i in range(n):
+            tier.wait_fetched()
+            self.nvme.fetch_wait()
+            master, acc = next_state
+            m, v = next_moments
+            if i + 1 < n:
+                next_state = tier.prefetch(i + 1)
+                next_moments = self.nvme.prefetch(i + 1)
+            self.opt.step_flat(master, m, v, acc, lr=lr,
+                               grad_scale=scale, clip_coef=clip_coef,
+                               step=self.step_count)
+            tier.flush()            # bound in-flight writes (double buf)
+            self.nvme.flush()
+            tier.writeback(i, master)
+            self.nvme.writeback(i, m, v)
+            tier.release(master, acc)
+        tier.flush()
+        self.nvme.flush()
+        self.acc = None
+        self.phase["host_adam_s"] += _time.perf_counter() - t0
+        return [], self._metrics(gnorm, overflow)
+
     def pop_phase_stats(self):
         """Per-phase wall times since the last call (the bench embeds
         these; engine adds the D2H/accumulate worker and join-stall
@@ -290,31 +573,46 @@ class HostOffloadOptimizer:
                 "loss_scale": self.scaler.loss_scale}
 
     # ------------------------------------------------------- checkpoint
-    def state_dict(self):
-        d = {"step_count": self.step_count,
-             "skipped_steps": self.skipped_steps,
-             "loss_scale": self.scaler.loss_scale}
+    def iter_state_entries(self):
+        """Stream the checkpoint entries one array at a time (the
+        ZeRO-Infinity tier must never hold a model-sized dict: masters
+        and moments read back from NVMe per leaf). Keys match
+        state_dict()'s, so either form round-trips through
+        load_state_dict."""
+        yield "step_count", np.asarray(self.step_count)
+        yield "skipped_steps", np.asarray(self.skipped_steps)
+        yield "loss_scale", np.asarray(self.scaler.loss_scale)
         if self.names is not None:
-            d["leaf_names"] = np.array(self.names)
-        for i, mstr in enumerate(self.master):
-            d[f"master_{i}"] = mstr
+            yield "leaf_names", np.array(self.names)
+        for i in range(len(self.sizes)):
+            yield f"master_{i}", (
+                self.param_tier.read_master(i)
+                if self.param_tier is not None else self.master[i])
             if self.moments is not None:
-                d[f"m_{i}"], d[f"v_{i}"] = self.moments[i]
+                m, v = self.moments[i]
             else:
-                bufs = self.nvme.prefetch(i)
+                m, v = self.nvme.prefetch(i)
                 self.nvme.fetch_wait()
-                d[f"m_{i}"], d[f"v_{i}"] = bufs
-        return d
+            yield f"m_{i}", m
+            yield f"v_{i}", v
+
+    def state_dict(self):
+        """Materialized form of :meth:`iter_state_entries` (tests and the
+        RAM-mode snapshot path; the tier streams instead)."""
+        return dict(self.iter_state_entries())
 
     def load_state_dict(self, d):
-        self.step_count = int(d["step_count"])
-        self.skipped_steps = int(d["skipped_steps"])
-        self.scaler.loss_scale = float(d["loss_scale"])
+        def scalar(key):   # scalars round-trip as (1,) (npz writer)
+            return np.asarray(d[key]).reshape(-1)[0]
+        self.step_count = int(scalar("step_count"))
+        self.skipped_steps = int(scalar("skipped_steps"))
+        self.scaler.loss_scale = float(scalar("loss_scale"))
         # pair saved master_{j}/m_{j}/v_{j} entries with live leaves by
         # *name* when both sides recorded names; positional pairing would
         # silently swap optimizer state if the model's flatten order
         # changed between save and load
-        index_of = {i: i for i in range(len(self.master))}
+        n_leaves = len(self.sizes)
+        index_of = {i: i for i in range(n_leaves)}
         if "leaf_names" in d and self.names is not None:
             saved = [str(s) for s in d["leaf_names"]]
             pos = {n: j for j, n in enumerate(saved)}
@@ -324,14 +622,18 @@ class HostOffloadOptimizer:
                     f"offload state missing master entries for leaves "
                     f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
             index_of = {i: pos[n] for i, n in enumerate(self.names)}
-        for i in range(len(self.master)):
+        for i in range(n_leaves):
             j = index_of[i]
-            if d[f"master_{j}"].size != self.master[i].size:
+            if d[f"master_{j}"].size != self.sizes[i]:
                 raise ValueError(
                     f"offload master_{j} has {d[f'master_{j}'].size} "
-                    f"elements but live leaf {i} has "
-                    f"{self.master[i].size}")
-            self.master[i][:] = d[f"master_{j}"]
+                    f"elements but live leaf {i} has {self.sizes[i]}")
+            if self.param_tier is not None:
+                # refreshes the at-rest compute copy too
+                self.param_tier.write_master(
+                    i, np.asarray(d[f"master_{j}"], np.float32))
+            else:
+                self.master[i][:] = d[f"master_{j}"]
             if self.moments is not None:
                 self.moments[i][0][:] = d[f"m_{j}"]
                 self.moments[i][1][:] = d[f"v_{j}"]
@@ -343,5 +645,8 @@ class HostOffloadOptimizer:
 
     def bf16_master_leaves(self):
         from deepspeed_tpu.ops.adam.cpu_adam import f32_to_bf16
+        if self.param_tier is not None:
+            return [f32_to_bf16(self.param_tier.read_master(i)).reshape(s)
+                    for i, s in enumerate(self.shapes)]
         return [f32_to_bf16(m).reshape(s)
                 for m, s in zip(self.master, self.shapes)]

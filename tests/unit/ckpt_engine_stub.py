@@ -21,3 +21,15 @@ class RecordingEngine(NpzCheckpointEngine):
 
     def commit(self, tag):
         CALLS.append(("commit", tag))
+
+    def save_aux(self, path, name, entries):
+        CALLS.append(("save_aux", name))
+        return super().save_aux(path, name, entries)
+
+    def load_aux(self, path, name):
+        CALLS.append(("load_aux", name))
+        return super().load_aux(path, name)
+
+    def consolidate_16bit(self, path, out_name, dtype):
+        CALLS.append(("consolidate_16bit", out_name))
+        return super().consolidate_16bit(path, out_name, dtype)

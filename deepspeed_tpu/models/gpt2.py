@@ -325,9 +325,18 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, positions=None,
-                 cache=None, pld_theta=None):
+                 cache=None, pld_theta=None, rltd_keep=None):
         cfg = self.cfg
         b, l = input_ids.shape
+        if rltd_keep is not None and (cache is not None or
+                                      rltd_keep >= l):
+            rltd_keep = None     # decode / schedule-complete: full layers
+        if rltd_keep is not None:
+            assert not any(cfg.attn_windows) and not cfg.use_alibi, \
+                "random_ltd middle layers attend over the gathered " \
+                "SUBsequence, where index distance != token distance — " \
+                "local attn_windows / ALiBi biases would silently " \
+                "change meaning; disable one of the two"
         if positions is None:
             start = cache["layers"][0]["index"] if cache is not None else 0
             positions = jnp.broadcast_to(start + jnp.arange(l)[None], (b, l))
@@ -357,6 +366,9 @@ class GPT2(nn.Module):
                 "scan_layers cannot interleave MoE blocks (heterogeneous)"
             assert not any(cfg.attn_windows), \
                 "scan_layers needs homogeneous layers (no local windows)"
+            assert rltd_keep is None, \
+                "random_ltd keeps the first/last layers full-sequence " \
+                "(heterogeneous shapes); use scan_layers=False"
             # one scanned block: params stack to [num_layers, ...] leaves
             # ('layers' logical axis). With the stacked leaves in host
             # memory (ZeRO-3 param offload) XLA's scan streams one layer
@@ -389,9 +401,28 @@ class GPT2(nn.Module):
                            i % cfg.moe_every == cfg.moe_every - 1)
                 win = cfg.attn_windows[i] if i < len(cfg.attn_windows) else 0
                 layer_cache = cache["layers"][i] if cache is not None else None
+                pk = None if pld_keeps is None else pld_keeps[i]
+                # random layerwise token dropping (reference
+                # data_routing/basic_layer.py:14 RandomLayerTokenDrop):
+                # middle layers see a random ordered subset of rltd_keep
+                # tokens; dropped tokens carry their residual value past
+                # the layer. First/last layers stay full-sequence (the
+                # reference's default layer selection).
+                if rltd_keep is not None and 0 < i < cfg.num_layers - 1:
+                    from deepspeed_tpu.runtime.data_pipeline.random_ltd \
+                        import (random_ltd_gather, random_ltd_indices,
+                                random_ltd_scatter)
+                    idx = random_ltd_indices(self.make_rng("rltd"), l,
+                                             rltd_keep, b)
+                    sub = random_ltd_gather(x, idx)
+                    sub_pos = jnp.take_along_axis(positions, idx, axis=1)
+                    sub_out, _ = block(cfg, use_moe, win, name=f"h_{i}")(
+                        sub, deterministic, None, sub_pos, pk)
+                    x = random_ltd_scatter(sub_out, idx, x)
+                    new_layer_caches.append(None)
+                    continue
                 x, new_c = block(cfg, use_moe, win, name=f"h_{i}")(
-                    x, deterministic, layer_cache, positions,
-                    None if pld_keeps is None else pld_keeps[i])
+                    x, deterministic, layer_cache, positions, pk)
                 new_layer_caches.append(new_c)
 
         logits = _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)

@@ -47,6 +47,12 @@ class RandomLTDScheduler:
 
     def keep_tokens(self, global_step):
         frac = min(1.0, global_step / self.steps)
+        if frac >= 1.0:
+            # exact completion regardless of step_size divisibility:
+            # flooring 1000 to a 16-grid would leave 8 tokens dropped
+            # forever after the schedule ends
+            self.current = self.seq_len
+            return self.current
         raw = self.start + frac * (self.seq_len - self.start)
         kept = int(raw // self.step_size * self.step_size)
         self.current = max(self.start, min(self.seq_len, kept))

@@ -262,6 +262,35 @@ class DeepSpeedEngine:
                     "does not accept a pld_theta kwarg — add "
                     "`pld_theta=None` to its signature and pass it into "
                     "the model call (models/gpt2.py consumes it)")
+        # random-LTD (reference data_routing/basic_layer.py:14 wired at
+        # engine.py:1698): the kept-token count is a SHAPE, so it enters
+        # the program as a build-time constant; each schedule milestone
+        # rebuilds the jitted fns (one recompile per milestone — size
+        # step_size so a full run pays a handful)
+        self._rltd_cfg = None
+        self._rltd = None
+        self._rltd_keep = None
+        de = self._config.data_efficiency or {}
+        # same falsy default as the data_sampling gate in deepspeed_io:
+        # the data_efficiency section is off unless enabled (reference
+        # data_pipeline/config.py defaults)
+        dr = de.get("data_routing", {}) if de.get("enabled") else {}
+        rl = dr.get("random_ltd", {})
+        if dr.get("enabled", True) and rl.get("enabled"):
+            self._rltd_cfg = rl
+            import inspect
+            try:
+                ps = inspect.signature(self._raw_loss_fn).parameters
+                accepts = "rltd_keep" in ps or any(
+                    p.kind == p.VAR_KEYWORD for p in ps.values())
+            except (TypeError, ValueError):
+                accepts = True
+            if not accepts:
+                raise ValueError(
+                    "random_ltd is enabled but the loss_fn does not "
+                    "accept an rltd_keep kwarg — add `rltd_keep=None` "
+                    "to its signature and pass it into the model call "
+                    "(models/gpt2.py consumes it)")
         # compression-aware training: runtime built once params exist
         # (_ensure_initialized); strengths ride the batch as traced
         # scalars so schedule changes never recompile
@@ -279,13 +308,13 @@ class DeepSpeedEngine:
                 gas_boundary_resolution=ev.gas_boundary_resolution)
         if getattr(self, "_compressed_axis", None) and (
                 self.progressive_layer_drop is not None
-                or self._config.compression_training):
+                or self._config.compression_training
+                or self._rltd_cfg is not None):
             raise ValueError(
-                "progressive_layer_drop / compression_training do not "
-                "compose with the 1-bit compressed gradient path yet "
-                "(its shard_map shards every batch leaf over 'data', "
-                "which the reserved scalar keys cannot satisfy) — "
-                "disable one of the two")
+                "progressive_layer_drop / compression_training / "
+                "random_ltd do not compose with the 1-bit compressed "
+                "gradient path yet (its shard_map loss call does not "
+                "thread the schedule kwargs) — disable one of the two")
 
         self.timers = SynchronizedWallClockTimer() \
             if self._config.wall_clock_breakdown else NoopTimer()
@@ -385,7 +414,7 @@ class DeepSpeedEngine:
         coef = getattr(getattr(module, "cfg", None), "moe_loss_coef", None)
         moe_coef = 0.01 if coef is None else float(coef)
 
-        def loss_fn(params, batch, rng, pld_theta=None):
+        def loss_fn(params, batch, rng, pld_theta=None, rltd_keep=None):
             rngs = None
             kw = {}
             if rng is not None:
@@ -395,6 +424,11 @@ class DeepSpeedEngine:
                 rngs = dict(rngs or {})
                 rngs["pld"] = jax.random.fold_in(r, 1)
                 kw["pld_theta"] = pld_theta
+            if rltd_keep is not None:   # random-LTD token dropping
+                r = rng if rng is not None else jax.random.PRNGKey(0)
+                rngs = dict(rngs or {})
+                rngs["rltd"] = jax.random.fold_in(r, 2)
+                kw["rltd_keep"] = rltd_keep
             logits, mut = module.apply(
                 {"params": params}, batch["input_ids"], rngs=rngs,
                 mutable=["intermediates"], **kw)
@@ -702,6 +736,8 @@ class DeepSpeedEngine:
                 lambda x: x.astype(compute_dtype)
                 if x.dtype == jnp.float32 and compute_dtype != jnp.float32 else x, p)
 
+        rltd_keep_static = self._rltd_keep
+
         # in-program param streaming (ZeRO-3 param offload): host-kind
         # params enter the program; XLA places each transfer next to its
         # consumer and frees the device buffer after last use
@@ -734,6 +770,10 @@ class DeepSpeedEngine:
                         extras[k] = batch.pop(k)
             loss_kw = {"pld_theta": extras["_ds_pld_theta"]} \
                 if "_ds_pld_theta" in extras else {}
+            if rltd_keep_static is not None:
+                # a shape constant: baked into this build of the
+                # jitted fns (forward() rebuilds at schedule milestones)
+                loss_kw["rltd_keep"] = rltd_keep_static
 
             def prep(p):
                 p = cast(materialize(p))
@@ -742,9 +782,9 @@ class DeepSpeedEngine:
                 return p
 
             if loss_and_grads is not None:
-                assert not extras, \
-                    "compression/pld do not compose with the fused 1F1B " \
-                    "pipeline loss yet"
+                assert not extras and rltd_keep_static is None, \
+                    "compression/pld/random_ltd do not compose with the " \
+                    "fused 1F1B pipeline loss yet"
                 loss, grads = loss_and_grads(cast(materialize(params)), batch)
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) * (scale / gas), grads)
@@ -1228,9 +1268,41 @@ class DeepSpeedEngine:
             return self._pending[2]
         return self.state
 
+    def _advance_random_ltd(self, batch):
+        """Advance the random-LTD schedule; a new kept-token milestone
+        rebuilds the jitted fns (shape constant). Returns quickly when
+        the feature is off or the milestone is unchanged."""
+        if self._rltd_cfg is None:
+            return
+        if self._rltd is None:
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+                RandomLTDScheduler)
+            seq = int(np.shape(self._model_input(batch))[-1])
+            rl = self._rltd_cfg
+            # 128-aligned milestones keep the gathered subsequence on
+            # the flash kernel's block grid
+            default_step = 128 if seq % 128 == 0 else 16
+            self._rltd = RandomLTDScheduler(
+                seq_len=seq,
+                start_tokens=rl.get("start_tokens"),
+                schedule_steps=rl.get("schedule_steps", 1000),
+                step_size=rl.get("step_size", default_step))
+        keep = self._rltd.keep_tokens(self.global_steps)
+        if keep >= self._rltd.seq_len:
+            keep = None      # schedule complete: full sequence
+        if keep != self._rltd_keep:
+            self._rltd_keep = keep
+            if self.state is not None:
+                self._build_jitted_fns()
+                log_dist(f"random-LTD milestone: keeping "
+                         f"{keep or self._rltd.seq_len}/"
+                         f"{self._rltd.seq_len} tokens per middle layer",
+                         ranks=[0])
+
     def forward(self, batch, rng=None):
         """One micro batch: fused forward+backward (+optimizer apply at the
         gradient-accumulation boundary), a single jitted dispatch."""
+        self._advance_random_ltd(batch)
         self._ensure_initialized(batch)
         assert self._next_state is None, \
             "step() must run before the next forward(): the previous " \
@@ -1659,6 +1731,7 @@ class DeepSpeedEngine:
     def _train_batch_fused(self, batches, sync=True):
         assert len(batches) == self.gas, \
             f"need {self.gas} micro batches, got {len(batches)}"
+        self._advance_random_ltd(batches[0])
         self._ensure_initialized(batches[0])
         if not self._can_fuse_window():
             # state became engine-managed mid-window; fall back
@@ -1725,9 +1798,9 @@ class DeepSpeedEngine:
             "train_loop does not compose with host offload or 1-bit sync"
         assert self._compression is None and \
             self.progressive_layer_drop is None and \
-            self.eigenvalue is None, \
-            "compression/PLD/MoQ schedules advance per engine step; " \
-            "drive those through forward()/backward()/step()"
+            self.eigenvalue is None and self._rltd_cfg is None, \
+            "compression/PLD/MoQ/random-LTD schedules advance per " \
+            "engine step; drive those through forward()/backward()/step()"
         assert self._pending is None and self._next_state is None, \
             "train_loop cannot start mid-step (pending forward state)"
         k = len(batches) // self.gas

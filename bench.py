@@ -9,6 +9,7 @@ utilization on its own hardware class.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -99,6 +100,22 @@ def run_config(gas, batch, seq, n_dev):
     loss23 = np.concatenate([jax.device_get(l) for l in all_losses])[22] \
         if on_tpu else float(jax.device_get(all_losses[-1][-1]))
 
+    profile = None
+    if gas == 1 and os.environ.get("DS_BENCH_PROFILE"):
+        # per-module measured breakdown on THE SAME engine/config the
+        # numbers above came from (engine.module_profile): the full
+        # table goes to stderr, the top HBM-traffic consumers ride the
+        # JSON line so a step-time regression carries its own diagnosis
+        import sys
+        from deepspeed_tpu.profiling.module_profiler import (
+            top_traffic_consumers)
+        records, table = engine.module_profile(micros[0], depth=3)
+        print(table, file=sys.stderr)
+        profile = [
+            {k: (round(v, 3) if isinstance(v, float) else v)
+             for k, v in t.items()}
+            for t in top_traffic_consumers(records)]
+
     tokens_per_step = batch * n_dev * seq
     tokens_per_sec = tokens_per_step * n_steps / dt
     loss = float(loss23)
@@ -107,7 +124,7 @@ def run_config(gas, batch, seq, n_dev):
     # 6N per token (fwd+bwd) + attention term 12*L*hidden*seq
     flops_per_token = 6 * n_params + \
         12 * cfg.num_layers * cfg.hidden_size * seq
-    return tokens_per_sec, loss, flops_per_token
+    return tokens_per_sec, loss, flops_per_token, profile
 
 
 def main():
@@ -116,9 +133,10 @@ def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq = (8, 1024) if on_tpu else (2, 128)
     n_dev = len(jax.devices())
-    tokens_per_sec, loss, flops_per_token = run_config(1, batch, seq, n_dev)
-    gas4_tps, gas4_loss, _ = run_config(4, batch, seq, n_dev) \
-        if batch % 4 == 0 else (None, None, None)
+    tokens_per_sec, loss, flops_per_token, profile = \
+        run_config(1, batch, seq, n_dev)
+    gas4_tps, gas4_loss = (run_config(4, batch, seq, n_dev)[:2]
+                           if batch % 4 == 0 else (None, None))
 
     achieved = tokens_per_sec * flops_per_token
     peak = guess_peak(jax.devices()[0]) * n_dev
@@ -130,6 +148,8 @@ def main():
              "device_kind": jax.devices()[0].device_kind,
              "batch": batch * n_dev, "seq": seq,
              "final_loss": loss}
+    if profile is not None:
+        extra["top_traffic"] = profile
     if gas4_tps is not None:
         extra["gas4_tokens_per_sec"] = round(gas4_tps, 1)
         # remaining gas4 gap is the fp32 grad accumulator's HBM traffic

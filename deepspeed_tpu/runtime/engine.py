@@ -271,12 +271,12 @@ class DeepSpeedEngine:
         self._rltd = None
         self._rltd_keep = None
         de = self._config.data_efficiency or {}
-        # same falsy default as the data_sampling gate in deepspeed_io:
-        # the data_efficiency section is off unless enabled (reference
-        # data_pipeline/config.py defaults)
+        # same falsy defaults as the data_sampling gate in deepspeed_io
+        # and the reference data_pipeline/config.py: every level of the
+        # data_efficiency section is off unless explicitly enabled
         dr = de.get("data_routing", {}) if de.get("enabled") else {}
-        rl = dr.get("random_ltd", {})
-        if dr.get("enabled", True) and rl.get("enabled"):
+        rl = dr.get("random_ltd", {}) if dr.get("enabled") else {}
+        if rl.get("enabled"):
             self._rltd_cfg = rl
             import inspect
             try:
@@ -1119,6 +1119,34 @@ class DeepSpeedEngine:
                                    None))
 
     # -------------------------------------------------------------- profiling
+    def module_profile(self, batch=None, depth=3, n_steps=3):
+        """Per-module measured flops/bytes/latency of one train step
+        (reference print_model_profile, profiler.py:23 — but from a real
+        device trace: every XLA op's measured time, flop count and HBM
+        bytes, attributed to its flax module path via the HLO metadata).
+        Returns (records, formatted_table). Trains ``n_steps`` real
+        steps on ``batch``."""
+        from deepspeed_tpu.profiling.module_profiler import (
+            capture_trace, format_profile)
+        if batch is None:
+            batch = getattr(self, "_last_batch", None)
+        if batch is None:
+            batch = self._example_batch
+        assert batch is not None, "module_profile needs a batch"
+        self._ensure_initialized(batch)
+
+        def step():
+            # a COMPLETE optimizer step per traced iteration: with
+            # gradient accumulation the window's micro dispatches AND
+            # the boundary apply (fp32 accumulator + Adam traffic) all
+            # land inside the trace
+            return self.train_batch(batches=[batch] * self.gas,
+                                    sync=False)
+
+        step()   # compile outside the trace window
+        records = capture_trace(step, n_steps=n_steps)
+        return records, format_profile(records, depth=depth)
+
     def flops_profile(self, batch=None):
         """Exact flops/bytes of one optimizer step from the compiled XLA
         executables (reference FlopsProfiler.get_total_flops — but from

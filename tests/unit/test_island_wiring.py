@@ -210,7 +210,8 @@ def test_random_ltd_custom_loss_without_kwarg_fails_loudly():
             model=model,
             config=_base_cfg(data_efficiency={
                 "enabled": True,
-                "data_routing": {"random_ltd": {"enabled": True}}}),
+                "data_routing": {"enabled": True,
+                                 "random_ltd": {"enabled": True}}}),
             loss_fn=simple_loss_fn(model))
 
 

@@ -65,18 +65,26 @@ def main():
     q, k, v = mk(), mk(), mk()
     results = []
 
-    def bench(f, *xs, n1=10 * args.trials, n2=70 * args.trials):
-        """Two-point measurement: the difference of an n1-call and an
-        n2-call window cancels the dispatch/relay constant, which on
-        tunneled rigs (~100 ms per round trip, +-tens of ms jitter)
-        otherwise swamps kernel-scale latencies; (n2-n1) is sized so
-        sub-ms kernels still integrate well past the jitter."""
-        fence(f(*xs))
+    def bench(f, *xs, n1=10 * args.trials, n2=60 * args.trials):
+        """Chained two-point measurement: the kernel runs inside ONE
+        jitted fori_loop per window (iteration i+1 consumes iteration
+        i's output), so per-dispatch overhead — ~6 ms through a relayed
+        rig, enough to swamp a sub-ms sparse kernel if each call were
+        its own dispatch — amortizes over the whole chain; the n2-n1
+        difference then cancels the remaining per-window constant."""
+        import functools
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def run(x, n):
+            return jax.lax.fori_loop(
+                0, n, lambda i, x: f(x, *xs[1:]), x)
+
+        fence(run(xs[0], n1))
+        fence(run(xs[0], n2))
+
         def window(n):
             t0 = time.time()
-            out = None
-            for _ in range(n):
-                out = f(*xs)
+            out = run(xs[0], n)
             fence(out)
             return time.time() - t0
         ds = []
@@ -126,17 +134,32 @@ def main():
                    "tokens_per_sec": round(L2 / t_dense * 1e3, 1)}
             results.append(row)
             print(json.dumps(row))
+            # two granularities: block 128 keeps the reference patterns'
+            # fine resolution (per-step overhead bound on TPU); block
+            # 512 is the MXU-native tile — comparable token coverage
+            # (~1.5k-token window vs HF BigBird's ~512), and the grid
+            # steps are big enough to run at the layout's density
             for name, cfg in [
                 ("bigbird", BigBirdSparsityConfig(
                     num_heads=h, block=128, num_random_blocks=1,
                     num_sliding_window_blocks=3, num_global_blocks=1)),
+                ("bigbird_b512", BigBirdSparsityConfig(
+                    num_heads=h, block=512, num_random_blocks=1,
+                    num_sliding_window_blocks=1, num_global_blocks=1)),
                 ("longformer", BSLongformerSparsityConfig(
                     num_heads=h, block=128,
                     num_sliding_window_blocks=3,
                     global_block_indices=[0])),
+                ("longformer_b512", BSLongformerSparsityConfig(
+                    num_heads=h, block=512,
+                    num_sliding_window_blocks=1,
+                    global_block_indices=[0])),
             ]:
-                layout = cfg.make_layout(L2)
-                density = float(np.asarray(layout).mean())
+                # the kernel runs causal=True, which trils the layout:
+                # the EXECUTED density (and so the admissible speedup)
+                # is the lower-triangle's
+                layout = np.tril(np.asarray(cfg.make_layout(L2)))
+                density = float(layout.mean())
                 sp = jax.jit(lambda q, k, v, c=cfg: flash_attention(
                     q, k, v, causal=True, sparsity_config=c))
                 t_sp = bench(sp, qs, qs, qs)
@@ -144,7 +167,11 @@ def main():
                        "latency_ms": round(t_sp, 2),
                        "tokens_per_sec": round(L2 / t_sp * 1e3, 1),
                        "layout_density": round(density, 4),
-                       "speedup_vs_dense": round(t_dense / t_sp, 2)}
+                       "speedup_vs_dense": round(t_dense / t_sp, 2),
+                       # causal dense does ~density-0.5 of the square;
+                       # the layout admits at most 0.5/density speedup —
+                       # how close the kernel gets IS its efficiency
+                       "density_ceiling": round(0.5 / density, 2)}
                 results.append(row)
                 print(json.dumps(row))
 

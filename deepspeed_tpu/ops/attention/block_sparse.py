@@ -317,9 +317,14 @@ def _sparse_bwd(q3, k3, v3, o3, lse, do3, csr, csr_t, *, scale, block,
 
 # ------------------------------------------------------------------- entry
 def make_sparse_op(layout, *, causal, scale, block, num_heads, interpret):
-    """custom_vjp closing over the (static) layout's CSR step arrays."""
-    csr = tuple(jnp.asarray(a) for a in build_csr(layout))
-    csr_t = tuple(jnp.asarray(a)
+    """custom_vjp closing over the (static) layout's CSR step arrays.
+
+    The step arrays stay NUMPY: the op is cached and reused across
+    traces, and a jnp constant minted inside one trace (e.g. the first
+    call under a caller's scan/fori_loop) would leak that trace's
+    tracer into every later one."""
+    csr = tuple(np.ascontiguousarray(a) for a in build_csr(layout))
+    csr_t = tuple(np.ascontiguousarray(a)
                   for a in build_csr(layout.transpose(0, 2, 1)))
     kw = dict(scale=scale, block=block, causal=causal, num_heads=num_heads,
               interpret=interpret)

@@ -26,6 +26,8 @@ TPU, so the same code path is exercised by the CPU test mesh.
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -562,6 +564,26 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
     the block-sparse kernel (block_sparse.py): grid steps exist only for
     active blocks, so compute AND k/v traffic scale with layout density.
     """
+    if q.dtype == jnp.float16 and jax.default_backend() == "tpu":
+        # Mosaic has no f16 vector type on TPU ("Unsupported type in
+        # mosaic dialect: 'f16'"); XLA itself handles f16 fine by
+        # upcasting, so fp16 compat mode routes through the jnp oracle
+        assert not with_lse, \
+            "fp16 attention has no kernel lse path on TPU; use bf16 " \
+            "for sequence-parallel training (the TPU-native half type)"
+        if sparsity_config is not None:
+            # no tril here: mha_reference applies the element-level
+            # causal mask itself when causal=True, and bidirectional
+            # layouts (causal=False) must keep their forward blocks
+            from deepspeed_tpu.ops.sparse_attention import layout_to_bias
+            layout = np.asarray(sparsity_config.make_layout(q.shape[1]))
+            bias = layout_to_bias(layout, q.shape[1],
+                                  int(sparsity_config.block))
+            from deepspeed_tpu.ops.attention.reference import mha_reference
+            return mha_reference(q, k, v, causal=causal, bias=bias,
+                                 scale=scale)
+        from deepspeed_tpu.ops.attention.reference import mha_reference
+        return mha_reference(q, k, v, causal=causal, scale=scale)
     if sparsity_config is not None:
         assert not with_lse, "with_lse is not supported on the sparse path"
         from deepspeed_tpu.ops.attention.block_sparse import (

@@ -109,7 +109,12 @@ def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
         b_vma = getattr(jax.typeof(branch), "vma", frozenset())
         missing = tuple(q_vma - b_vma)
         if missing:
-            branch = lax.pvary(branch, missing)
+            # lax.pvary is deprecated in favor of pcast(to='varying');
+            # keep the fallback for jax versions that predate pcast
+            if hasattr(lax, "pcast"):
+                branch = lax.pcast(branch, missing, to="varying")
+            else:   # pragma: no cover
+                branch = lax.pvary(branch, missing)
         return lax.switch(branch, [skip, diag, full], (q, k_cur, v_cur))
 
     def merge(m, l, acc, o_i, lse_i):

@@ -54,9 +54,12 @@ class HostLossScaler:
     runtime/fp16/loss_scaler.py:264)."""
 
     def __init__(self, fp16_cfg, enabled):
-        self.enabled = bool(enabled)
+        # static mode (loss_scale != 0) keeps the configured scale fixed
+        # (reference LossScaler); only dynamic mode adjusts on overflow
+        self.enabled = bool(enabled) and (
+            fp16_cfg is None or bool(fp16_cfg.dynamic_loss_scale))
         if enabled and fp16_cfg is not None:
-            self.loss_scale = float(fp16_cfg.initial_scale)
+            self.loss_scale = float(fp16_cfg.initial_dynamic_scale)
             self.scale_window = int(fp16_cfg.loss_scale_window)
             self.min_scale = float(fp16_cfg.min_loss_scale)
             self.hysteresis = int(fp16_cfg.hysteresis)

@@ -509,3 +509,16 @@ def test_experiment_scheduler_multi_host(tmp_path):
     summary = json.loads(
         (tmp_path / "results" / "summary.json").read_text())
     assert summary["best"] == best.name
+
+
+def test_env_report_runs_and_lists_ops(capsys):
+    """ds_report (reference env_report.py): every registered op builder
+    appears in the table and the general section names the stack."""
+    from deepspeed_tpu import env_report
+    from deepspeed_tpu.ops.op_builder import ALL_OPS
+    env_report.main([])
+    out = capsys.readouterr().out
+    for name in ALL_OPS:
+        assert name in out, name
+    for item in ("python", "deepspeed_tpu", "jax", "device count"):
+        assert item in out, item

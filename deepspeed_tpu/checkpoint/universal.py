@@ -1,0 +1,173 @@
+"""Universal checkpoints: per-param fp32 fragments any partitioning can
+load, plus the offline 3D (tp, pp) Megatron merge that produces them.
+
+Reference: ``deepspeed/checkpoint/universal_checkpoint.py:12`` (per-param
+fp32 "hp" fragments loadable into any partitioning),
+``reshape_3d_utils.py:17`` / ``reshape_meg_2d.py`` (re-slicing Megatron
+tp/pp/dp checkpoints), and the offline driver ``ds_to_universal``.
+
+TPU shape of the idea: the fragment store is a directory of
+``<param-name>.npy`` fp32 files (names = the engine's checkpoint leaf
+names, ``param_leaf_names``) plus optional ``<name>.m.npy``/``.v.npy``
+Adam moments and a ``meta.json``. ``DeepSpeedEngine.
+load_universal_checkpoint`` maps fragments onto the live state tree —
+whatever the mesh/ZeRO stage, each leaf is device_put to its own
+sharding, so "any partitioning" needs no reshape logic at all here.
+
+The Megatron merge undoes tensor parallelism by key pattern
+(ColumnParallel: concat out-dim; RowParallel: concat in-dim; embeddings:
+concat vocab; layernorms/biases-of-row: replicated) and pipeline
+parallelism by renumbering each stage's layers at its global offset —
+then MegatronGPT2Policy.convert maps the merged dict onto the native
+GPT2 tree.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+
+import jax
+
+# TP merge rules for Megatron-LM GPT state dicts, by key suffix.
+# cat0 = ColumnParallel (output dim sharded), cat1 = RowParallel (input
+# dim sharded), rep = replicated across tp ranks.
+_TP_RULES = (
+    (r"word_embeddings\.weight$", "cat0"),
+    (r"position_embeddings\.weight$", "rep"),
+    (r"query_key_value\.weight$", "cat0"),
+    (r"query_key_value\.bias$", "cat0"),
+    (r"attention\.dense\.weight$", "cat1"),
+    (r"attention\.dense\.bias$", "rep"),
+    (r"dense_h_to_4h\.weight$", "cat0"),
+    (r"dense_h_to_4h\.bias$", "cat0"),
+    (r"dense_4h_to_h\.weight$", "cat1"),
+    (r"dense_4h_to_h\.bias$", "rep"),
+    (r"layernorm\.(weight|bias)$", "rep"),
+    (r"\.(weight|bias)$", "rep"),    # fallback: anything not sharded
+)
+
+_LAYER_RE = re.compile(r"(.*\blayers\.)(\d+)(\..*)")
+
+
+def _tp_rule(key):
+    for pat, rule in _TP_RULES:
+        if re.search(pat, key):
+            return rule
+    return "rep"
+
+
+def merge_megatron_tp(shards):
+    """Merge one pipeline stage's tp shards (list of state dicts, tp-rank
+    order) into a single-unit state dict."""
+    out = {}
+    for key in shards[0]:
+        vals = [np.asarray(s[key]) for s in shards]
+        if np.ndim(vals[0]) == 0:
+            out[key] = vals[0]
+            continue
+        rule = _tp_rule(key)
+        if rule == "cat0":
+            out[key] = np.concatenate(vals, axis=0)
+        elif rule == "cat1":
+            out[key] = np.concatenate(vals, axis=1)
+        else:
+            out[key] = vals[0]
+    return out
+
+
+def merge_megatron_3d(stages):
+    """``stages[pp_rank] = [sd_tp0, sd_tp1, ...]`` -> one merged state
+    dict with globally renumbered layers (reference reshape_3d_utils
+    semantics: undo tp within each stage, then concatenate stages'
+    layer ranges)."""
+    merged = {}
+    offset = 0
+    for pp_rank, tp_shards in enumerate(stages):
+        sd = merge_megatron_tp(tp_shards)
+        max_local = -1
+        for key, val in sd.items():
+            m = _LAYER_RE.match(key)
+            if m:
+                local = int(m.group(2))
+                max_local = max(max_local, local)
+                merged[f"{m.group(1)}{local + offset}{m.group(3)}"] = val
+            else:
+                # stage-resident singletons (embeddings on the first
+                # stage, final layernorm on the last) merge by name;
+                # identical duplicates (tied embeddings on both ends)
+                # are fine to overwrite
+                merged[key] = val
+        offset += max_local + 1
+    return merged
+
+
+# ---------------------------------------------------------------- fragments
+def save_universal(path, named_params, named_moments=None, meta=None):
+    """Write per-param fp32 fragments: ``named_params`` maps checkpoint
+    leaf name -> array; ``named_moments`` maps name -> (m, v)."""
+    os.makedirs(path, exist_ok=True)
+    names = []
+    for name, arr in named_params.items():
+        fn = _frag_file(path, name)
+        np.asarray(arr, np.float32).tofile(fn + ".bin")
+        names.append(name)
+        mv = (named_moments or {}).get(name)
+        if mv is not None:
+            np.asarray(mv[0], np.float32).tofile(fn + ".m.bin")
+            np.asarray(mv[1], np.float32).tofile(fn + ".v.bin")
+    info = {"format": "ds_tpu_universal_v1",
+            "leaves": {n: {"shape": list(np.shape(named_params[n])),
+                           "has_moments":
+                               (named_moments or {}).get(n) is not None}
+                       for n in names}}
+    info.update(meta or {})
+    with open(os.path.join(path, "universal_meta.json"), "w") as f:
+        json.dump(info, f, indent=2)
+
+
+def _frag_file(path, name):
+    # leaf names contain '/' and '.'; flatten to a safe filename
+    return os.path.join(path, name.strip(".").replace("/", "__")
+                        .replace(".", "__"))
+
+
+def load_universal(path):
+    """-> (meta, {name: fp32 array}, {name: (m, v) or None})."""
+    with open(os.path.join(path, "universal_meta.json")) as f:
+        meta = json.load(f)
+    params, moments = {}, {}
+    for name, info in meta["leaves"].items():
+        fn = _frag_file(path, name)
+        shape = tuple(info["shape"])
+        # memmaps, not eager reads: the NVMe-offload resume path
+        # consumes one leaf at a time (init_master takes a generator) —
+        # params+m+v of a tier-scale model must never be resident at once
+        params[name] = np.memmap(fn + ".bin", np.float32, "r",
+                                 shape=shape)
+        if info.get("has_moments"):
+            moments[name] = (
+                np.memmap(fn + ".m.bin", np.float32, "r", shape=shape),
+                np.memmap(fn + ".v.bin", np.float32, "r", shape=shape))
+        else:
+            moments[name] = None
+    return meta, params, moments
+
+
+def megatron_to_universal(stages, hf_config, out_path):
+    """Offline conversion (the reference's ``ds_to_universal`` for
+    Megatron sources): merge the (pp, tp) shard grid, convert to the
+    native GPT2 tree via the inference policy's layout knowledge, and
+    write fragments under the engine's checkpoint leaf names."""
+    from deepspeed_tpu.checkpoint.engine import param_leaf_names
+    from deepspeed_tpu.module_inject.policy import MegatronGPT2Policy
+
+    merged = merge_megatron_3d(stages)
+    params = MegatronGPT2Policy.convert(hf_config, merged)
+    names = param_leaf_names(params)
+    leaves = jax.tree.leaves(params)
+    save_universal(out_path, dict(zip(names, leaves)),
+                   meta={"source": "megatron-lm",
+                         "num_layers": int(hf_config.num_layers)})
+    return MegatronGPT2Policy.build_module(hf_config)

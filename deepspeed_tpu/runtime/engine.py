@@ -2097,6 +2097,156 @@ class DeepSpeedEngine:
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client
 
+    def load_universal_checkpoint(self, path, example_batch=None,
+                                  load_optimizer_states=True):
+        """Resume TRAINING from a universal checkpoint — per-param fp32
+        fragments produced by ``ds_to_universal`` from either a native
+        checkpoint or a foreign Megatron tp/pp one (reference
+        universal_checkpoint.py:12 + reshape_3d_utils.py: re-slice any
+        source partitioning for training resume). Each fragment is
+        device_put straight onto the live leaf's sharding, so the
+        current mesh/ZeRO stage needs no reshape logic; Adam moments
+        load when the source carried them (else the optimizer starts
+        fresh, reference load_universal semantics for param-only
+        sources)."""
+        from deepspeed_tpu.checkpoint.engine import param_leaf_names
+        from deepspeed_tpu.checkpoint.universal import load_universal
+        self.wait_checkpoint()   # an in-flight async writer reads the
+        # live offload buffers this load mutates in place
+        if self.state is None:
+            batch = example_batch if example_batch is not None \
+                else self._example_batch
+            assert batch is not None, \
+                "load_universal_checkpoint before init needs example_batch"
+            self._ensure_initialized(batch)
+        meta, frags, moments = load_universal(path)
+        names = param_leaf_names(self.state.params)
+        missing = [n for n in names if n not in frags]
+        if missing:
+            raise KeyError(
+                f"universal checkpoint at {path} lacks fragments for "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''} "
+                f"(has {len(frags)} leaves)")
+        leaves = jax.tree.leaves(self.state.params)
+        treedef = jax.tree.structure(self.state.params)
+        new_leaves = []
+        for name, live in zip(names, leaves):
+            frag = frags[name]
+            if tuple(np.shape(frag)) != tuple(np.shape(live)):
+                raise ValueError(
+                    f"fragment {name} has shape {np.shape(frag)} but the "
+                    f"live leaf is {np.shape(live)}")
+            if self._offload is not None:
+                new_leaves.append(frag)
+            else:
+                new_leaves.append(jax.device_put(
+                    np.asarray(frag, jax.dtypes.canonicalize_dtype(
+                        live.dtype)), live.sharding))
+        if self._offload is not None:
+            # masters refresh from the fragments; compute copies rebuild
+            self._offload.init_master(iter(new_leaves), names=names)
+            if self._params_nvme:
+                self.state = self.state.replace(
+                    params=jax.tree_util.tree_unflatten(
+                        self._param_treedef,
+                        self._offload.param_tier.param_memmaps()))
+            else:
+                put = [jax.device_put(
+                    np.asarray(l, np.dtype(self.compute_dtype)
+                               if self.compute_dtype != jnp.bfloat16
+                               else "bfloat16"), s)
+                    for l, s in zip(new_leaves, self._param_sh_flat)]
+                self.state = self.state.replace(
+                    params=jax.tree_util.tree_unflatten(
+                        self._param_treedef, put))
+            if load_optimizer_states and self._offload.nvme is not None:
+                for i, n in enumerate(names):
+                    if moments.get(n) is not None:
+                        self._offload.nvme.writeback(
+                            i, np.ascontiguousarray(moments[n][0]),
+                            np.ascontiguousarray(moments[n][1]))
+                self._offload.nvme.flush()
+            elif load_optimizer_states and self._offload.moments:
+                for i, n in enumerate(names):
+                    if moments.get(n) is not None:
+                        self._offload.moments[i][0][:] = \
+                            moments[n][0].reshape(-1)
+                        self._offload.moments[i][1][:] = \
+                            moments[n][1].reshape(-1)
+        else:
+            params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+            opt_state = self.state.opt_state
+            if load_optimizer_states and any(
+                    m is not None for m in moments.values()):
+                mu = jax.tree_util.tree_unflatten(
+                    treedef, [moments[n][0] if moments.get(n) is not None
+                              else np.zeros_like(frags[n])
+                              for n in names])
+                nu = jax.tree_util.tree_unflatten(
+                    treedef, [moments[n][1] if moments.get(n) is not None
+                              else np.zeros_like(frags[n])
+                              for n in names])
+                opt_state = self._inject_adam_moments(
+                    opt_state, mu, nu,
+                    count=int(meta.get("global_steps", 0)))
+            self.state = self.state.replace(params=params,
+                                            opt_state=opt_state)
+        self.global_steps = int(meta.get("global_steps", 0))
+        if self._offload is not None:
+            # Adam bias correction must continue from the source's step
+            # (t=1 would scale the loaded moments ~1/(1-beta) wrong)
+            self._offload.step_count = self.global_steps
+        if self.lr_scheduler is not None:
+            # fast-forward the schedule to the restored step — a
+            # universal source carries no scheduler state (it may come
+            # from a different framework), but replaying warmup on a
+            # converged model is strictly worse
+            for _ in range(self.global_steps):
+                self.lr_scheduler.step()
+        log_dist(f"loaded universal checkpoint {path} "
+                 f"({len(names)} fragments, source="
+                 f"{meta.get('source', 'native')})", ranks=[0])
+        return meta
+
+    def _inject_adam_moments(self, opt_state, mu, nu, count=0):
+        """Replace the ScaleByAdamState mu/nu trees (optax chain walk)
+        and advance its bias-correction count, preserving shardings."""
+        import optax
+
+        def put_like(new, old):
+            return jax.device_put(
+                np.asarray(new, old.dtype),
+                old.sharding if hasattr(old, "sharding") else None)
+
+        found = [0]
+
+        def walk(node):
+            if isinstance(node, optax.ScaleByAdamState):
+                found[0] += 1
+                return node._replace(
+                    count=jax.device_put(
+                        jnp.asarray(count, node.count.dtype),
+                        getattr(node.count, "sharding", None)),
+                    mu=jax.tree.map(put_like, mu, node.mu),
+                    nu=jax.tree.map(put_like, nu, node.nu))
+            if isinstance(node, tuple) and not hasattr(node, "_fields"):
+                return tuple(walk(c) for c in node)
+            if hasattr(node, "_fields"):   # other NamedTuple states
+                return type(node)(*(walk(c) for c in node))
+            return node
+
+        new = walk(opt_state)
+        if not found[0]:
+            logger.warning(
+                "load_universal_checkpoint: the source carries Adam "
+                "moments but no optax ScaleByAdamState was found in "
+                "this optimizer's state (wrapped/custom optimizer?) — "
+                "optimizer state starts FRESH")
+            return opt_state
+        if jax.tree.structure(new) == jax.tree.structure(opt_state):
+            return new
+        return opt_state
+
     # ------------------------------------------------------------------ misc
     def get_params(self):
         return self._live_state().params if self.state is not None else None

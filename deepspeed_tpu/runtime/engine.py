@@ -683,6 +683,45 @@ class DeepSpeedEngine:
             log_dist("compression-aware training: "
                      f"{len(self._compression)} config groups active",
                      ranks=[0])
+        # sparse embedding gradients on the dense-DP path (reference
+        # engine.py:2303 sparse allreduce in plain DP; the offload path
+        # has its own D2H variant). Engaged when data parallelism is
+        # real and the fused gas window / onebit / offload are not
+        # claiming the step.
+        self._sparse_dp = False
+        if self._config.sparse_gradients_enabled and \
+                self._offload is None and not self._compressed_axis and \
+                mesh.shape.get("data", 1) > 1 and self.gas == 1 and \
+                self.zero_stage <= 2 and \
+                self.progressive_layer_drop is None and \
+                self._compression is None and self._rltd_cfg is None and \
+                not self._config.compression_training:
+            if getattr(getattr(self.module, "cfg", None),
+                       "tie_embeddings", False):
+                raise ValueError(
+                    "sparse_gradients with a TIED embedding head: the "
+                    "lm head's backward produces a DENSE [vocab, d] "
+                    "grad on wte every step, so there is nothing "
+                    "sparse to ship — untie the embeddings or disable "
+                    "sparse_gradients")
+            from deepspeed_tpu.checkpoint.engine import param_leaf_names
+            names = param_leaf_names(self.state.params)
+            lv = jax.tree.leaves(self.state.params)
+            self._sparse_dp_positions = frozenset(
+                i for i, (nm, l) in enumerate(zip(names, lv))
+                if l.ndim == 2 and any(t in nm.lower()
+                                       for t in ("wte", "wpe", "embed")))
+            ids = self._model_input(batch)
+            self._sparse_dp_tokens = int(
+                np.prod(np.shape(ids)) // mesh.shape["data"])
+            self._sparse_dp = bool(self._sparse_dp_positions)
+            if self._sparse_dp:
+                log_dist(
+                    "sparse_gradients: dense-DP embedding grads sync as "
+                    f"(indices, rows) over 'data' — "
+                    f"{len(self._sparse_dp_positions)} leaves, "
+                    f"{self._sparse_dp_tokens} rows/shard budget",
+                    ranks=[0])
         self._build_jitted_fns()
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
         log_dist(f"engine initialized: {n_params / 1e6:.2f}M params, mesh="
@@ -1008,6 +1047,79 @@ class DeepSpeedEngine:
         self._step_loop = jax.jit(
             step_loop, donate_argnums=(0, 1),
             out_shardings=(None, self._state_sh, None))
+
+        if getattr(self, "_sparse_dp", False):
+            # sparse_gradients on the DENSE data-parallel path
+            # (reference sparse_allreduce_no_retain, engine.py:2303): the
+            # fwd+bwd runs under shard_map so the embedding grads stay
+            # per-worker; embedding leaves sync as (touched-row indices,
+            # rows) via all_gather + scatter-add — traffic scales with
+            # tokens, not vocab — while every other leaf takes a plain
+            # pmean. Tied-embedding heads produce DENSE wte grads, which
+            # would overflow the row budget: the sync poisons the result
+            # with NaN in that case so training fails loudly instead of
+            # silently dropping gradient mass.
+            from jax import lax
+            mesh = self.mesh
+            sparse_pos = self._sparse_dp_positions
+
+            def sparse_sync(grads, k):
+                # k (row budget) comes from the TRACED batch shape, so a
+                # curriculum/packing change retraces with the right
+                # budget instead of NaN-poisoning legitimate grads
+                leaves = jax.tree.leaves(grads)
+                out = []
+                for i, g in enumerate(leaves):
+                    if i in sparse_pos and g.ndim == 2 and \
+                            0 < k < g.shape[0]:
+                        rn = jnp.sum(jnp.abs(g), axis=1)
+                        n_touched = jnp.sum(rn > 0)
+                        idx = jnp.nonzero(rn > 0, size=k,
+                                          fill_value=0)[0]
+                        valid = (jnp.arange(k) <
+                                 jnp.minimum(n_touched, k)).astype(g.dtype)
+                        vals = g[idx] * valid[:, None]
+                        all_idx = lax.all_gather(idx, "data")
+                        all_vals = lax.all_gather(vals, "data")
+                        dense = jnp.zeros_like(g).at[
+                            all_idx.reshape(-1)].add(
+                            all_vals.reshape(-1, g.shape[1]))
+                        dp = all_idx.shape[0]
+                        bad = (n_touched > k).astype(g.dtype)
+                        out.append(dense / dp +
+                                   bad * jnp.float32(jnp.nan).astype(
+                                       g.dtype))
+                    else:
+                        out.append(lax.pmean(g, "data"))
+                return jax.tree.unflatten(jax.tree.structure(grads), out)
+
+            def local_fwd_bwd_sparse(params, scale, batch, rng):
+                def scaled_loss(p):
+                    loss = loss_fn(cast(p), batch, rng)
+                    return loss.astype(jnp.float32) * scale, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(params)
+                k = int(np.prod(np.shape(self._model_input(batch))))
+                return lax.pmean(loss, "data"), sparse_sync(grads, k)
+
+            sm_sparse = jax.shard_map(
+                local_fwd_bwd_sparse, mesh=mesh,
+                in_specs=(P(), P(), P("data"), P()),
+                out_specs=(P(), P()),
+                check_vma=False)   # the all_gather makes grads
+            # replicated; the rep checker cannot prove it
+
+            def step_sparse_dp(params, opt_state, rest, batch, rng, lr):
+                state = rest.replace(params=params, opt_state=opt_state)
+                loss, grads = sm_sparse(params, state.scaler.loss_scale,
+                                        batch, rng)
+                new_state, metrics = apply_grads(state, grads, lr)
+                return loss, new_state, metrics
+
+            self._step_sparse_dp = jax.jit(
+                step_sparse_dp, donate_argnums=(1,),
+                out_shardings=(None, self._state_sh, None))
 
         if self._compressed_axis:
             # 1-bit compressed grad sync: the whole fwd+bwd runs under
@@ -1376,6 +1488,11 @@ class DeepSpeedEngine:
                     self.state.params, self.state.opt_state, rest,
                     dev_batch, rng, float(self.get_lr()[0]),
                     self._onebit_we, self._onebit_se)
+            self._pending = ("commit", loss, new_state, metrics)
+        elif self.gas == 1 and getattr(self, "_sparse_dp", False):
+            loss, new_state, metrics = self._step_sparse_dp(
+                self.state.params, self.state.opt_state, rest,
+                dev_batch, rng, float(self.get_lr()[0]))
             self._pending = ("commit", loss, new_state, metrics)
         elif self.gas == 1:
             loss, new_state, metrics = self._step_gas1(
@@ -1831,6 +1948,10 @@ class DeepSpeedEngine:
             "engine step; drive those through forward()/backward()/step()"
         assert self._pending is None and self._next_state is None, \
             "train_loop cannot start mid-step (pending forward state)"
+        assert not getattr(self, "_sparse_dp", False), \
+            "sparse_gradients' shard_map grad sync does not ride the " \
+            "scan-fused train_loop yet; drive it through " \
+            "forward()/backward()/step()"
         k = len(batches) // self.gas
         self.tput_timer.start()
         self._last_batch = batches[0]
@@ -2196,13 +2317,17 @@ class DeepSpeedEngine:
             # Adam bias correction must continue from the source's step
             # (t=1 would scale the loaded moments ~1/(1-beta) wrong)
             self._offload.step_count = self.global_steps
-        if self.lr_scheduler is not None:
+        if self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "step"):
             # fast-forward the schedule to the restored step — a
             # universal source carries no scheduler state (it may come
             # from a different framework), but replaying warmup on a
             # converged model is strictly worse
-            for _ in range(self.global_steps):
-                self.lr_scheduler.step()
+            try:
+                self.lr_scheduler.step(self.global_steps)
+            except TypeError:   # client scheduler without increment arg
+                for _ in range(self.global_steps):
+                    self.lr_scheduler.step()
         log_dist(f"loaded universal checkpoint {path} "
                  f"({len(names)} fragments, source="
                  f"{meta.get('source', 'native')})", ranks=[0])
@@ -2245,6 +2370,10 @@ class DeepSpeedEngine:
             return opt_state
         if jax.tree.structure(new) == jax.tree.structure(opt_state):
             return new
+        logger.warning(
+            "load_universal_checkpoint: rebuilding the optimizer state "
+            "around the loaded Adam moments changed its tree structure "
+            "— moments DISCARDED, optimizer state starts FRESH")
         return opt_state
 
     # ------------------------------------------------------------------ misc

@@ -1,0 +1,42 @@
+"""Convergence-tier shared corpus (reference tests/model/ tier: real-model
+sanity with loss baselines, run_sanity_check.py style).
+
+The corpus is an order-1 Markov chain over `vocab` tokens with a FIXED
+seed and Dirichlet-concentrated rows, so its per-token cross-entropy
+floor is exactly computable: a correct trainer must drive next-token
+loss toward H = -sum_s pi(s) sum_t P(t|s) ln P(t|s). That gives an
+absolute, framework-independent convergence anchor; the torch-oracle
+test additionally checks our curve tracks an HF/torch run on the SAME
+stream."""
+
+import numpy as np
+
+
+def markov_corpus(vocab=256, alpha=0.05, seed=7):
+    """-> (transition matrix P [vocab, vocab], stationary pi, entropy)."""
+    rng = np.random.default_rng(seed)
+    P = rng.dirichlet([alpha] * vocab, size=vocab)
+    # stationary distribution by power iteration
+    pi = np.full(vocab, 1.0 / vocab)
+    for _ in range(200):
+        pi = pi @ P
+        pi /= pi.sum()
+    H = float(-(pi[:, None] * P * np.log(P + 1e-30)).sum())
+    return P, pi, H
+
+
+def sample_batches(P, n_steps, batch, seq, seed=11):
+    """Deterministic stream of [batch, seq] int32 batches."""
+    vocab = P.shape[0]
+    rng = np.random.default_rng(seed)
+    cum = np.cumsum(P, axis=1)
+    state = rng.integers(0, vocab, size=batch)
+    for _ in range(n_steps):
+        out = np.empty((batch, seq), np.int32)
+        for t in range(seq):
+            u = rng.random(batch)
+            state = np.array([np.searchsorted(cum[s], x)
+                              for s, x in zip(state, u)])
+            state = np.minimum(state, vocab - 1)
+            out[:, t] = state
+        yield {"input_ids": out}

@@ -306,15 +306,10 @@ class DeepSpeedEngine:
                 verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
                 stability=ev.stability,
                 gas_boundary_resolution=ev.gas_boundary_resolution)
-        if getattr(self, "_compressed_axis", None) and (
-                self.progressive_layer_drop is not None
-                or self._config.compression_training
-                or self._rltd_cfg is not None):
-            raise ValueError(
-                "progressive_layer_drop / compression_training / "
-                "random_ltd do not compose with the 1-bit compressed "
-                "gradient path yet (its shard_map loss call does not "
-                "thread the schedule kwargs) — disable one of the two")
+        # PLD / compression / random-LTD compose with the 1-bit path:
+        # the reserved schedule scalars ride the batch REPLICATED into
+        # the shard_map (batch_specs in _build_jitted_fns) and the local
+        # loss threads them exactly like the SPMD fwd_bwd does
 
         self.timers = SynchronizedWallClockTimer() \
             if self._config.wall_clock_breakdown else NoopTimer()
@@ -796,15 +791,19 @@ class DeepSpeedEngine:
 
         comp = self._compression
 
-        def fwd_bwd(params, scale, batch, rng):
-            # reserved keys injected by forward(): compression strengths
-            # and pld theta ride the batch as TRACED scalars, so their
-            # per-step values never trigger a recompile
+        RESERVED = ("_ds_pld_theta", "_ds_comp")
+
+        def pop_reserved(batch):
+            """Split the reserved schedule scalars (injected by
+            forward() as TRACED values, so per-step changes never
+            recompile) out of the batch: -> (clean_batch, extras,
+            loss_kw). ONE implementation shared by the SPMD fwd_bwd and
+            the 1-bit shard_map local loss."""
             extras = {}
-            if isinstance(batch, dict) and (
-                    "_ds_pld_theta" in batch or "_ds_comp" in batch):
+            if isinstance(batch, dict) and any(k in batch
+                                               for k in RESERVED):
                 batch = dict(batch)
-                for k in ("_ds_pld_theta", "_ds_comp"):
+                for k in RESERVED:
                     if k in batch:
                         extras[k] = batch.pop(k)
             loss_kw = {"pld_theta": extras["_ds_pld_theta"]} \
@@ -813,6 +812,10 @@ class DeepSpeedEngine:
                 # a shape constant: baked into this build of the
                 # jitted fns (forward() rebuilds at schedule milestones)
                 loss_kw["rltd_keep"] = rltd_keep_static
+            return batch, extras, loss_kw
+
+        def fwd_bwd(params, scale, batch, rng):
+            batch, extras, loss_kw = pop_reserved(batch)
 
             def prep(p):
                 p = cast(materialize(p))
@@ -1151,26 +1154,58 @@ class DeepSpeedEngine:
                         jax.tree.unflatten(tdef,
                                            [o[2][None] for o in outs]))
 
-            def local_fwd_bwd(params, scale, batch, rng, we, se):
-                def scaled_loss(p):
-                    loss = loss_fn(cast(p), batch, rng)
-                    return loss.astype(jnp.float32) * scale, loss
+            def batch_specs(batch, stacked=False):
+                """Per-leaf specs: the reserved schedule scalars
+                (compression strengths, pld theta) ride the batch
+                REPLICATED — only real data leaves shard over 'data'.
+                This is what lets PLD/compression compose with the
+                1-bit path (r4 weak #5). ``stacked`` adds the fused
+                window's leading [n_micro] axis to every spec."""
+                data_spec = P(None, "data") if stacked else P("data")
+                rep_spec = P(None) if stacked else P()
+                if not isinstance(batch, dict):
+                    return jax.tree.map(lambda _: data_spec, batch)
+                return {k: (rep_spec if k in RESERVED
+                            else jax.tree.map(lambda _: data_spec, v))
+                        for k, v in batch.items()}
 
-                (_, loss), grads = jax.value_and_grad(
-                    scaled_loss, has_aux=True)(params)
+            def local_loss(params, batch, rng, scale, div=1.0):
+                """One micro's scaled loss + grads for the per-worker
+                (shard_map) path; reserved-key handling is the shared
+                pop_reserved."""
+                batch, extras, loss_kw = pop_reserved(batch)
+
+                def prep(p):
+                    p = cast(p)
+                    if comp is not None and "_ds_comp" in extras:
+                        p = comp.apply(p, extras["_ds_comp"])
+                    return p
+
+                def scaled_loss(p):
+                    loss = loss_fn(prep(p), batch, rng, **loss_kw)
+                    return loss.astype(jnp.float32) * scale / div, loss
+
+                return jax.value_and_grad(scaled_loss,
+                                          has_aux=True)(params)
+
+            def local_fwd_bwd(params, scale, batch, rng, we, se):
+                (_, loss), grads = local_loss(params, batch, rng, scale)
                 g_sync, new_we, new_se = compress_sync(grads, we, se)
                 return lax.pmean(loss, ca), g_sync, new_we, new_se
-
-            sm = shard_map(
-                local_fwd_bwd, mesh=mesh,
-                in_specs=(P(), P(), P("data"), P(), P(ca), P(ca)),
-                out_specs=(P(), P(), P(ca), P(ca)),
-                check_vma=False)   # phase-2 all_gather makes loss/grads
-            # replicated; the rep checker cannot prove it
 
             def step_onebit(params, opt_state, rest, batch, rng, lr,
                             we, se):
                 state = rest.replace(params=params, opt_state=opt_state)
+                # the shard_map builds INSIDE the trace so its in_specs
+                # can follow the batch's structure (reserved keys
+                # replicated, data leaves sharded)
+                sm = shard_map(
+                    local_fwd_bwd, mesh=mesh,
+                    in_specs=(P(), P(), batch_specs(batch), P(), P(ca),
+                              P(ca)),
+                    out_specs=(P(), P(), P(ca), P(ca)),
+                    check_vma=False)   # phase-2 all_gather makes
+                # loss/grads replicated; the rep checker cannot prove it
                 loss, grads, we, se = sm(params, state.scaler.loss_scale,
                                          batch, rng, we, se)
                 new_state, metrics = apply_grads(state, grads, lr)
@@ -1193,14 +1228,8 @@ class DeepSpeedEngine:
                     acc, losses = None, []
                     for i in range(n_micro):
                         b = jax.tree.map(lambda x: x[i], batches)
-
-                        def scaled_loss(p, b=b, r=rngs[i]):
-                            loss = loss_fn(cast(p), b, r)
-                            return (loss.astype(jnp.float32) * scale / gas,
-                                    loss)
-
-                        (_, loss), grads = jax.value_and_grad(
-                            scaled_loss, has_aux=True)(params)
+                        (_, loss), grads = local_loss(
+                            params, b, rngs[i], scale, div=gas)
                         acc = grads if acc is None else \
                             jax.tree.map(jnp.add, acc, grads)
                         losses.append(loss)
@@ -1208,17 +1237,17 @@ class DeepSpeedEngine:
                     return (lax.pmean(jnp.mean(jnp.stack(losses)), ca),
                             g_sync, new_we, new_se)
 
-                sm_n = shard_map(
-                    local_fwd_bwd_gasN, mesh=mesh,
-                    in_specs=(P(), P(), P(None, "data"), P(), P(ca),
-                              P(ca)),
-                    out_specs=(P(), P(), P(ca), P(ca)),
-                    check_vma=False)
-
                 def step_onebit_gasN(params, opt_state, rest, batches,
                                      rng, lr, we, se):
                     state = rest.replace(params=params,
                                          opt_state=opt_state)
+                    sm_n = shard_map(
+                        local_fwd_bwd_gasN, mesh=mesh,
+                        in_specs=(P(), P(),
+                                  batch_specs(batches, stacked=True),
+                                  P(), P(ca), P(ca)),
+                        out_specs=(P(), P(), P(ca), P(ca)),
+                        check_vma=False)
                     loss, grads, we, se = sm_n(
                         params, state.scaler.loss_scale, batches, rng,
                         we, se)

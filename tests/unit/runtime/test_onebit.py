@@ -192,3 +192,85 @@ def test_engine_compressed_matches_psum_direction():
     # same warmup-Adam math on quantized-mean grads: updates correlate
     cos = np.dot(pc, pp) / (np.linalg.norm(pc) * np.linalg.norm(pp))
     assert cos > 0.99, cos
+
+
+def test_onebit_composes_with_pld_and_compression():
+    """r4 weak #5: PLD / compression-aware training now ride the 1-bit
+    path — the reserved schedule scalars enter the shard_map replicated
+    and the local loss threads them. PLD must change the trajectory vs
+    plain 1-bit; compression must build its runtime and still converge."""
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+
+    def run(extra):
+        model = GPT2(gpt2_tiny(vocab_size=128, max_seq_len=32,
+                               num_layers=4))
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 4,
+                                     "comm_backend_name": "nccl"}},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000000,
+        }
+        cfg.update(extra)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 128, (16, 32)).astype("i4")}
+        losses = []
+        for _ in range(4):
+            loss = engine.forward(batch, rng=jax.random.PRNGKey(5))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        return engine, losses
+
+    e_plain, plain = run({})
+    e_pld, pld = run({"progressive_layer_drop": {
+        "enabled": True, "theta": 0.3, "gamma": 0.01}})
+    assert e_pld._compressed_axis and \
+        e_pld.progressive_layer_drop is not None
+    assert any(abs(a - b) > 1e-7 for a, b in zip(plain, pld))
+    assert all(np.isfinite(pld))
+
+    e_comp, comp = run({"compression_training": {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 1},
+        "different_groups": {"wq1": {
+            "params": {"start_bits": 8, "target_bits": 8,
+                       "quantization_period": 1},
+            "modules": ["fc_in"]}}}}})
+    assert e_comp._compression is not None and e_comp._compressed_axis
+    assert any(abs(a - b) > 1e-7 for a, b in zip(plain, comp))
+    assert all(np.isfinite(comp))
+
+
+def test_onebit_gas_window_composes_with_pld_and_rltd():
+    """The 1-bit FUSED gas window must thread the stacked reserved keys
+    (tiled theta riding P(None)) and the random-LTD shape constant
+    through its shard_map; training converges and rltd milestones
+    advance."""
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+    model = GPT2(gpt2_tiny(vocab_size=128, max_seq_len=32, num_layers=4))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "OnebitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": 3,
+                                 "comm_backend_name": "nccl"}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.3,
+                                   "gamma": 0.01},
+        "data_efficiency": {"enabled": True, "data_routing": {
+            "enabled": True,
+            "random_ltd": {"enabled": True, "start_tokens": 16,
+                           "schedule_steps": 2, "step_size": 16}}},
+        "mesh": {"data": 8},
+        "steps_per_print": 1000000})
+    rng = np.random.default_rng(0)
+    mk = lambda: {"input_ids": rng.integers(0, 128, (8, 32)).astype("i4")}
+    losses, keeps = [], []
+    for _ in range(4):
+        losses.append(engine.train_batch(batches=[mk(), mk()]))
+        keeps.append(engine._rltd_keep or 32)
+    assert engine._compressed_axis == "data"
+    assert all(np.isfinite(losses)), losses
+    assert keeps[0] == 16 and keeps[-1] == 32, keeps

@@ -250,13 +250,21 @@ class ElasticRendezvous:
                     port = s.getsockname()[1]
                 self.c.set(f"port:{e}", port)
             while True:
-                cur = self.current_epoch()
-                if cur != e:
-                    break        # stale round; rejoin at cur
+                # COMPLETION before staleness: once every node joined e
+                # and the port is published, round e happened — return
+                # it even if a fast peer already finished e and bumped
+                # the epoch for the NEXT round. (Checking the epoch
+                # first misclassified a completed round as stale, made
+                # this agent rejoin one round ahead of its peers, and
+                # wedged the group a round apart — the flake both
+                # rendezvous tests exhibited under load.)
                 joined = int(self.c.get(f"joined:{e}") or 0)
                 port = self.c.get(f"port:{e}")
                 if joined >= self.num_nodes and port is not None:
                     return e, int(port)
+                cur = self.current_epoch()
+                if cur != e:
+                    break        # abandoned mid-join; rejoin at cur
                 if time.time() > deadline:
                     raise TimeoutError(
                         f"rendezvous round {e}: {joined}/"

@@ -814,14 +814,21 @@ class DeepSpeedEngine:
                 loss_kw["rltd_keep"] = rltd_keep_static
             return batch, extras, loss_kw
 
-        def fwd_bwd(params, scale, batch, rng):
-            batch, extras, loss_kw = pop_reserved(batch)
-
+        def make_prep(extras, mat=True):
+            """The shared param-preparation closure (cast [+ in-jit
+            materialize] + compression apply) — ONE implementation for
+            the SPMD and 1-bit paths; ``mat=False`` on the per-worker
+            path, where offload streaming is excluded by construction."""
             def prep(p):
-                p = cast(materialize(p))
+                p = cast(materialize(p) if mat else p)
                 if comp is not None and "_ds_comp" in extras:
                     p = comp.apply(p, extras["_ds_comp"])
                 return p
+            return prep
+
+        def fwd_bwd(params, scale, batch, rng):
+            batch, extras, loss_kw = pop_reserved(batch)
+            prep = make_prep(extras)
 
             if loss_and_grads is not None:
                 assert not extras and rltd_keep_static is None, \
@@ -1171,15 +1178,10 @@ class DeepSpeedEngine:
 
             def local_loss(params, batch, rng, scale, div=1.0):
                 """One micro's scaled loss + grads for the per-worker
-                (shard_map) path; reserved-key handling is the shared
-                pop_reserved."""
+                (shard_map) path; reserved-key and prep handling are the
+                shared pop_reserved/make_prep."""
                 batch, extras, loss_kw = pop_reserved(batch)
-
-                def prep(p):
-                    p = cast(p)
-                    if comp is not None and "_ds_comp" in extras:
-                        p = comp.apply(p, extras["_ds_comp"])
-                    return p
+                prep = make_prep(extras, mat=False)
 
                 def scaled_loss(p):
                     loss = loss_fn(prep(p), batch, rng, **loss_kw)

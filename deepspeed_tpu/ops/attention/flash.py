@@ -96,12 +96,17 @@ def _finalize_softmax(o_ref, lse_ref, m_scr, l_scr, acc_scr):
 
 
 def _bwd_p_ds(q, k, v, do, lse, delta, scale, causal, qi, ki, block_q,
-              block_k, offset):
+              block_k, offset, score_mask=None):
     """Recompute p from the saved logsumexp and form ds (flash-2 style);
-    shared by the dense and sparse backward kernels."""
+    shared by the dense and sparse backward kernels. ``score_mask``
+    (optional bool tile) knocks out entries BEFORE the causal mask —
+    the block-sparse coarse tiles pass their fine-activity mask here so
+    the recompute matches the forward exactly."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
+    if score_mask is not None:
+        s = jnp.where(score_mask, s, NEG_INF)
     if causal:
         s = _causal_block_mask(s, qi, ki, block_q, block_k, offset)
     # fully-masked rows carry lse = NEG_INF; their p must be 0

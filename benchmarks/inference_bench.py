@@ -1,11 +1,16 @@
-"""Inference latency benchmark (reference benchmarks/inference/gpt-bench.py).
+"""Inference latency benchmark (reference benchmarks/inference/gpt-bench.py
++ bert-bench.py).
 
-Measures prefill latency and per-token decode latency (p50/p90) through
-the KV-cache generation path, optionally with int8 weight quantization.
-Prints one bench.py-style JSON line per configuration.
+Decoder models: prefill latency and per-token decode latency through the
+KV-cache generation path, optionally with int8 weight quantization.
+Encoder models (bert-*): single-forward latency p50/p90 swept over
+(batch, seq) pairs — the reference bert-bench.py grid. Prints one
+bench.py-style JSON line per configuration.
 
 Usage: python benchmarks/inference_bench.py [--model gpt2-small]
        [--batch 1] [--prompt 128] [--tokens 64] [--dtypes bfloat16,int8]
+       python benchmarks/inference_bench.py --model bert-large \
+           [--encoder-sweep 1:128,8:128,1:512,8:512] [--trials 20]
 """
 
 import argparse
@@ -97,15 +102,68 @@ def run(model_name, batch, prompt_len, new_tokens, dtype):
     }
 
 
+def run_encoder(model_name, sweep, dtype, trials):
+    """BERT encoder latency rows (reference benchmarks/inference/
+    bert-bench.py: fill-mask pipeline latency over a batch x seq grid;
+    here the MLM forward through init_inference, p50/p90 over trials)."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import Bert, bert_large, bert_tiny
+
+    import jax.numpy as jnp
+    cfgs = {"bert-large": bert_large, "bert-tiny": bert_tiny}
+    module = Bert(cfgs[model_name](dtype=jnp.bfloat16,
+                                   param_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(module, dtype=dtype)
+    engine.init_params(example_ids=jnp.zeros((1, 8), jnp.int32))
+    vocab = module.cfg.vocab_size
+    rng = np.random.default_rng(0)
+
+    rows = []
+    for batch, seq in sweep:
+        ids = rng.integers(0, vocab, (batch, seq)).astype("i4")
+        mask = np.ones((batch, seq), "i4")
+        engine.forward(ids, attention_mask=mask)      # compile
+        engine.model_times()
+        for _ in range(trials):
+            engine.forward(ids, attention_mask=mask)
+        times = np.asarray(engine.model_times()) * 1e3
+        rows.append({
+            "batch": batch, "seq": seq,
+            "latency_ms_p50": round(float(np.percentile(times, 50)), 3),
+            "latency_ms_p90": round(float(np.percentile(times, 90)), 3),
+            "seq_per_sec": round(batch / (np.percentile(times, 50) / 1e3), 1),
+            "trials": trials,
+        })
+    return rows
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2-small",
-                   choices=["gpt2-small", "gpt-2b7"])
+                   choices=["gpt2-small", "gpt-2b7", "bert-tiny",
+                            "bert-large"])
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt", type=int, default=128)
     p.add_argument("--tokens", type=int, default=64)
     p.add_argument("--dtypes", default="bfloat16,int8")
+    p.add_argument("--encoder-sweep", default="1:128,8:128,1:512,8:512",
+                   help="batch:seq pairs for encoder models")
+    p.add_argument("--trials", type=int, default=20)
     args = p.parse_args()
+
+    if args.model.startswith("bert"):
+        sweep = [tuple(int(x) for x in pair.split(":"))
+                 for pair in args.encoder_sweep.split(",")]
+        dtype = args.dtypes.split(",")[0]
+        for r in run_encoder(args.model, sweep, dtype, args.trials):
+            print(json.dumps({
+                "metric": f"{args.model}_{dtype}_encoder_latency"
+                          f"_b{r['batch']}_s{r['seq']}",
+                "value": r["latency_ms_p50"], "unit": "ms",
+                "extra": {**r, "dtype": dtype},
+            }))
+        return
 
     for dtype in args.dtypes.split(","):
         r = run(args.model, args.batch, args.prompt, args.tokens, dtype)

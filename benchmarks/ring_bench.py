@@ -46,10 +46,20 @@ def main():
     p.add_argument("--json", default=None)
     args = p.parse_args()
 
+    if args.cpu_devices:
+        # before jax initializes: jax<0.5 has no jax_num_cpu_devices
+        # option, only the XLA flag
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = flags + \
+                f" --xla_force_host_platform_device_count={args.cpu_devices}"
     import jax
     if args.cpu_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except AttributeError:
+            pass   # jax<0.5: XLA_FLAGS above already set the count
     import jax.numpy as jnp
     from deepspeed_tpu import comm as dist
     from deepspeed_tpu.ops.attention import flash_attention

@@ -1,0 +1,177 @@
+"""Serving throughput benchmark: continuous batching vs static batching.
+
+A Poisson-arrival load generator drives the same request set through
+
+  (a) the continuous-batching scheduler (serving/ScheduleScheduler:
+      iteration-level joins, paged KV cache), and
+  (b) a static-batching baseline: FIFO batches of --batch requests,
+      left-padded to the batch's longest prompt, every request held
+      until the slowest in its batch finishes (the pre-serving
+      `generate()` regime).
+
+Arrivals are replayed open-loop against the wall clock: a request is
+only visible to either system once its (simulated) arrival time has
+passed. Reports aggregate tokens/s plus TTFT/TPOT percentiles and
+page-pool utilization, one bench.py-style JSON line per system.
+
+Usage: python benchmarks/serving_bench.py [--model gpt2-tiny]
+       [--requests 32] [--rate 4.0] [--seed 0] [--json-out results.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_workload(vocab, n_requests, rate, seed):
+    """Mixed-length prompts + Poisson arrival offsets."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, int(rng.integers(4, 24))).astype("i4")
+               for _ in range(n_requests)]
+    max_new = [int(rng.integers(4, 16)) for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    return prompts, max_new, arrivals
+
+
+def run_continuous(engine, prompts, max_new, arrivals, cfg):
+    from deepspeed_tpu.serving import ServingScheduler
+    sched = ServingScheduler(
+        engine, num_slots=cfg["num_slots"], num_pages=cfg["num_pages"],
+        page_size=cfg["page_size"],
+        max_pages_per_slot=cfg["max_pages_per_slot"],
+        prefill_chunk=cfg["prefill_chunk"])
+    t0 = time.time()
+    pending = list(zip(prompts, max_new, arrivals))
+    submitted = []
+    while True:
+        now = time.time() - t0
+        while pending and pending[0][2] <= now:
+            p, m, _ = pending.pop(0)
+            submitted.append(sched.submit(p, max_new_tokens=m))
+        work = sched.step()
+        if not work:
+            if not pending:
+                break
+            # idle until the next arrival
+            time.sleep(max(pending[0][2] - (time.time() - t0), 0.0))
+    wall = time.time() - t0
+    toks = sum(len(r.out_tokens) for r in submitted)
+    out = sched.metrics.summary(wall)
+    out.update({"wall_s": round(wall, 3), "tokens": toks,
+                "tokens_per_sec": round(toks / wall, 2)})
+    return out
+
+
+def run_static(engine, prompts, max_new, arrivals, batch):
+    """FIFO batches; each batch left-pads prompts to its longest and
+    decodes max(max_new) steps — slot time is held by the slowest
+    request (throughput baseline, not a token-for-token oracle)."""
+    t0 = time.time()
+    ttft, done_t = [], []
+    toks = 0
+    i = 0
+    while i < len(prompts):
+        j = min(i + batch, len(prompts))
+        # a batch launches only once all of its members have arrived
+        wait = arrivals[j - 1] - (time.time() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        batch_prompts = prompts[i:j]
+        batch_new = max_new[i:j]
+        longest = max(len(p) for p in batch_prompts)
+        ids = np.zeros((j - i, longest), np.int32)
+        for b, p in enumerate(batch_prompts):
+            ids[b, longest - len(p):] = p      # left-pad
+        t_launch = time.time()
+        out = engine.generate(ids, max_new_tokens=max(batch_new),
+                              do_sample=False)
+        t_done = time.time()
+        for b in range(j - i):
+            ttft.append(t_done - t0 - arrivals[i + b])
+            done_t.append(t_done - t0)
+            toks += batch_new[b]               # useful tokens only
+        del out
+        i = j
+    wall = max(done_t)
+    return {
+        "wall_s": round(wall, 3), "tokens": toks,
+        "tokens_per_sec": round(toks / wall, 2),
+        "ttft_ms_p50": round(float(np.percentile(ttft, 50)) * 1e3, 3),
+        "ttft_ms_p90": round(float(np.percentile(ttft, 90)) * 1e3, 3),
+        "ttft_ms_p99": round(float(np.percentile(ttft, 99)) * 1e3, 3),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2-tiny",
+                   choices=["gpt2-tiny", "gpt2-small"])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=4.0,
+                   help="Poisson arrival rate (req/s)")
+    p.add_argument("--batch", type=int, default=4,
+                   help="static-baseline batch size")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=64)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-slot", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args()
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_small, gpt2_tiny
+
+    cfgs = {"gpt2-tiny": gpt2_tiny, "gpt2-small": gpt2_small}
+    module = GPT2(cfgs[args.model]())
+    engine = deepspeed_tpu.init_inference(
+        module, dtype="float32", kv_cache_dtype="float32",
+        max_out_tokens=args.max_pages_per_slot * args.page_size)
+    engine.init_params()
+    vocab = module.cfg.vocab_size
+
+    prompts, max_new, arrivals = make_workload(
+        vocab, args.requests, args.rate, args.seed)
+    cfg = {k: getattr(args, k) for k in
+           ("num_slots", "num_pages", "page_size", "max_pages_per_slot",
+            "prefill_chunk")}
+
+    # warmup: compile every signature both systems will hit (the serving
+    # primitives, plus generate() at each static batch/length bucket)
+    warm = run_continuous(engine, prompts[:4], max_new[:4],
+                          np.zeros(4), cfg)
+    run_static(engine, prompts, [1] * len(prompts), np.zeros(len(prompts)),
+               args.batch)
+    del warm
+
+    cont = run_continuous(engine, prompts, max_new, arrivals, cfg)
+    stat = run_static(engine, prompts, max_new, arrivals, args.batch)
+
+    results = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "static_batch": args.batch,
+        "continuous": cont, "static": stat,
+        "speedup": round(cont["tokens_per_sec"] / stat["tokens_per_sec"], 3)
+        if stat["tokens_per_sec"] else None,
+    }
+    for name, r in (("continuous", cont), ("static", stat)):
+        print(json.dumps({
+            "metric": f"serving_{name}_tokens_per_sec",
+            "value": r["tokens_per_sec"], "unit": "tok/s",
+            "extra": r,
+        }))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

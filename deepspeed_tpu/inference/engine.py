@@ -453,6 +453,123 @@ class InferenceEngine:
         self._decode_loop_fn = jax.jit(decode_loop, donate_argnums=(2,),
                                        static_argnums=(5, 6, 7, 8, 9, 10, 11))
 
+    # ------------------------------------------------------- paged serving
+    # Slot-level primitives for the continuous-batching serving layer
+    # (deepspeed_tpu/serving/): a fixed pool of KV pages shared by all
+    # live sequences through a page table. Both primitives have a SINGLE
+    # jit signature — shapes are fixed by (num_slots, chunk, num_pages,
+    # page_size, max_pages) config constants, never by request churn —
+    # so the serving loop never recompiles.
+
+    def _paged_module(self):
+        from deepspeed_tpu.models import gpt2, llama
+        if isinstance(self.module, llama.Llama):
+            return llama
+        if isinstance(self.module, gpt2.GPT2):
+            return gpt2
+        raise ValueError(
+            "paged serving needs a KV-cache model contract (GPT2/Llama); "
+            f"got {type(self.module).__name__}")
+
+    def init_paged_cache(self, num_pages, page_size):
+        """Device-resident per-layer K/V page pools. The page table,
+        lengths and active mask are host-owned (the scheduler passes
+        them per call as small traced inputs). Built INSIDE a jit so the
+        pools carry the same committed sharding as the pools the serving
+        primitives return — otherwise the first prefill/decode call
+        compiles a second signature just for the uncommitted zeros."""
+        mod = self._paged_module()
+        cfg, dt = self.module.cfg, self.kv_dtype
+        rep = NamedSharding(self.mesh, P())
+        with dist.mesh_scope(self.mesh):
+            return jax.jit(lambda: mod.init_paged_kv_cache(
+                cfg, num_pages, page_size, dtype=dt), out_shardings=rep)()
+
+    def _build_serving_fns(self):
+        module = self.module
+        materialize = self._materialize
+
+        def prefill(params, ids, slot, n_valid, page_table, lengths, pools):
+            cache = dict(pools, page_table=page_table, lengths=lengths,
+                         slot=slot, n_valid=n_valid)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         ids, cache=cache)
+            # the model already reduced to the chunk's boundary row (the
+            # only position a scheduler ever samples from)
+            return logits[0, 0], {"layers": cache["layers"]}
+
+        def decode(params, toks, active, page_table, lengths, pools, rng,
+                   do_sample, temperature, top_k, top_p):
+            cache = dict(pools, page_table=page_table, lengths=lengths,
+                         active=active)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         toks[:, None], cache=cache)
+            nxt = _sample_tokens(logits[:, 0], rng, do_sample, temperature,
+                                 top_k, top_p)
+            return nxt.astype(jnp.int32), {"layers": cache["layers"]}
+
+        # pools replicate over the mesh (pinned out_shardings so the
+        # donated round-trip keeps ONE jit signature: an inferred
+        # sharding that differed from init_paged_cache's would compile a
+        # second copy on the first feedback call)
+        rep = NamedSharding(self.mesh, P())
+        self._paged_prefill_fn = jax.jit(prefill, donate_argnums=(6,),
+                                         out_shardings=(rep, rep))
+        self._paged_decode_fn = jax.jit(decode, donate_argnums=(5,),
+                                        static_argnums=(7, 8, 9, 10),
+                                        out_shardings=(rep, rep))
+
+    def prefill_into_slots(self, ids_chunk, slot, n_valid, page_table,
+                           lengths, pools):
+        """One prefill chunk of one slot: write the chunk's K/V through
+        the page table and return (boundary logits [vocab], new pools).
+        ``ids_chunk`` is [1, chunk] (padded past ``n_valid``); the pages
+        covering positions lengths[slot] .. +n_valid must be allocated."""
+        assert self.params is not None, "set_params/init_params first"
+        if getattr(self, "_paged_prefill_fn", None) is None:
+            self._build_serving_fns()
+        with dist.mesh_scope(self.mesh):
+            return self._paged_prefill_fn(
+                self.params, jnp.asarray(ids_chunk, jnp.int32),
+                jnp.int32(slot), jnp.int32(n_valid),
+                jnp.asarray(page_table, jnp.int32),
+                jnp.asarray(lengths, jnp.int32), pools)
+
+    def decode_step(self, toks, active, page_table, lengths, pools,
+                    do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
+        """One continuous-batching decode step over ALL slots: write each
+        active slot's token K/V at position lengths[slot], attend through
+        the page table, and return (next tokens [slots] i32, new pools).
+        Inactive slots pass through untouched (writes dropped)."""
+        assert self.params is not None, "set_params/init_params first"
+        if getattr(self, "_paged_decode_fn", None) is None:
+            self._build_serving_fns()
+        self._rng, rng = jax.random.split(self._rng)
+        with dist.mesh_scope(self.mesh):
+            return self._paged_decode_fn(
+                self.params, jnp.asarray(toks, jnp.int32),
+                jnp.asarray(active, bool),
+                jnp.asarray(page_table, jnp.int32),
+                jnp.asarray(lengths, jnp.int32), pools, rng,
+                bool(do_sample), float(temperature), int(top_k),
+                float(top_p))
+
+    def sample_from_logits(self, logits, do_sample=False, temperature=1.0,
+                           top_k=0, top_p=1.0):
+        """Sample one token from a [vocab] logits row (the serving
+        scheduler's prefill-boundary sample — same `_sample_tokens` math
+        as generate())."""
+        self._rng, rng = jax.random.split(self._rng)
+        tok = _sample_tokens(jnp.asarray(logits)[None], rng, do_sample,
+                             temperature, top_k, top_p)
+        return int(np.asarray(jax.device_get(tok))[0])
+
+    def serving_decode_compile_count(self):
+        """Number of compiled signatures behind decode_step (the
+        no-per-step-recompilation guarantee: stays 1 across churn)."""
+        fn = getattr(self, "_paged_decode_fn", None)
+        return 0 if fn is None else fn._cache_size()
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  max_length=None, stream=False, **kwargs):

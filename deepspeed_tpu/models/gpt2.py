@@ -73,6 +73,7 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_min_capacity: int = 4
     moe_use_residual: bool = False
+    moe_use_rts: bool = False          # Random Token Selection (top-1 drops)
     moe_loss_coef: float = 0.01
 
     @property
@@ -136,7 +137,61 @@ class SelfAttention(nn.Module):
                                      interleaved=cfg.rotary_interleaved)
 
         new_cache = None
-        if cache is not None:
+        if cache is not None and "k_pages" in cache:
+            # paged serving path (serving/ subsystem): K/V live in a
+            # shared fixed-page pool indexed through a per-slot page
+            # table — sequences of any length share one preallocated
+            # cache, and the jit signature is fixed by (slots, chunk,
+            # pool, table) shapes regardless of request churn.
+            assert self.window == 0, \
+                "paged serving does not support local attn_windows yet"
+            from deepspeed_tpu.ops.attention import (decode_attention,
+                                                     gather_pages,
+                                                     paged_decode_attention)
+            k_pages, v_pages = cache["k_pages"], cache["v_pages"]
+            num_pages, ps = k_pages.shape[0], k_pages.shape[1]
+            pt = cache["page_table"]                     # [slots, maxp]
+            max_len = pt.shape[1] * ps
+            k_pos = jnp.arange(max_len)
+            alibi = None
+            if cfg.use_alibi:
+                alibi = (alibi_slopes(cfg.num_heads)[None, :, None, None]
+                         * k_pos[None, None, None, :])
+            if "slot" in cache:
+                # chunked prefill into ONE slot: b == 1, l == chunk;
+                # rows past n_valid are padding — their K/V writes drop
+                # (out-of-bounds page id) and their outputs are unused
+                slot = cache["slot"]
+                pos = positions[0]                       # [l]
+                valid = jnp.arange(l) < cache["n_valid"]
+                page_ids = jnp.where(valid, pt[slot, pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k[0].astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v[0].astype(v_pages.dtype), mode="drop")
+                k_slot = gather_pages(k_pages, pt[slot][None])
+                v_slot = gather_pages(v_pages, pt[slot][None])
+                mask = k_pos[None, None, :] <= positions[:, :, None]
+                bias = jnp.where(mask, 0.0,
+                                 jnp.finfo(jnp.float32).min)[:, None]
+                if alibi is not None:
+                    bias = bias + alibi
+                out = decode_attention(q, k_slot, v_slot, bias=bias)
+            else:
+                # continuous-batch decode: b == slots, l == 1; inactive
+                # slots write nowhere and produce ignored outputs
+                active = cache["active"]
+                pos = positions[:, 0]                    # [slots]
+                page_ids = jnp.where(active,
+                                     pt[jnp.arange(b), pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k[:, 0].astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v[:, 0].astype(v_pages.dtype), mode="drop")
+                out = paged_decode_attention(q, k_pages, v_pages, pt, pos,
+                                             bias=alibi)
+            new_cache = {"k_pages": k_pages, "v_pages": v_pages}
+        elif cache is not None:
             # decode: append k/v at cache["index"], attend over the valid
             # prefix with a positional mask (same scheme as models/llama.py)
             k_cache = lax.dynamic_update_slice(
@@ -254,6 +309,7 @@ class Block(nn.Module):
                               capacity_factor=cfg.moe_capacity_factor,
                               min_capacity=cfg.moe_min_capacity,
                               use_residual=cfg.moe_use_residual,
+                              use_rts=cfg.moe_use_rts,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               name="moe")(h, deterministic)
             else:
@@ -337,9 +393,21 @@ class GPT2(nn.Module):
                 "SUBsequence, where index distance != token distance — " \
                 "local attn_windows / ALiBi biases would silently " \
                 "change meaning; disable one of the two"
+        paged = cache is not None and "page_table" in cache
         if positions is None:
-            start = cache["layers"][0]["index"] if cache is not None else 0
-            positions = jnp.broadcast_to(start + jnp.arange(l)[None], (b, l))
+            if paged:
+                lens = cache["lengths"]
+                if "slot" in cache:      # chunked prefill (b == 1)
+                    positions = (lens[cache["slot"]] +
+                                 jnp.arange(l))[None, :]
+                else:                    # continuous-batch decode (l == 1)
+                    positions = lens[:, None]
+                positions = jnp.broadcast_to(positions, (b, l))
+            else:
+                start = cache["layers"][0]["index"] if cache is not None \
+                    else 0
+                positions = jnp.broadcast_to(start + jnp.arange(l)[None],
+                                             (b, l))
 
         wte_v, wpe_v = _make_embed_tables(self, cfg)
         x = _embed_tokens(wte_v, wpe_v, input_ids, cfg, positions)
@@ -401,6 +469,12 @@ class GPT2(nn.Module):
                            i % cfg.moe_every == cfg.moe_every - 1)
                 win = cfg.attn_windows[i] if i < len(cfg.attn_windows) else 0
                 layer_cache = cache["layers"][i] if cache is not None else None
+                if paged:
+                    layer_cache = dict(layer_cache,
+                                       page_table=cache["page_table"])
+                    for key in ("slot", "n_valid", "active"):
+                        if key in cache:
+                            layer_cache[key] = cache[key]
                 pk = None if pld_keeps is None else pld_keeps[i]
                 # random layerwise token dropping (reference
                 # data_routing/basic_layer.py:14 RandomLayerTokenDrop):
@@ -425,7 +499,22 @@ class GPT2(nn.Module):
                     x, deterministic, layer_cache, positions, pk)
                 new_layer_caches.append(new_c)
 
+        if paged and "slot" in cache:
+            # chunked prefill consumes ONLY the boundary row — skip the
+            # full-vocab head for the chunk's other positions (~30% of a
+            # prefill step at gpt2-small shapes)
+            x = lax.dynamic_slice_in_dim(x, cache["n_valid"] - 1, 1, axis=1)
         logits = _head_logits(x, cfg, wte_v=wte_v, dense_ctor=_dense)
+        if paged:
+            if "slot" in cache:
+                lengths = cache["lengths"].at[cache["slot"]].add(
+                    cache["n_valid"])
+            else:
+                lengths = cache["lengths"] + \
+                    cache["active"].astype(jnp.int32)
+            out_cache = dict(cache, lengths=lengths,
+                             layers=new_layer_caches)
+            return logits, out_cache
         if cache is not None:
             return logits, {"layers": new_layer_caches}
         return logits
@@ -508,6 +597,21 @@ def init_kv_cache(cfg: GPTConfig, batch_size, max_len=None,
         "v": jnp.zeros((batch_size, max_len, cfg.num_heads, cfg.head_dim),
                        dtype),
         "index": jnp.int32(0),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}
+
+
+def init_paged_kv_cache(cfg: GPTConfig, num_pages, page_size,
+                        dtype=jnp.bfloat16):
+    """Per-layer paged KV pools (serving/ subsystem): ``num_pages`` fixed
+    pages of ``page_size`` tokens shared by every live sequence through a
+    page table. The table/lengths/active arrays are host-owned (the
+    scheduler passes them per call); only the pools live here."""
+    layer = lambda: {
+        "k_pages": jnp.zeros((num_pages, page_size, cfg.num_heads,
+                              cfg.head_dim), dtype),
+        "v_pages": jnp.zeros((num_pages, page_size, cfg.num_heads,
+                              cfg.head_dim), dtype),
     }
     return {"layers": [layer() for _ in range(cfg.num_layers)]}
 

@@ -93,7 +93,45 @@ class LlamaAttention(nn.Module):
         k = apply_rotary_emb(k, positions, base=cfg.rope_base)
 
         new_cache = None
-        if cache is not None:
+        if cache is not None and "k_pages" in cache:
+            # paged serving path — same contract as models/gpt2.py:
+            # pools [num_pages, page_size, kv_h, d] shared via a per-slot
+            # page table; GQA pools stay grouped end to end
+            from deepspeed_tpu.ops.attention import (decode_attention,
+                                                     gather_pages,
+                                                     paged_decode_attention)
+            k_pages, v_pages = cache["k_pages"], cache["v_pages"]
+            num_pages, ps = k_pages.shape[0], k_pages.shape[1]
+            pt = cache["page_table"]
+            max_len = pt.shape[1] * ps
+            if "slot" in cache:          # chunked prefill (b == 1)
+                slot = cache["slot"]
+                pos = positions[0]
+                valid = jnp.arange(l) < cache["n_valid"]
+                page_ids = jnp.where(valid, pt[slot, pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k[0].astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v[0].astype(v_pages.dtype), mode="drop")
+                k_slot = gather_pages(k_pages, pt[slot][None])
+                v_slot = gather_pages(v_pages, pt[slot][None])
+                k_pos = jnp.arange(max_len)
+                mask = k_pos[None, None, :] <= positions[:, :, None]
+                bias = jnp.where(mask, 0.0,
+                                 jnp.finfo(jnp.float32).min)[:, None]
+                out = decode_attention(q, k_slot, v_slot, bias=bias)
+            else:                        # continuous-batch decode (l == 1)
+                active = cache["active"]
+                pos = positions[:, 0]
+                page_ids = jnp.where(active,
+                                     pt[jnp.arange(b), pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k[:, 0].astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v[:, 0].astype(v_pages.dtype), mode="drop")
+                out = paged_decode_attention(q, k_pages, v_pages, pt, pos)
+            new_cache = {"k_pages": k_pages, "v_pages": v_pages}
+        elif cache is not None:
             # decode: append k/v at cache["index"], attend over valid prefix
             k_cache = lax.dynamic_update_slice(
                 cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0))
@@ -177,8 +215,17 @@ class Llama(nn.Module):
                  cache=None):
         cfg = self.cfg
         b, l = input_ids.shape
+        paged = cache is not None and "page_table" in cache
         if positions is None:
-            if cache is not None:
+            if paged:
+                lens = cache["lengths"]
+                if "slot" in cache:      # chunked prefill (b == 1)
+                    positions = (lens[cache["slot"]] +
+                                 jnp.arange(l))[None, :]
+                else:                    # continuous-batch decode (l == 1)
+                    positions = lens[:, None]
+                positions = jnp.broadcast_to(positions, (b, l))
+            elif cache is not None:
                 start = cache["layers"][0]["index"]
                 positions = start + jnp.arange(l)[None, :]
                 positions = jnp.broadcast_to(positions, (b, l))
@@ -198,16 +245,35 @@ class Llama(nn.Module):
         new_layer_caches = []
         for i in range(cfg.num_layers):
             layer_cache = cache["layers"][i] if cache is not None else None
+            if paged:
+                layer_cache = dict(layer_cache,
+                                   page_table=cache["page_table"])
+                for key in ("slot", "n_valid", "active"):
+                    if key in cache:
+                        layer_cache[key] = cache[key]
             x, new_c = block(cfg, name=f"layers_{i}")(x, positions,
                                                       layer_cache)
             new_layer_caches.append(new_c)
 
+        if paged and "slot" in cache:
+            # chunked prefill consumes ONLY the boundary row — skip the
+            # full-vocab head for the chunk's other positions
+            x = lax.dynamic_slice_in_dim(x, cache["n_valid"] - 1, 1, axis=1)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm")(x)
         if cfg.tie_embeddings:
             logits = jnp.einsum("ble,ve->blv", x, embed_v.astype(cfg.dtype))
         else:
             logits = _proj(cfg, cfg.vocab_size, ("embed", "vocab"),
                            "lm_head")(x)
+        if paged:
+            if "slot" in cache:
+                lengths = cache["lengths"].at[cache["slot"]].add(
+                    cache["n_valid"])
+            else:
+                lengths = cache["lengths"] + \
+                    cache["active"].astype(jnp.int32)
+            return logits, dict(cache, lengths=lengths,
+                                layers=new_layer_caches)
         if cache is not None:
             return logits, {"layers": new_layer_caches}
         return logits
@@ -223,6 +289,19 @@ def init_kv_cache(cfg: LlamaConfig, batch_size, max_len=None,
         "v": jnp.zeros((batch_size, max_len, cfg.num_kv_heads, cfg.head_dim),
                        dtype),
         "index": jnp.int32(0),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}
+
+
+def init_paged_kv_cache(cfg: LlamaConfig, num_pages, page_size,
+                        dtype=jnp.bfloat16):
+    """Per-layer paged KV pools (serving/ subsystem) — GQA pools are
+    sized to num_kv_heads and stay grouped through the paged kernel."""
+    layer = lambda: {
+        "k_pages": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
+                              cfg.head_dim), dtype),
+        "v_pages": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
+                              cfg.head_dim), dtype),
     }
     return {"layers": [layer() for _ in range(cfg.num_layers)]}
 

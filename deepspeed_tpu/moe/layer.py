@@ -91,6 +91,7 @@ class MoE(nn.Module):
     min_capacity: int = 4
     drop_tokens: bool = True
     use_residual: bool = False           # PR-MoE
+    use_rts: bool = False                # Random Token Selection (top-1)
     noisy_gate_policy: Optional[str] = None
     activation: Callable = nn.gelu
     dtype: Any = jnp.float32
@@ -111,14 +112,22 @@ class MoE(nn.Module):
         logits = tokens.astype(jnp.float32) @ gate_w
 
         rng = None
-        if self.noisy_gate_policy == "RSample" and not deterministic:
+        # RTS keys off rng AVAILABILITY, not `deterministic`: the engine's
+        # default loss applies modules with flax's deterministic default
+        # but threads a "gating" rng, and RTS must work there (a
+        # deterministic-only gate would make the config flag a silent
+        # no-op through deepspeed_tpu.initialize). k=2 never uses it.
+        use_rts = self.use_rts and self.k == 1 and self.has_rng("gating")
+        if use_rts or (self.noisy_gate_policy == "RSample" and
+                       not deterministic):
             rng = self.make_rng("gating")
         cf = self.capacity_factor if not deterministic \
             else self.eval_capacity_factor
         l_aux, combine, dispatch, exp_counts = sharded_moe.gate(
             logits, k=self.k, capacity_factor=cf,
             min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
-            **({"noisy_gate_policy": self.noisy_gate_policy, "rng": rng}
+            **({"noisy_gate_policy": self.noisy_gate_policy, "rng": rng,
+                "use_rts": use_rts}
                if self.k == 1 else {}))
 
         dispatched = sharded_moe.dispatch_tokens(dispatch, tokens)  # [e,c,m]

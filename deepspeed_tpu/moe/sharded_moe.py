@@ -30,12 +30,20 @@ def _one_hot(idx, n):
 
 
 def top1_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
-                noisy_gate_policy=None, rng=None, used_token_mask=None):
+                noisy_gate_policy=None, rng=None, used_token_mask=None,
+                use_rts=False):
     """Top-1 gating (reference top1gating, sharded_moe.py:179).
 
     logits: [s, e] raw gate scores (fp32 recommended).
     Returns (l_aux, combine_weights [s,e,c], dispatch_mask [s,e,c] bool,
     exp_counts [e]).
+
+    ``use_rts`` (Random Token Selection, reference sharded_moe.py
+    ``use_rts``): when an expert is over capacity, the kept subset is
+    chosen by random priority instead of strictly by queue position —
+    without it, tokens late in the sequence are ALWAYS the ones dropped,
+    a systematic bias RTS removes. Needs ``rng``; queue positions of the
+    surviving tokens are re-compacted so capacity slots stay dense.
     """
     s, e = logits.shape
     cap = capacity(s, e, capacity_factor, min_capacity) if drop_tokens else s
@@ -51,8 +59,6 @@ def top1_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
     if used_token_mask is not None:                          # padding tokens
         mask1 = mask1 * used_token_mask[:, None]
 
-    # position of each token within its expert's queue
-    locations1 = jnp.cumsum(mask1, axis=0) - mask1           # [s, e]
     exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)    # [e]
 
     # load-balancing loss (reference :232): mean gate mass x mean routed
@@ -61,7 +67,20 @@ def top1_gating(logits, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
     ce = jnp.mean(mask1, axis=0)
     l_aux = jnp.sum(me * ce) * e
 
-    if drop_tokens:
+    if drop_tokens and use_rts:
+        assert rng is not None, "use_rts needs an rng"
+        # random priority per (token, expert); unrouted rows rank last.
+        # rank-within-expert via double argsort (the reference's
+        # _top_idx scatter expressed densely), then keep rank < cap and
+        # re-compact queue positions over the survivors.
+        prio = jnp.where(mask1 > 0,
+                         jax.random.uniform(rng, mask1.shape, jnp.float32),
+                         -1.0)
+        order = jnp.argsort(-prio, axis=0)
+        ranks = jnp.argsort(order, axis=0)
+        mask1 = mask1 * (ranks < cap)
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1           # [s, e]
+    if drop_tokens and not use_rts:
         mask1 = mask1 * (locations1 < cap)
     locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)  # [s]
 
@@ -139,6 +158,7 @@ def gate(logits, k=1, **kw):
         return top1_gating(logits, **kw)
     if k == 2:
         kw.pop("noisy_gate_policy", None)
+        kw.pop("use_rts", None)       # RTS is a top-1 drop policy
         return top2_gating(logits, **kw)
     raise ValueError(f"k={k} not supported (reference supports 1 and 2)")
 

@@ -9,7 +9,9 @@ from deepspeed_tpu.ops.attention.reference import (apply_rotary_emb,  # noqa: F4
                                                    decode_attention_reference,
                                                    mha_reference)
 from deepspeed_tpu.ops.attention.flash import flash_attention  # noqa: F401
-from deepspeed_tpu.ops.attention.decode import decode_attention  # noqa: F401
+from deepspeed_tpu.ops.attention.decode import (decode_attention,  # noqa: F401
+                                                gather_pages,
+                                                paged_decode_attention)
 from deepspeed_tpu.ops.attention.ring import (ring_attention_local,  # noqa: F401
                                               ring_attention_sharded)
 from deepspeed_tpu.ops.attention.ulysses import (  # noqa: F401

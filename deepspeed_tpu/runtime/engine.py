@@ -413,7 +413,10 @@ class DeepSpeedEngine:
             rngs = None
             kw = {}
             if rng is not None:
-                rngs = {"dropout": rng}
+                # "gating" feeds MoE's stochastic drop policies (RTS /
+                # RSample); unused rngs are free in flax
+                rngs = {"dropout": rng,
+                        "gating": jax.random.fold_in(rng, 3)}
             if pld_theta is not None:   # progressive layer drop active
                 r = rng if rng is not None else jax.random.PRNGKey(0)
                 rngs = dict(rngs or {})

@@ -1,0 +1,12 @@
+"""Continuous-batching serving layer with a paged KV cache.
+
+See serving/README.md for the page-table layout and the scheduler loop.
+"""
+
+from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from deepspeed_tpu.serving.page_manager import (PagedKVManager,  # noqa: F401
+                                                PagePool,
+                                                PagePoolExhausted)
+from deepspeed_tpu.serving.scheduler import (QueueFull,  # noqa: F401
+                                             Request,
+                                             ServingScheduler)

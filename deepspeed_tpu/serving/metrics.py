@@ -1,0 +1,83 @@
+"""Serving observability: TTFT / per-token latency / queue and pool
+gauges, emitted as ``(tag, value, step)`` events through the existing
+``monitor/`` path (MonitorMaster.write_events) so serving metrics land in
+the same TensorBoard/WandB/CSV sinks as training metrics."""
+
+import numpy as np
+
+
+def _percentile(values, q):
+    return float(np.percentile(np.asarray(values, np.float64), q)) \
+        if values else 0.0
+
+
+class ServingMetrics:
+    """Aggregates per-request latency samples and per-step gauges."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor        # MonitorMaster-compatible (or None)
+        self.ttft_s = []              # submit -> first token, per request
+        self.tpot_s = []              # inter-token gaps, per token
+        self.completed = 0
+        self.preemptions = 0
+        self.tokens_emitted = 0
+        self.page_util = []           # pool utilization per step
+        self.queue_depths = []
+        self._events = []
+
+    # ---------------------------------------------------------- recording
+    def record_step(self, step, *, queue_depth, running, waiting,
+                    page_utilization):
+        self.page_util.append(page_utilization)
+        self.queue_depths.append(queue_depth)
+        self._events = [
+            ("serving/queue_depth", queue_depth, step),
+            ("serving/running", running, step),
+            ("serving/waiting", waiting, step),
+            ("serving/page_utilization", page_utilization, step),
+        ]
+        if self.monitor is not None:
+            self.monitor.write_events(self._events)
+
+    def record_first_token(self, step, ttft_s):
+        self.ttft_s.append(ttft_s)
+        self.tokens_emitted += 1
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("serving/ttft_ms", ttft_s * 1e3, step)])
+
+    def record_token(self, step, gap_s):
+        self.tpot_s.append(gap_s)
+        self.tokens_emitted += 1
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("serving/token_latency_ms", gap_s * 1e3, step)])
+
+    def record_completion(self, step):
+        self.completed += 1
+
+    def record_preemption(self, step):
+        self.preemptions += 1
+
+    # ----------------------------------------------------------- summary
+    def summary(self, wall_s=None):
+        out = {
+            "completed": self.completed,
+            "tokens_emitted": self.tokens_emitted,
+            "preemptions": self.preemptions,
+            "ttft_ms_p50": round(_percentile(self.ttft_s, 50) * 1e3, 3),
+            "ttft_ms_p90": round(_percentile(self.ttft_s, 90) * 1e3, 3),
+            "ttft_ms_p99": round(_percentile(self.ttft_s, 99) * 1e3, 3),
+            "tpot_ms_p50": round(_percentile(self.tpot_s, 50) * 1e3, 3),
+            "tpot_ms_p90": round(_percentile(self.tpot_s, 90) * 1e3, 3),
+            "tpot_ms_p99": round(_percentile(self.tpot_s, 99) * 1e3, 3),
+            "page_util_mean": round(float(np.mean(self.page_util)), 4)
+            if self.page_util else 0.0,
+            "page_util_peak": round(float(np.max(self.page_util)), 4)
+            if self.page_util else 0.0,
+            "queue_depth_peak": int(np.max(self.queue_depths))
+            if self.queue_depths else 0,
+        }
+        if wall_s:
+            out["tokens_per_sec"] = round(self.tokens_emitted / wall_s, 2)
+        return out

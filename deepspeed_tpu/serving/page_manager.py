@@ -1,0 +1,140 @@
+"""Paged KV-cache management (host side).
+
+The design is vLLM/PagedAttention (SOSP '23) adapted to the TPU serving
+stack: device memory holds ONE preallocated pool of fixed-size KV pages
+per layer (``models/*.init_paged_kv_cache``); which pages belong to which
+sequence is pure host bookkeeping — a free list plus a per-slot page
+table.  Allocation granularity is a page (``page_size`` tokens), so the
+worst-case internal fragmentation is ``page_size - 1`` tokens per live
+sequence and external fragmentation is zero by construction.
+
+The device never sees this class: the scheduler passes ``table`` /
+lengths as small int32 inputs into the fixed-shape jitted primitives
+(``InferenceEngine.prefill_into_slots`` / ``decode_step``), so request
+churn never changes a jit signature.
+"""
+
+import numpy as np
+
+
+class PagePoolExhausted(RuntimeError):
+    """Raised when a required allocation cannot be satisfied even after
+    the caller's eviction policy ran out of victims."""
+
+
+class PagePool:
+    """Fixed pool of fixed-size cache pages with a free list and
+    allocation accounting (the reference counterpart is vLLM's
+    BlockAllocator)."""
+
+    def __init__(self, num_pages, page_size):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently freed pages are re-used first (their
+        # pool slices are most likely still warm in cache hierarchies)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._allocated = set()
+        self.peak_in_use = 0
+        self.total_allocs = 0
+        self.total_frees = 0
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def pages_in_use(self):
+        return self.num_pages - len(self._free)
+
+    def can_allocate(self, n):
+        return n <= len(self._free)
+
+    def allocate(self, n):
+        """Take ``n`` pages off the free list; raises PagePoolExhausted
+        if fewer are free (callers gate with can_allocate / evict)."""
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"({self.pages_in_use}/{self.num_pages} in use)")
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        self.total_allocs += n
+        self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
+        return pages
+
+    def free(self, pages):
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(f"double free / foreign page {p}")
+            self._allocated.remove(p)
+            self._free.append(p)
+        self.total_frees += len(pages)
+
+    def utilization(self):
+        return self.pages_in_use / self.num_pages
+
+    def pages_for_tokens(self, num_tokens):
+        """Pages needed to hold ``num_tokens`` cache entries."""
+        return -(-int(num_tokens) // self.page_size)
+
+
+class PagedKVManager:
+    """Per-slot page tables over one PagePool.
+
+    ``table`` is the [num_slots, max_pages_per_slot] int32 array handed
+    to the jitted decode/prefill primitives each step.  Unassigned
+    entries stay 0 — a *valid* page id, because gathers must stay in
+    bounds; the attention mask (driven by lengths) hides them.
+    """
+
+    def __init__(self, num_pages, page_size, num_slots, max_pages_per_slot):
+        self.pool = PagePool(num_pages, page_size)
+        self.num_slots = int(num_slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.table = np.zeros((num_slots, max_pages_per_slot), np.int32)
+        self._slot_pages = [[] for _ in range(num_slots)]
+
+    @property
+    def page_size(self):
+        return self.pool.page_size
+
+    def max_tokens_per_slot(self):
+        return self.max_pages_per_slot * self.pool.page_size
+
+    def slot_page_count(self, slot):
+        return len(self._slot_pages[slot])
+
+    def ensure_capacity(self, slot, target_len):
+        """Grow ``slot``'s table until positions < target_len are
+        writable. Returns True on success; False when the pool is out of
+        pages (caller decides eviction).  Raises when target_len exceeds
+        the per-slot table (a config error, not a transient)."""
+        needed = self.pool.pages_for_tokens(target_len)
+        if needed > self.max_pages_per_slot:
+            raise ValueError(
+                f"sequence of {target_len} tokens needs {needed} pages > "
+                f"max_pages_per_slot={self.max_pages_per_slot}")
+        have = len(self._slot_pages[slot])
+        if needed <= have:
+            return True
+        if not self.pool.can_allocate(needed - have):
+            return False
+        new = self.pool.allocate(needed - have)
+        for i, p in enumerate(new):
+            self.table[slot, have + i] = p
+        self._slot_pages[slot].extend(new)
+        return True
+
+    def release_slot(self, slot):
+        """Return all of a slot's pages to the pool (sequence retired or
+        preempted)."""
+        pages = self._slot_pages[slot]
+        self.pool.free(pages)
+        self._slot_pages[slot] = []
+        self.table[slot, :] = 0
+        return len(pages)
+
+    def utilization(self):
+        return self.pool.utilization()

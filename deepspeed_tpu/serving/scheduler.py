@@ -1,0 +1,294 @@
+"""Iteration-level (continuous-batching) scheduler.
+
+Orca (OSDI '22) scheduling over the paged KV cache: requests join and
+leave the running batch at token granularity instead of batch
+granularity.  Each ``step()`` is one scheduler iteration:
+
+  1. retire finished slots and recycle their pages,
+  2. admit waiting requests into free slots (admission control: the pool
+     must be able to hold the whole prompt),
+  3. advance every admitted-but-unprefilled slot by ONE prompt chunk
+     (chunked prefill — long prompts never stall running decoders for
+     more than a chunk),
+  4. run ONE fixed-shape decode step over all running slots,
+  5. emit observability events.
+
+All device work goes through the two jit-stable primitives on
+``InferenceEngine`` (``prefill_into_slots`` / ``decode_step``); the
+scheduler itself is pure host logic.  When the page pool runs dry the
+youngest running request is preempted (recompute-style eviction: its
+pages recycle, the request re-queues at the queue head with its
+already-emitted tokens folded into the prompt).
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.page_manager import (PagedKVManager,
+                                                PagePoolExhausted)
+
+WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
+    "finished"
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the waiting queue is at max_queue."""
+
+
+class Request:
+    """One generation request flowing through the scheduler."""
+
+    _next_id = 0
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id=None,
+                 on_token=None, rid=None):
+        if rid is None:
+            rid = Request._next_id
+            Request._next_id += 1
+        self.rid = rid
+        self.orig_prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.prompt = list(self.orig_prompt)   # grows on preemption
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.out_tokens = []
+        self.state = WAITING
+        self.prefill_pos = 0
+        self.t_submit = time.time()
+        self.t_admit = None
+        self.t_first = None
+        self.t_last = None
+
+    @property
+    def remaining_new(self):
+        return self.max_new_tokens - len(self.out_tokens)
+
+    def _finished_by(self, tok):
+        return (self.eos_token_id is not None and
+                tok == self.eos_token_id) or self.remaining_new <= 0
+
+
+class ServingScheduler:
+    """Continuous-batching serving loop over an ``InferenceEngine``."""
+
+    def __init__(self, engine, *, num_slots=8, num_pages=64, page_size=None,
+                 max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
+                 monitor=None, do_sample=False, temperature=1.0, top_k=0,
+                 top_p=1.0):
+        if page_size is None:
+            # the paged Pallas decode kernel needs 128-multiple pages
+            # (TPU lane tiling); anything smaller silently drops every
+            # decode step to the gather fallback. Off-TPU the gather
+            # fallback runs regardless, so small pages (finer-grained
+            # pool sharing) are the better default there.
+            import jax
+            page_size = 128 if jax.default_backend() == "tpu" else 16
+        self.engine = engine
+        self.num_slots = int(num_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_queue = int(max_queue)
+        if max_pages_per_slot is None:
+            max_pages_per_slot = -(-num_pages // 2) or 1
+        self.kv = PagedKVManager(num_pages, page_size, num_slots,
+                                 max_pages_per_slot)
+        self.pools = engine.init_paged_cache(num_pages, page_size)
+        self.lengths = np.zeros(num_slots, np.int32)
+        self.last_tok = np.zeros(num_slots, np.int32)
+        self.slot_req = [None] * num_slots
+        self.waiting = deque()
+        self.requests = []
+        self.metrics = ServingMetrics(monitor)
+        self.step_idx = 0
+        self.sampling = dict(do_sample=do_sample, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               on_token=None):
+        """Queue a request; raises :class:`QueueFull` at max_queue (the
+        backpressure signal callers turn into 429/retry)."""
+        if len(self.waiting) >= self.max_queue:
+            raise QueueFull(
+                f"waiting queue at max_queue={self.max_queue}")
+        need = len(np.asarray(prompt).reshape(-1)) + int(max_new_tokens)
+        cap = min(self.kv.max_tokens_per_slot(),
+                  self.kv.pool.num_pages * self.kv.page_size)
+        if need > cap:
+            raise ValueError(
+                f"request of {need} tokens exceeds per-slot capacity {cap} "
+                "(min(max_pages_per_slot, num_pages) * page_size)")
+        req = Request(prompt, max_new_tokens, eos_token_id, on_token)
+        self.requests.append(req)
+        if req.max_new_tokens <= 0:
+            # parity with generate(max_new_tokens=0): nothing to emit
+            req.state = FINISHED
+            return req
+        self.waiting.append(req)
+        return req
+
+    # --------------------------------------------------------- accounting
+    def _emit(self, req, tok):
+        now = time.time()
+        tok = int(tok)
+        req.out_tokens.append(tok)
+        if req.t_first is None:
+            req.t_first = now
+            self.metrics.record_first_token(self.step_idx,
+                                            now - req.t_submit)
+        else:
+            self.metrics.record_token(self.step_idx, now - req.t_last)
+        req.t_last = now
+        if req.on_token is not None:
+            req.on_token(req, tok)
+
+    def _retire(self, slot):
+        req = self.slot_req[slot]
+        self.kv.release_slot(slot)
+        self.slot_req[slot] = None
+        self.lengths[slot] = 0
+        req.state = FINISHED
+        self.metrics.record_completion(self.step_idx)
+
+    def _preempt_youngest(self, protect=None):
+        """Evict the most recently admitted live request (vLLM's
+        recompute preemption), re-queueing it at the queue head. Returns
+        the freed slot or None if there was nothing to evict."""
+        candidates = [s for s in range(self.num_slots)
+                      if self.slot_req[s] is not None and s != protect]
+        if not candidates:
+            candidates = [protect] if protect is not None and \
+                self.slot_req[protect] is not None else []
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda s: self.slot_req[s].t_admit)
+        req = self.slot_req[victim]
+        self.kv.release_slot(victim)
+        self.slot_req[victim] = None
+        self.lengths[victim] = 0
+        req.state = WAITING
+        req.prompt = req.orig_prompt + req.out_tokens
+        req.prefill_pos = 0
+        self.waiting.appendleft(req)
+        self.metrics.record_preemption(self.step_idx)
+        return victim
+
+    def _grow_or_evict(self, slot, target_len):
+        """ensure_capacity with the eviction policy behind it. Returns
+        False when ``slot`` itself was preempted."""
+        while not self.kv.ensure_capacity(slot, target_len):
+            victim = self._preempt_youngest(protect=slot)
+            if victim is None:
+                raise PagePoolExhausted(
+                    f"cannot grow slot {slot} to {target_len} tokens: "
+                    "pool exhausted with no evictable request")
+            if victim == slot:
+                return False
+        return True
+
+    # -------------------------------------------------------------- step
+    def step(self):
+        """One scheduler iteration; returns True if any work remains."""
+        self.step_idx += 1
+
+        # 1+2. admit waiting requests into free slots (retirement happens
+        # inline as tokens are observed, so slots are already recycled)
+        for slot in range(self.num_slots):
+            if not self.waiting:
+                break
+            if self.slot_req[slot] is not None:
+                continue
+            req = self.waiting[0]
+            if not self.kv.pool.can_allocate(
+                    self.kv.pool.pages_for_tokens(len(req.prompt))):
+                break   # admission control: whole prompt must fit now
+            self.waiting.popleft()
+            self.slot_req[slot] = req
+            req.state = PREFILL
+            req.t_admit = time.time()
+            self.lengths[slot] = 0
+
+        # 3. one prompt chunk per prefilling slot (chunked prefill)
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None or req.state != PREFILL:
+                continue
+            chunk = req.prompt[req.prefill_pos:
+                               req.prefill_pos + self.prefill_chunk]
+            n_valid = len(chunk)
+            if not self._grow_or_evict(slot, req.prefill_pos + n_valid):
+                continue      # self-preempted: back in the queue
+            ids = np.zeros((1, self.prefill_chunk), np.int32)
+            ids[0, :n_valid] = chunk
+            logits, self.pools = self.engine.prefill_into_slots(
+                ids, slot, n_valid, self.kv.table, self.lengths, self.pools)
+            self.lengths[slot] += n_valid
+            req.prefill_pos += n_valid
+            if req.prefill_pos == len(req.prompt):
+                tok = self.engine.sample_from_logits(logits, **self.sampling)
+                self._emit(req, tok)
+                if req._finished_by(tok):
+                    self._retire(slot)
+                else:
+                    self.last_tok[slot] = tok
+                    req.state = RUNNING
+
+        # 4. one decode step over every running slot
+        candidates = [s for s in range(self.num_slots)
+                      if self.slot_req[s] is not None and
+                      self.slot_req[s].state == RUNNING]
+        kept = []
+        for slot in candidates:
+            if self.slot_req[slot] is None or \
+                    self.slot_req[slot].state != RUNNING:
+                continue   # evicted by an earlier slot's growth
+            # the pending token writes at position lengths[slot] — make
+            # sure its page exists (this is where decode-time growth and
+            # eviction happen)
+            if self._grow_or_evict(slot, int(self.lengths[slot]) + 1):
+                kept.append(slot)
+        # a later slot's growth can evict an earlier kept slot too
+        running = [s for s in kept if self.slot_req[s] is not None and
+                   self.slot_req[s].state == RUNNING]
+        if running:
+            active = np.zeros(self.num_slots, bool)
+            active[running] = True
+            toks, self.pools = self.engine.decode_step(
+                self.last_tok, active, self.kv.table, self.lengths,
+                self.pools, **self.sampling)
+            toks = np.asarray(toks)
+            self.lengths[running] += 1
+            for slot in running:
+                req = self.slot_req[slot]
+                tok = int(toks[slot])
+                self._emit(req, tok)
+                if req._finished_by(tok):
+                    self._retire(slot)
+                else:
+                    self.last_tok[slot] = tok
+
+        # 5. observability
+        n_running = sum(r is not None for r in self.slot_req)
+        self.metrics.record_step(
+            self.step_idx, queue_depth=len(self.waiting),
+            running=n_running, waiting=len(self.waiting),
+            page_utilization=self.kv.utilization())
+        return bool(self.waiting) or n_running > 0
+
+    def run(self, max_steps=100000):
+        """Drive step() until idle; returns {rid: generated tokens}."""
+        t0 = time.time()
+        for _ in range(max_steps):
+            if not self.step():
+                break
+        self._wall_s = time.time() - t0
+        # max_steps exhausted with live work is a legitimate outcome (a
+        # bounded drain): finished requests are returned, the rest stay
+        # queued/running for further step() calls
+        return {r.rid: list(r.out_tokens) for r in self.requests
+                if r.state == FINISHED}
+
+    def summary(self):
+        return self.metrics.summary(getattr(self, "_wall_s", None))

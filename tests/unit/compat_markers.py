@@ -1,0 +1,24 @@
+"""Shared skipif markers for runtime capabilities this rig may lack.
+
+One definition for the predicates that gate environment-bound tests, so
+a probe change lands in one place (see deepspeed_tpu/utils/jax_compat.py
+for the underlying detection).
+"""
+
+import pytest
+
+from deepspeed_tpu.utils import jax_compat
+
+# this runtime's CPU devices may expose only unpinned_host memory; the
+# ZeRO-3 param-offload tier pins host memory by design (pinned_host), so
+# its residency tests need a runtime/backend with that memory space
+needs_pinned_host = pytest.mark.skipif(
+    not jax_compat.pinned_host_available(),
+    reason="device exposes no pinned_host memory space")
+
+# jax<0.5 CPU backend has no multiprocess collectives ("Multiprocess
+# computations aren't implemented on the CPU backend"), so true
+# multi-process rendezvous + allreduce only runs on current jax
+mp_collectives = pytest.mark.skipif(
+    jax_compat.LEGACY_SHARD_MAP,
+    reason="CPU multiprocess collectives need jax>=0.5")

@@ -11,6 +11,10 @@ import sys
 
 import pytest
 
+from tests.unit.compat_markers import mp_collectives
+
+
+
 from deepspeed_tpu.launcher.runner import fetch_hostfile, parse_args
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -71,6 +75,7 @@ def test_ds_bench_cli():
 
 
 @pytest.mark.parametrize("nproc", [2])
+@mp_collectives
 def test_cli_two_process_rendezvous_and_allreduce(tmp_path, nproc):
     """Spawn 2 real processes through the CLI; they rendezvous via
     jax.distributed and jointly reduce a sharded array."""

@@ -19,7 +19,10 @@ def main():
         counts = re.findall(r"host_platform_device_count=(\d+)",
                             os.environ.get("XLA_FLAGS", ""))
         if counts:  # last occurrence wins, like XLA's own flag parsing
-            jax.config.update("jax_num_cpu_devices", int(counts[-1]))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(counts[-1]))
+            except AttributeError:
+                pass   # jax<0.5: XLA_FLAGS already carries the count
 
     import jax.numpy as jnp
     import numpy as np

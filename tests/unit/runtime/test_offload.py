@@ -11,7 +11,11 @@ import numpy as np
 import optax
 import pytest
 
+from tests.unit.compat_markers import needs_pinned_host
+
 import deepspeed_tpu
+
+
 from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
 
 from tests.unit.simple_model import (SimpleModel, random_regression_data,
@@ -290,6 +294,7 @@ def param_offload_config(**over):
     return cfg
 
 
+@needs_pinned_host
 def test_param_offload_at_rest_on_host():
     """offload_param: between steps every param leaf lives in pinned host
     memory (reference stage3.py:445-480 — params on CPU, fetched per
@@ -303,6 +308,7 @@ def test_param_offload_at_rest_on_host():
     assert jax.tree.leaves(engine.state.opt_state) == []
 
 
+@needs_pinned_host
 def test_param_offload_matches_optimizer_only_offload():
     """Param residency must not change the numerics: identical trajectory
     to plain optimizer-state offload."""
@@ -314,6 +320,7 @@ def test_param_offload_matches_optimizer_only_offload():
     np.testing.assert_allclose(l_opt, l_par, rtol=1e-6)
 
 
+@needs_pinned_host
 def test_param_offload_implies_host_optimizer():
     """offload_param alone must still engage the host-optimizer tier (the
     config key must not be silently ignored — VERDICT r2 missing #1)."""
@@ -368,6 +375,7 @@ def test_nvme_param_tier_trains_and_keeps_ram_bounded(tmp_path):
         (tier.peak_buffer_bytes, total_bytes)
 
 
+@needs_pinned_host
 def test_nvme_param_tier_matches_cpu_offload_trajectory(tmp_path):
     """The tier must not change numerics: identical losses to the
     pinned-host param offload path."""
@@ -471,6 +479,7 @@ def test_param_offload_requires_stage3():
     assert not engine._offload_param  # warned + ignored below stage 3
 
 
+@needs_pinned_host
 def test_param_offload_checkpoint_and_eval(tmp_path):
     engine = make_engine(param_offload_config())
     batch = random_regression_data(n=32)

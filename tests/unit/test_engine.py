@@ -208,14 +208,79 @@ def test_gradient_accumulation():
     assert engine.global_steps == step0 + 1
 
 
-def test_fp16_dynamic_loss_scale_overflow_recovery():
-    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 32,
-                            "loss_scale_window": 100, "hysteresis": 1})
-    engine = make_engine(cfg)
-    losses = train_steps(engine, n=20)
-    assert engine.skipped_steps > 0
-    assert engine.loss_scale < 2 ** 32
-    assert losses[-1] < losses[0]
+def test_fp16_dynamic_loss_scale_overflow_sequence_gas2():
+    """Induced-overflow sequence with EXACT skip counts and scale
+    dynamics under gradient accumulation (gas=2).
+
+    The model's gradient is the constant 3.0 per element, so the fp16
+    cotangent at the param-cast boundary is scale * 3 / gas — it
+    overflows fp16 iff scale >= 2**16 (65536 * 1.5 > 65504 > 32768 *
+    1.5). With initial scale 2**17, window 2, hysteresis 1 the whole
+    trajectory is determined:
+
+      w1: 2**17 ovf -> skip, halve    w5: 65536 ovf -> skip, halve
+      w2: 65536 ovf -> skip, halve    w6: 32768 ok
+      w3: 32768 ok                    w7: ok -> grow to 65536
+      w4: ok -> grow to 65536         w8: 65536 ovf -> skip, halve
+    """
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class ConstGradModel(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("w", nn.initializers.zeros_init(), (4,))
+            return w
+
+    model = ConstGradModel()
+
+    def loss_fn(params, batch, rng):
+        w = model.apply({"params": params}, batch["x"])
+        # mean over rows of sum(w * row); rows are the constant 3.0, so
+        # dloss/dw = 3.0 exactly, every step
+        return jnp.mean(jnp.sum(w[None, :] * batch["x"], axis=1))
+
+    cfg = base_config(gradient_accumulation_steps=2, train_batch_size=64,
+                      fp16={"enabled": True, "initial_scale_power": 17,
+                            "loss_scale_window": 2, "hysteresis": 1})
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                          loss_fn=loss_fn)
+    batch = {"x": np.full((32, 4), 3.0, np.float32)}
+
+    def window():
+        for _ in range(2):      # gas=2 micro steps per optimizer step
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            engine.step()
+        params = jax.device_get(jax.tree.leaves(engine.state.params)[0])
+        return float(engine.loss_scale), int(engine.skipped_steps), params
+
+    expected = [
+        (2 ** 16, 1),   # w1: 2**17 overflowed, halved
+        (2 ** 15, 2),   # w2: 65536 overflowed, halved
+        (2 ** 15, 2),   # w3: good step, mid-window -> scale unchanged
+        (2 ** 16, 2),   # w4: good step, window hit -> grew
+        (2 ** 15, 3),   # w5: 65536 overflows again
+        (2 ** 15, 3),   # w6: good, mid-window
+        (2 ** 16, 3),   # w7: grew
+        (2 ** 15, 4),   # w8: overflow, halved
+    ]
+    prev_w = np.zeros(4, np.float32)   # zeros_init
+    for i, (want_scale, want_skips) in enumerate(expected):
+        scale, skips, w = window()
+        assert scale == want_scale, \
+            f"window {i + 1}: scale {scale}, want {want_scale}"
+        assert skips == want_skips, \
+            f"window {i + 1}: skipped {skips}, want {want_skips}"
+        moved = bool(np.abs(w - prev_w).max() > 0)
+        overflowed = want_skips > (expected[i - 1][1] if i else 0)
+        assert moved != overflowed, \
+            f"window {i + 1}: params {'moved' if moved else 'froze'} on " \
+            f"{'overflow' if overflowed else 'good'} step"
+        prev_w = w
+    # gas accounting: 8 windows of 2 micro steps, 4 skipped updates
+    assert engine.global_steps == 8
+    assert int(engine.skipped_steps) == 4
 
 
 def test_fp16_scale_grows_after_window():

@@ -115,3 +115,59 @@ def test_moe_gpt2_trains_expert_parallel(zero_stage):
     moe_wi = engine.state.params["h_1"]["moe"]["experts"]["wi"]
     spec = moe_wi.sharding.spec
     assert "expert" in str(spec), f"expert axis not in sharding: {spec}"
+
+
+def test_top1_rts_randomizes_overcapacity_drops():
+    """Random Token Selection (reference sharded_moe.py use_rts): when an
+    expert is over capacity, the kept subset varies with the rng instead
+    of always being the first `cap` tokens in sequence order."""
+    s, e = 32, 2
+    # every token routes to expert 0 -> heavily over capacity
+    logits = jnp.stack([jnp.ones(s), jnp.zeros(s)], axis=1) * 10.0
+    cap = capacity(s, e, 0.25, 2)
+    assert cap < s
+
+    # without RTS: strictly the first `cap` tokens survive
+    _, _, disp, _ = top1_gating(logits, capacity_factor=0.25, min_capacity=2)
+    kept = np.asarray(disp).any(axis=(1, 2))
+    assert kept.sum() == cap
+    assert kept[:cap].all() and not kept[cap:].any()
+
+    # with RTS: still exactly `cap` survivors, but the subset depends on
+    # the rng (and differs from strict queue order for some seed)
+    kept_sets = []
+    for seed in range(4):
+        _, _, disp, _ = top1_gating(logits, capacity_factor=0.25,
+                                    min_capacity=2, use_rts=True,
+                                    rng=jax.random.PRNGKey(seed))
+        k = np.asarray(disp).any(axis=(1, 2))
+        assert k.sum() == cap
+        kept_sets.append(tuple(np.nonzero(k)[0]))
+    assert len(set(kept_sets)) > 1, "RTS produced identical drops " \
+        "across seeds (not random)"
+    assert any(ks != tuple(range(cap)) for ks in kept_sets)
+
+    # capacity slots stay dense: each survivor gets a unique slot < cap
+    _, _, disp, _ = top1_gating(logits, capacity_factor=0.25,
+                                min_capacity=2, use_rts=True,
+                                rng=jax.random.PRNGKey(0))
+    slots = np.asarray(disp)[:, 0, :]          # expert 0's [s, c] mask
+    assert slots.sum(axis=0).max() <= 1        # no slot double-booked
+    assert slots.any(axis=0).sum() == cap      # all cap slots used
+
+
+def test_moe_layer_rts_flag_smoke():
+    """use_rts threads through the MoE layer (needs the 'gating' rng) and
+    keeps forward shapes; deterministic mode ignores it."""
+    m = MoE(hidden_size=16, num_experts=4, ffn_hidden_size=32, k=1,
+            capacity_factor=0.5, use_rts=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = m.init({"params": jax.random.PRNGKey(1),
+                     "gating": jax.random.PRNGKey(2)}, x,
+                    deterministic=False)
+    out, l_aux, counts = m.apply(params, x, deterministic=False,
+                                 rngs={"gating": jax.random.PRNGKey(3)})
+    assert out.shape == x.shape
+    # eval path: no rng needed, RTS inert
+    out_eval, _, _ = m.apply(params, x, deterministic=True)
+    assert out_eval.shape == x.shape

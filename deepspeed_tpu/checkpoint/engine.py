@@ -39,12 +39,21 @@ import json
 import os
 import threading
 import zipfile
+import zlib
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.resilience import faults
+
 _META = "checkpoint_meta.json"
 _FORMAT = 2
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed an integrity check (CRC mismatch, truncated
+    shard file, missing chunk coverage). Callers roll back to an older
+    intact tag rather than restoring partial/garbage state."""
 
 
 def _flatten_named(tree):
@@ -78,7 +87,11 @@ def _full_index(shape):
 
 def _write_npz_streaming(path, chunk_iter):
     """Write an .npz one entry at a time (np.savez holds everything in
-    memory at once; a checkpoint writer must stay chunk-sized)."""
+    memory at once; a checkpoint writer must stay chunk-sized). Returns
+    ``{entry_key: crc32}`` over the stored .npy member bytes — the same
+    CRC zipfile records in the central directory (ZIP_STORED), so the
+    meta-recorded value and the zip-internal value cross-check."""
+    crcs = {}
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as z:
         for key, arr in chunk_iter:
             arr = np.ascontiguousarray(arr)
@@ -95,6 +108,8 @@ def _write_npz_streaming(path, chunk_iter):
             buf = io.BytesIO()
             np.lib.format.write_array(buf, arr, allow_pickle=False)
             z.writestr(key + ".npy", buf.getvalue())
+            crcs[key] = z.getinfo(key + ".npy").CRC
+    return crcs
 
 
 def _leaf_chunks(leaf):
@@ -242,8 +257,21 @@ def save_state(path, state, client_state=None, async_write=False,
             chunks.append((f"{name}|{key}", arr))
 
     def write():
-        _write_npz_streaming(shard_file + ".tmp", chunks)
+        # fault point: a raised IOError here models a transient disk
+        # failure — the supervisor's bounded-retry save path owns it
+        faults.fire("ckpt.shard_write", path=shard_file)
+        crcs = _write_npz_streaming(shard_file + ".tmp", chunks)
         os.replace(shard_file + ".tmp", shard_file)
+        # fault point: actions here mangle the DURABLE file (truncation,
+        # bit rot) so integrity verification and rollback are testable
+        faults.fire("ckpt.shard_written", path=shard_file)
+        if jax.process_index() == 0:
+            # record per-entry CRC32s in the meta: verify_checkpoint and
+            # the loader check entry bytes end-to-end against these (the
+            # `latest` pointer only advances past this check). Process 0
+            # knows only its own entries; other hosts' entries are still
+            # covered by the zip-internal CRCs verify_checkpoint reads.
+            _merge_meta_crcs(path, crcs)
         # reclaim this process's shard files from earlier saves of the tag
         me = f"shards_p{jax.process_index():05d}."
         for fn in os.listdir(path):
@@ -265,6 +293,121 @@ def save_state(path, state, client_state=None, async_write=False,
         return writer
     write()
     return None
+
+
+def _merge_meta_crcs(path, crcs):
+    """Fold this process's entry CRCs into checkpoint_meta.json
+    (atomic rewrite; the meta body was written at save start)."""
+    meta_f = os.path.join(path, _META)
+    if not os.path.exists(meta_f):
+        return
+    with open(meta_f) as fh:
+        meta = json.load(fh)
+    merged = dict(meta.get("entry_crc32", {}))
+    merged.update({k: int(v) for k, v in crcs.items()})
+    meta["entry_crc32"] = merged
+    tmp = meta_f + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    os.replace(tmp, meta_f)
+
+
+def verify_checkpoint(path):
+    """Integrity-check one checkpoint tag directory WITHOUT restoring it.
+
+    Returns ``(ok, problems)`` where ``problems`` is a list of strings.
+    Checks, in order:
+
+    1. the meta exists and parses;
+    2. every process's shard file for the meta's ``save_id`` is present;
+    3. each shard file is a structurally valid zip and every member's
+       bytes match the zip-recorded CRC32 (catches truncation and bit
+       corruption — ``testzip`` reads every byte);
+    4. members named in the meta's ``entry_crc32`` map match it (catches
+       a shard entry wholesale replaced with differently-valid bytes);
+    5. the chunk rectangles cover every element of every leaf in the
+       meta (catches a missing/partial shard — never a silent partial
+       restore).
+
+    This is the gate the supervisor runs before advancing the ``latest``
+    pointer, and again (per candidate tag) when rolling back to the
+    newest intact tag.
+    """
+    problems = []
+    meta_f = os.path.join(path, _META)
+    if not os.path.isdir(path):
+        return False, [f"no such checkpoint directory: {path}"]
+    if not os.path.exists(meta_f):
+        if os.path.exists(os.path.join(path, "model_states.npz")):
+            return True, []     # round-1 format: no integrity metadata
+        return False, [f"missing {_META}"]
+    try:
+        with open(meta_f) as fh:
+            meta = json.load(fh)
+    except (json.JSONDecodeError, OSError) as e:
+        return False, [f"unreadable {_META}: {e}"]
+    save_id = meta.get("save_id")
+    nprocs = int(meta.get("process_count", 1))
+    meta_crcs = {k: int(v) for k, v in meta.get("entry_crc32", {}).items()}
+
+    shard_files = []
+    for fn in sorted(os.listdir(path)):
+        if not (fn.startswith("shards_p") and fn.endswith(".npz")):
+            continue
+        stem = fn[len("shards_p"):-len(".npz")]
+        _, _, fid = stem.partition(".")
+        if save_id is not None and fid != save_id:
+            continue
+        shard_files.append(fn)
+    if len(shard_files) < nprocs:
+        problems.append(
+            f"only {len(shard_files)}/{nprocs} shard files present for "
+            f"save {save_id}")
+
+    entry_crcs = {}     # member name (sans .npy) -> zip-recorded CRC
+    for fn in shard_files:
+        full = os.path.join(path, fn)
+        try:
+            with zipfile.ZipFile(full) as z:
+                bad = z.testzip()   # full read: CRC of every member
+                if bad is not None:
+                    problems.append(f"{fn}: member {bad} fails CRC")
+                for info in z.infolist():
+                    key = info.filename[:-len(".npy")] \
+                        if info.filename.endswith(".npy") else info.filename
+                    entry_crcs[key] = info.CRC
+        except (zipfile.BadZipFile, OSError) as e:
+            problems.append(f"{fn}: unreadable/truncated zip ({e})")
+    for key, want in meta_crcs.items():
+        have = entry_crcs.get(key)
+        if have is None:
+            problems.append(f"meta entry {key} missing from shard files")
+        elif have != want:
+            problems.append(
+                f"entry {key}: crc32 {have:#010x} != meta {want:#010x}")
+
+    # chunk coverage per leaf (disjoint-rectangle volume accounting, the
+    # same standard assemble() enforces at restore time)
+    for name, info in (meta.get("leaves") or {}).items():
+        shape = tuple(info.get("shape", ()))
+        want = int(np.prod(shape)) if shape else 1
+        filled = 0
+        for key in entry_crcs:
+            leaf, _, idx = key.rpartition("|")
+            if leaf != name:
+                continue
+            if not idx:
+                filled += 1
+                continue
+            vol = 1
+            for part in idx.split(","):
+                a, b = part.split(":")
+                vol *= max(0, int(b) - int(a))
+            filled += vol
+        if filled < want:
+            problems.append(
+                f"leaf {name}: chunks cover {filled}/{want} elements")
+    return not problems, problems
 
 
 class AsyncCheckpointWriter:
@@ -299,6 +442,7 @@ class _ChunkIndex:
         self.path = path
         self.by_leaf = {}      # name -> list of (index_key, file, zip_name)
         self._files = {}
+        self._verified = set()  # (file, entry) pairs already CRC-checked
         self.meta = None
         meta_f = os.path.join(path, _META)
         if os.path.exists(meta_f):
@@ -343,6 +487,22 @@ class _ChunkIndex:
         return tuple(stops or ())
 
     def read(self, fn, zkey):
+        """Read one entry, verifying its bytes against the meta-recorded
+        CRC32 on first access ("verified at load"): corruption raises
+        :class:`CheckpointCorrupt` instead of restoring garbage."""
+        crcs = (self.meta or {}).get("entry_crc32") or {}
+        want = crcs.get(zkey)
+        if want is not None and (fn, zkey) not in self._verified:
+            raw = self._files[fn].zip.read(zkey + ".npy")
+            have = zlib.crc32(raw)
+            if have != int(want):
+                raise CheckpointCorrupt(
+                    f"checkpoint entry {zkey} in {fn}: crc32 "
+                    f"{have:#010x} != recorded {int(want):#010x} — "
+                    f"shard data corrupt; roll back to an intact tag")
+            self._verified.add((fn, zkey))
+            return np.lib.format.read_array(io.BytesIO(raw),
+                                            allow_pickle=False)
         return self._files[fn][zkey]
 
     def _saved_dtype(self, name):

@@ -13,6 +13,14 @@ bumped. Recovery of *state* is checkpoint-based (SURVEY §5.3: the real
 fault-tolerance story): training scripts call ``load_checkpoint`` at
 startup, which no-ops on the first launch (no ``latest`` yet) and
 resumes after a restart.
+
+The graceful-shutdown contract with ``resilience.ResilientTrainer``:
+``_terminate`` sends SIGTERM first and escalates to SIGKILL only after
+``term_grace_s`` — a supervised worker uses that window to finish its
+in-flight step and write the preemption checkpoint
+(``DS_PREEMPTION_GRACE_S`` in the worker env carries the budget), so an
+agent-driven restart resumes from the step it was killed at, not from
+the last periodic save.
 """
 
 import os
@@ -41,7 +49,7 @@ class DSElasticAgent:
     def __init__(self, training_script, script_args=(), num_workers=1,
                  num_nodes=1, node_rank=0, master_addr="127.0.0.1",
                  master_port=None, max_restarts=3, monitor_interval=0.25,
-                 force_cpu_devices=0, rdzv_port=None):
+                 force_cpu_devices=0, rdzv_port=None, term_grace_s=10.0):
         self.training_script = training_script
         self.script_args = list(script_args)
         self.num_workers = num_workers
@@ -53,6 +61,12 @@ class DSElasticAgent:
         self.monitor_interval = monitor_interval
         self.force_cpu_devices = force_cpu_devices
         self.rdzv_port = rdzv_port
+        # SIGTERM-to-SIGKILL budget: a worker wrapped in
+        # resilience.ResilientTrainer uses this window to finish its
+        # in-flight step and write the preemption checkpoint. Published
+        # to workers as DS_PREEMPTION_GRACE_S so the trainer can size
+        # its final save against the real budget.
+        self.term_grace_s = float(term_grace_s)
         self.restart_count = 0
         self._procs = []
         self._store = None
@@ -76,6 +90,7 @@ class DSElasticAgent:
                 "MASTER_ADDR": self.master_addr,
                 "MASTER_PORT": str(self.master_port),
                 "DS_ELASTIC_RESTART_COUNT": str(self.restart_count),
+                "DS_PREEMPTION_GRACE_S": str(self.term_grace_s),
             })
             if self.force_cpu_devices:
                 env["JAX_PLATFORMS"] = "cpu"
@@ -90,10 +105,13 @@ class DSElasticAgent:
                     f"port {self.master_port})")
 
     def _terminate(self):
+        # graceful first: SIGTERM is the preemption notice the
+        # resilience supervisor turns into a boundary checkpoint; only
+        # after term_grace_s does escalation to SIGKILL destroy state
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()
-        deadline = time.time() + 10
+        deadline = time.time() + self.term_grace_s
         for p in self._procs:
             try:
                 p.wait(timeout=max(0.1, deadline - time.time()))

@@ -5,6 +5,7 @@ WandB and CSV writers). Events are ``(tag, value, step)`` tuples."""
 import csv
 import os
 from abc import ABC, abstractmethod
+from collections import deque
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -86,6 +87,25 @@ class csvMonitor(Monitor):
                 if new:
                     w.writerow(["step", safe])
                 w.writerow([int(step), float(value)])
+
+
+class RingBufferMonitor(Monitor):
+    """Bounded in-memory event sink (same ``write_events`` contract as
+    the file-backed monitors). The resilience supervisor and the serving
+    health endpoint keep their recent event history here so a live
+    process can be interrogated (``tail()``) without any sink
+    configured — and tests can assert on emitted events directly."""
+
+    def __init__(self, maxlen=1024):
+        super().__init__(None)
+        self.enabled = True
+        self.events = deque(maxlen=maxlen)
+
+    def write_events(self, event_list):
+        self.events.extend(event_list)
+
+    def tail(self, n=20):
+        return list(self.events)[-n:]
 
 
 class MonitorMaster(Monitor):

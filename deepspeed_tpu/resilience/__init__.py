@@ -1,0 +1,35 @@
+"""Fault-tolerant run supervision.
+
+Three coordinated parts (see docs/resilience.md):
+
+* :mod:`deepspeed_tpu.resilience.faults` — deterministic, seed-driven
+  fault injection through named points threaded into checkpoint writes,
+  train steps and serving steps.
+* :mod:`deepspeed_tpu.resilience.supervisor` —
+  :class:`~deepspeed_tpu.resilience.supervisor.ResilientTrainer`:
+  periodic + SIGTERM-triggered (preemption-safe) checkpointing,
+  integrity-gated ``latest`` advancement, rollback to the newest intact
+  tag, bounded save retries, and a NaN/divergence watchdog.
+* Serving hardening lives in :mod:`deepspeed_tpu.serving` itself
+  (deadlines, cancellation, per-request error containment, health).
+
+``faults`` is imported eagerly (stdlib + numpy only, safe from any
+layer); the supervisor — which pulls in the full runtime engine — loads
+lazily so instrumented low-level modules can import this package
+without cycles.
+"""
+
+from deepspeed_tpu.resilience import faults  # noqa: F401
+
+_LAZY = ("ResilientTrainer", "Preempted", "TrainReport", "DivergenceError")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from deepspeed_tpu.resilience import supervisor
+        return getattr(supervisor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,263 @@
+"""Deterministic, seed-driven fault injection.
+
+Every recovery path in the resilience subsystem is testable because the
+code it protects carries *named injection points* — one-line hooks that
+are free no-ops until a :class:`FaultInjector` is installed.  A test (or
+a chaos run) arms an injector with a plan and replays the exact same
+failure sequence on every run: triggers are keyed on exact step numbers,
+on the n-th firing of a point, or on a seeded RNG — never on wall time.
+
+Injection points wired through the codebase:
+
+====================  =======================================  ==========
+point                 site                                     ctx keys
+====================  =======================================  ==========
+``ckpt.shard_write``  before a shard file's bytes are written  ``path``
+                      (``checkpoint/engine.py``) — a raised
+                      IOError simulates a transient disk
+                      failure for save-retry paths
+``ckpt.shard_written``after the shard file is durably renamed  ``path``
+                      — a callable action can truncate or
+                      corrupt the on-disk file to exercise
+                      integrity checking and rollback
+``train.step``        entry of ``DeepSpeedEngine.train_batch`` ``step``
+                      — raise, sleep (slow step) or deliver
+                      SIGTERM to self (preemption)
+``train.loss``        transform of train_batch's returned      ``step``
+                      loss — force NaN for watchdog tests
+``serve.step``        entry of ``ServingScheduler.step``       ``step``
+``serve.request``     per-request, before a token is emitted   ``step``,
+                      — containment: the error must fail one   ``rid``
+                      request, not the loop
+``serve.page_alloc``  inside ``_grow_or_evict`` — raise
+                      :class:`PagePoolExhausted` to force a    ``step``,
+                      page-exhaustion episode on an exact      ``slot``,
+                      step regardless of actual pool size      ``rid``
+====================  =======================================  ==========
+
+Usage::
+
+    inj = FaultInjector(seed=0)
+    inj.on("ckpt.shard_write", nth=1, exc=IOError("disk wobble"))
+    inj.on("train.loss", step=4, replace=float("nan"))
+    inj.on("serve.request", match={"rid": 2}, exc=RuntimeError("boom"))
+    with faults.injected(inj):
+        ...  # run the workload; faults fire deterministically
+
+This module imports only stdlib + numpy so any layer (checkpoint,
+runtime, serving) can import it without cycles.
+"""
+
+import contextlib
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+_active = None          # the installed injector (module-global, like a
+_lock = threading.Lock()  # logging root); serving/train loops are host
+                          # threads, so arming is lock-protected
+
+
+class Injection:
+    """One armed fault: a trigger predicate plus an action.
+
+    Trigger (all supplied conditions must hold):
+      * ``step``  — ctx step equals this exact value
+      * ``steps`` — ctx step is in this collection
+      * ``nth``   — this is the n-th firing of the point (1-based),
+                    counted per injection
+      * ``match`` — every (key, value) equals the firing ctx's
+      * ``prob``  — seeded coin flip (drawn from the injector's RNG, so
+                    the decision sequence is a pure function of the seed)
+
+    Action (first non-None wins):
+      * ``exc``     — exception instance or class to raise
+      * ``action``  — callable(ctx) for side effects (truncate a file,
+                      sleep, kill -TERM self, ...)
+      * ``replace`` — value substituted at ``transform`` points (or a
+                      callable(value, ctx) -> new value)
+
+    ``times`` bounds how often the action runs (default 1 — one-shot, so
+    a retry/rollback pass after the fault is clean by default).
+    """
+
+    def __init__(self, point, *, step=None, steps=None, nth=None,
+                 match=None, prob=None, times=1, exc=None, action=None,
+                 replace=None):
+        if exc is None and action is None and replace is None:
+            raise ValueError("injection needs an action: exc=, action= "
+                             "or replace=")
+        self.point = point
+        self.step = step
+        self.steps = set(steps) if steps is not None else None
+        self.nth = nth
+        self.match = dict(match or {})
+        self.prob = prob
+        self.times = times
+        self.exc = exc
+        self.action = action
+        self.replace = replace
+        self.seen = 0       # firings of the point observed by this plan
+        self.fired = 0      # times the action actually ran
+
+    def _triggers(self, ctx, rng):
+        self.seen += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.step is not None and ctx.get("step") != self.step:
+            return False
+        if self.steps is not None and ctx.get("step") not in self.steps:
+            return False
+        if self.nth is not None and self.seen != self.nth:
+            return False
+        for k, v in self.match.items():
+            if ctx.get(k) != v:
+                return False
+        if self.prob is not None and not (rng.random() < self.prob):
+            return False
+        return True
+
+
+class FaultInjector:
+    """Replayable fault schedule over the named injection points."""
+
+    def __init__(self, seed=0):
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self.plans = []
+        self.log = []      # (point, step, ctx) for every action that ran
+
+    def on(self, point, **kwargs):
+        """Arm an injection (see :class:`Injection`); returns it so the
+        caller can assert on ``.fired`` afterwards."""
+        plan = Injection(point, **kwargs)
+        self.plans.append(plan)
+        return plan
+
+    # --------------------------------------------------------- firing
+    def _record(self, plan, ctx):
+        plan.fired += 1
+        self.log.append((plan.point, ctx.get("step"), dict(ctx)))
+
+    def fire(self, point, **ctx):
+        """Called from an instrumented site; raises or side-effects when
+        an armed plan triggers."""
+        for plan in self.plans:
+            if plan.point != point or not plan._triggers(ctx, self.rng):
+                continue
+            self._record(plan, ctx)
+            if plan.action is not None:
+                plan.action(ctx)
+            if plan.exc is not None:
+                raise plan.exc if isinstance(plan.exc, BaseException) \
+                    else plan.exc()
+
+    def transform(self, point, value, **ctx):
+        """Value-substitution variant for sites that return data (e.g.
+        the train loss)."""
+        for plan in self.plans:
+            if plan.point != point or not plan._triggers(ctx, self.rng):
+                continue
+            self._record(plan, ctx)
+            if plan.action is not None:
+                plan.action(ctx)
+            if plan.exc is not None:
+                raise plan.exc if isinstance(plan.exc, BaseException) \
+                    else plan.exc()
+            if callable(plan.replace):
+                value = plan.replace(value, ctx)
+            elif plan.replace is not None:
+                value = plan.replace
+        return value
+
+
+# ------------------------------------------------------------ site API
+# The hooks instrumented code calls. They must cost one global load and
+# one comparison when no injector is installed (the production path).
+
+def fire(point, **ctx):
+    inj = _active
+    if inj is not None:
+        inj.fire(point, **ctx)
+
+
+def transform(point, value, **ctx):
+    inj = _active
+    if inj is None:
+        return value
+    return inj.transform(point, value, **ctx)
+
+
+def install(injector):
+    global _active
+    with _lock:
+        _active = injector
+    return injector
+
+
+def uninstall():
+    global _active
+    with _lock:
+        _active = None
+
+
+def get_injector():
+    return _active
+
+
+@contextlib.contextmanager
+def injected(injector):
+    """Scope an injector's lifetime; always uninstalls, so a failed test
+    cannot leak faults into the next."""
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()
+
+
+# ------------------------------------------------- stock fault actions
+
+def truncate_file(nbytes=64):
+    """Action: chop the last ``nbytes`` off ctx['path'] — a partial
+    write surviving a crash (torn shard file)."""
+    def act(ctx):
+        path = ctx["path"]
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size - nbytes))
+    return act
+
+
+def corrupt_file(offset=None, nbytes=8):
+    """Action: overwrite ``nbytes`` at ``offset`` (default: mid-file)
+    with complemented bits — silent on-media corruption the zip/CRC
+    layers must catch."""
+    def act(ctx):
+        path = ctx["path"]
+        size = os.path.getsize(path)
+        off = size // 2 if offset is None else offset
+        with open(path, "r+b") as f:
+            f.seek(off)
+            data = f.read(nbytes)
+            f.seek(off)
+            f.write(bytes(b ^ 0xFF for b in data))
+    return act
+
+
+def sleep_s(seconds):
+    """Action: a slow step / slow write."""
+    def act(ctx):
+        time.sleep(seconds)
+    return act
+
+
+def sigterm_self():
+    """Action: deliver SIGTERM to this process — a preemption notice,
+    exactly what a cloud scheduler sends before reclaiming capacity."""
+    def act(ctx):
+        os.kill(os.getpid(), signal.SIGTERM)
+    return act

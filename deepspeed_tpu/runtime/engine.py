@@ -47,6 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.parallel import sharding as shd
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.parallel.topology import make_mesh
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -1840,6 +1841,10 @@ class DeepSpeedEngine:
         donate params and has no such hazard)."""
         assert data_iter is not None or batches is not None or \
             self.training_dataloader is not None
+        # fault point: raise / sleep / SIGTERM-self on an exact step —
+        # the step about to run (global_steps is pre-increment here)
+        fstep = self.global_steps
+        faults.fire("train.step", step=fstep)
         if data_iter is None and batches is None:
             data_iter = iter(self.training_dataloader)
         if batches is None and self.gas > 1:
@@ -1849,7 +1854,9 @@ class DeepSpeedEngine:
             # what instantiates the offload optimizer that rules it out
             self._ensure_initialized(batches[0])
         if self._can_fuse_window():
-            return self._train_batch_fused(batches, sync=sync)
+            return faults.transform(
+                "train.loss", self._train_batch_fused(batches, sync=sync),
+                step=fstep)
         losses = []
         self.tput_timer.start()
         for i in range(self.gas):
@@ -1863,10 +1870,13 @@ class DeepSpeedEngine:
                 self._config.steps_per_print != 0:
             # window-mean as a device scalar; no host round trip (same
             # metric the fused path reports)
-            return jnp.mean(jnp.stack(losses))
+            return faults.transform("train.loss",
+                                    jnp.mean(jnp.stack(losses)), step=fstep)
         mean_loss = float(np.mean([jax.device_get(l) for l in losses]))
         self._log_train_step(mean_loss, metrics)
-        return mean_loss
+        # fault transform: force a NaN loss on an exact step so the
+        # supervisor's divergence watchdog is testable end to end
+        return faults.transform("train.loss", mean_loss, step=fstep)
 
     def _log_train_step(self, mean_loss, metrics):
         """THE steps_per_print train-step log + monitor events (shared by

@@ -1,7 +1,13 @@
 """Serving observability: TTFT / per-token latency / queue and pool
 gauges, emitted as ``(tag, value, step)`` events through the existing
 ``monitor/`` path (MonitorMaster.write_events) so serving metrics land in
-the same TensorBoard/WandB/CSV sinks as training metrics."""
+the same TensorBoard/WandB/CSV sinks as training metrics.
+
+Latency samples are durations computed by the scheduler from
+``time.monotonic()`` timestamps — never wall-clock, so an NTP step
+cannot produce negative or wild TTFT/ITL values.  Terminal outcomes are
+counted distinctly (completed / failed / shed / cancelled): an operator
+must be able to tell "we errored" from "we refused load"."""
 
 import numpy as np
 
@@ -19,6 +25,9 @@ class ServingMetrics:
         self.ttft_s = []              # submit -> first token, per request
         self.tpot_s = []              # inter-token gaps, per token
         self.completed = 0
+        self.failed = 0               # per-request error, contained
+        self.shed = 0                 # deadline/capacity load shedding
+        self.cancelled = 0
         self.preemptions = 0
         self.tokens_emitted = 0
         self.page_util = []           # pool utilization per step
@@ -56,6 +65,19 @@ class ServingMetrics:
     def record_completion(self, step):
         self.completed += 1
 
+    def record_terminal(self, step, state, rid, reason=None):
+        """A request left the loop without finishing: ``state`` is
+        ``failed`` (contained per-request error), ``shed`` (deadline or
+        capacity refusal) or ``cancelled``."""
+        if state == "failed":
+            self.failed += 1
+        elif state == "shed":
+            self.shed += 1
+        elif state == "cancelled":
+            self.cancelled += 1
+        if self.monitor is not None:
+            self.monitor.write_events([(f"serving/{state}", 1, step)])
+
     def record_preemption(self, step):
         self.preemptions += 1
 
@@ -63,6 +85,9 @@ class ServingMetrics:
     def summary(self, wall_s=None):
         out = {
             "completed": self.completed,
+            "failed": self.failed,
+            "shed": self.shed,
+            "cancelled": self.cancelled,
             "tokens_emitted": self.tokens_emitted,
             "preemptions": self.preemptions,
             "ttft_ms_p50": round(_percentile(self.ttft_s, 50) * 1e3, 3),

@@ -4,9 +4,11 @@ Orca (OSDI '22) scheduling over the paged KV cache: requests join and
 leave the running batch at token granularity instead of batch
 granularity.  Each ``step()`` is one scheduler iteration:
 
-  1. retire finished slots and recycle their pages,
+  1. sweep cancellations and expired deadlines (terminal work leaves at
+     step boundaries, never mid-dispatch),
   2. admit waiting requests into free slots (admission control: the pool
-     must be able to hold the whole prompt),
+     must be able to hold the whole prompt, and a deadline the request
+     cannot possibly meet sheds it NOW instead of wasting pool pages),
   3. advance every admitted-but-unprefilled slot by ONE prompt chunk
      (chunked prefill — long prompts never stall running decoders for
      more than a chunk),
@@ -19,6 +21,26 @@ scheduler itself is pure host logic.  When the page pool runs dry the
 youngest running request is preempted (recompute-style eviction: its
 pages recycle, the request re-queues at the queue head with its
 already-emitted tokens folded into the prompt).
+
+Failure policy (the serving half of docs/resilience.md):
+
+* **Containment** — an exception attributable to ONE request (its
+  prefill dispatch, its token callback, an injected per-request fault)
+  fails that request (state ``failed``) and releases its pages; the
+  loop and every other request keep going.  Only errors in the shared
+  batched decode dispatch — not attributable to a single request — can
+  take the loop down.
+* **Shedding** — load the system cannot serve is refused distinctly
+  from errors (state ``shed``): deadline-infeasible admissions, expired
+  deadlines, and page-capacity dead-ends.
+* **Cancellation** — ``req.cancel()`` is a flag; the scheduler honors
+  it at the next step boundary, releasing pages (state ``cancelled``).
+* **Bounded memory** — terminal requests leave the live ``requests``
+  map for a bounded ``completed`` history, so a long-running server's
+  bookkeeping cannot grow without bound.
+
+All latency accounting uses ``time.monotonic()``: an NTP clock step
+must never produce negative or wild TTFT/ITL samples.
 """
 
 import time
@@ -26,12 +48,15 @@ from collections import deque
 
 import numpy as np
 
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.page_manager import (PagedKVManager,
                                                 PagePoolExhausted)
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
+CANCELLED, FAILED, SHED = "cancelled", "failed", "shed"
+TERMINAL = (FINISHED, CANCELLED, FAILED, SHED)
 
 
 class QueueFull(RuntimeError):
@@ -44,7 +69,7 @@ class Request:
     _next_id = 0
 
     def __init__(self, prompt, max_new_tokens, eos_token_id=None,
-                 on_token=None, rid=None):
+                 on_token=None, rid=None, deadline_s=None):
         if rid is None:
             rid = Request._next_id
             Request._next_id += 1
@@ -57,7 +82,11 @@ class Request:
         self.out_tokens = []
         self.state = WAITING
         self.prefill_pos = 0
-        self.t_submit = time.time()
+        self.error = None            # reason string for failed/shed
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+        self.deadline = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
         self.t_admit = None
         self.t_first = None
         self.t_last = None
@@ -65,6 +94,15 @@ class Request:
     @property
     def remaining_new(self):
         return self.max_new_tokens - len(self.out_tokens)
+
+    def cancel(self):
+        """Request cancellation; honored at the next step boundary (the
+        scheduler releases the pages then). Idempotent; a no-op once
+        the request is terminal."""
+        self.cancelled = True
+
+    def past_deadline(self, now):
+        return self.deadline is not None and now > self.deadline
 
     def _finished_by(self, tok):
         return (self.eos_token_id is not None and
@@ -77,7 +115,7 @@ class ServingScheduler:
     def __init__(self, engine, *, num_slots=8, num_pages=64, page_size=None,
                  max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
-                 top_p=1.0):
+                 top_p=1.0, completed_history=4096):
         if page_size is None:
             # the paged Pallas decode kernel needs 128-multiple pages
             # (TPU lane tiling); anything smaller silently drops every
@@ -99,17 +137,30 @@ class ServingScheduler:
         self.last_tok = np.zeros(num_slots, np.int32)
         self.slot_req = [None] * num_slots
         self.waiting = deque()
-        self.requests = []
+        self.requests = {}           # rid -> LIVE request only
+        # bounded terminal history: a long-running server retires
+        # requests out of the live map instead of keeping them forever
+        self.completed = deque(maxlen=int(completed_history))
+        self._collect = None         # active run()'s result accumulator
         self.metrics = ServingMetrics(monitor)
         self.step_idx = 0
+        self._ema_step_s = None      # EWMA of step wall time (health)
+        # admission feasibility uses the MEDIAN of a recent window, not
+        # the EWMA: one jit-compile step (seconds) would otherwise
+        # dominate the estimate for dozens of steps and shed perfectly
+        # serviceable deadline-bearing requests after every cold start
+        self._step_window = deque(maxlen=16)
+        self._last_error = None
         self.sampling = dict(do_sample=do_sample, temperature=temperature,
                              top_k=top_k, top_p=top_p)
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
-               on_token=None):
+               on_token=None, deadline_s=None):
         """Queue a request; raises :class:`QueueFull` at max_queue (the
-        backpressure signal callers turn into 429/retry)."""
+        backpressure signal callers turn into 429/retry). ``deadline_s``
+        is a relative budget: a request that cannot finish inside it is
+        shed instead of served late."""
         if len(self.waiting) >= self.max_queue:
             raise QueueFull(
                 f"waiting queue at max_queue={self.max_queue}")
@@ -120,18 +171,26 @@ class ServingScheduler:
             raise ValueError(
                 f"request of {need} tokens exceeds per-slot capacity {cap} "
                 "(min(max_pages_per_slot, num_pages) * page_size)")
-        req = Request(prompt, max_new_tokens, eos_token_id, on_token)
-        self.requests.append(req)
+        req = Request(prompt, max_new_tokens, eos_token_id, on_token,
+                      deadline_s=deadline_s)
         if req.max_new_tokens <= 0:
-            # parity with generate(max_new_tokens=0): nothing to emit
+            # parity with generate(max_new_tokens=0): nothing to emit —
+            # but it still counts as completed, so health()/summary
+            # reconcile with the per-request rows ds_serve reports
             req.state = FINISHED
+            self.completed.append(req)
+            self.metrics.record_completion(self.step_idx)
             return req
+        self.requests[req.rid] = req
         self.waiting.append(req)
         return req
 
     # --------------------------------------------------------- accounting
     def _emit(self, req, tok):
-        now = time.time()
+        # fault point: a raised exception here is attributable to THIS
+        # request — the containment wrappers fail it, not the loop
+        faults.fire("serve.request", step=self.step_idx, rid=req.rid)
+        now = time.monotonic()
         tok = int(tok)
         req.out_tokens.append(tok)
         if req.t_first is None:
@@ -144,13 +203,42 @@ class ServingScheduler:
         if req.on_token is not None:
             req.on_token(req, tok)
 
+    def _finalize(self, req, state, reason=None):
+        """Move a request from live bookkeeping to the bounded terminal
+        history ("drain on retire")."""
+        req.state = state
+        if reason is not None:
+            req.error = reason
+        self.requests.pop(req.rid, None)
+        self.completed.append(req)
+
     def _retire(self, slot):
         req = self.slot_req[slot]
         self.kv.release_slot(slot)
         self.slot_req[slot] = None
         self.lengths[slot] = 0
-        req.state = FINISHED
+        self._finalize(req, FINISHED)
+        if self._collect is not None:
+            # run()'s result set stays complete even after the bounded
+            # history evicts this request
+            self._collect[req.rid] = list(req.out_tokens)
         self.metrics.record_completion(self.step_idx)
+
+    def _close_slot(self, slot, state, reason):
+        """Terminal removal of a live slot for cancel/shed/fail: release
+        pages at the step boundary, record the reason distinctly."""
+        req = self.slot_req[slot]
+        self.kv.release_slot(slot)
+        self.slot_req[slot] = None
+        self.lengths[slot] = 0
+        self._finalize(req, state, reason)
+        self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
+        if state == FAILED:
+            self._last_error = f"rid={req.rid}: {reason}"
+
+    def _drop_waiting(self, req, state, reason):
+        self._finalize(req, state, reason)
+        self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
 
     def _preempt_youngest(self, protect=None):
         """Evict the most recently admitted live request (vLLM's
@@ -177,7 +265,13 @@ class ServingScheduler:
 
     def _grow_or_evict(self, slot, target_len):
         """ensure_capacity with the eviction policy behind it. Returns
-        False when ``slot`` itself was preempted."""
+        False when ``slot`` itself was preempted. Raises
+        :class:`PagePoolExhausted` on a genuine dead-end (no evictable
+        victim) — callers shed the slot's request rather than letting
+        the loop die."""
+        req = self.slot_req[slot]
+        faults.fire("serve.page_alloc", step=self.step_idx, slot=slot,
+                    rid=None if req is None else req.rid)
         while not self.kv.ensure_capacity(slot, target_len):
             victim = self._preempt_youngest(protect=slot)
             if victim is None:
@@ -188,18 +282,83 @@ class ServingScheduler:
                 return False
         return True
 
+    # ----------------------------------------------------- failure policy
+    def _estimated_service_steps(self, req):
+        """Scheduler iterations this request still needs if admitted
+        now: remaining prefill chunks + one decode step per remaining
+        token (ignores queueing ahead of it — a deliberately optimistic
+        bound, so shedding only fires on certainly-hopeless requests)."""
+        prefill = -(-max(0, len(req.prompt) - req.prefill_pos)
+                    // self.prefill_chunk)
+        return prefill + max(1, req.remaining_new)
+
+    def _step_s_estimate(self):
+        """Robust per-step wall-time estimate for admission decisions:
+        median over a recent window (compile spikes must not starve
+        admissions), None until there are at least two samples."""
+        if len(self._step_window) < 2:
+            return None
+        return float(np.median(self._step_window))
+
+    def _infeasible(self, req, now):
+        est = self._step_s_estimate()
+        if req.deadline is None or est is None:
+            return False
+        eta = now + self._estimated_service_steps(req) * est
+        return eta > req.deadline
+
+    def _sweep(self):
+        """Step-boundary honoring of cancellations and deadlines, for
+        both queued and running requests."""
+        now = time.monotonic()
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None:
+                continue
+            if req.cancelled:
+                self._close_slot(slot, CANCELLED, "cancelled")
+            elif req.past_deadline(now):
+                self._close_slot(slot, SHED, "deadline expired mid-flight")
+        if any(r.cancelled or r.past_deadline(now) for r in self.waiting):
+            keep = deque()
+            for req in self.waiting:
+                if req.cancelled:
+                    self._drop_waiting(req, CANCELLED, "cancelled")
+                elif req.past_deadline(now):
+                    self._drop_waiting(req, SHED,
+                                       "deadline expired in queue")
+                else:
+                    keep.append(req)
+            self.waiting = keep
+
     # -------------------------------------------------------------- step
     def step(self):
         """One scheduler iteration; returns True if any work remains."""
         self.step_idx += 1
+        t_step = time.monotonic()
+        # fault point: slow-step / loop-level fault injection
+        faults.fire("serve.step", step=self.step_idx)
 
-        # 1+2. admit waiting requests into free slots (retirement happens
+        # 1. cancellations + deadlines leave at the boundary
+        self._sweep()
+
+        # 2. admit waiting requests into free slots (retirement happens
         # inline as tokens are observed, so slots are already recycled)
+        now = time.monotonic()
         for slot in range(self.num_slots):
-            if not self.waiting:
-                break
             if self.slot_req[slot] is not None:
                 continue
+            # deadline-aware admission: shed what cannot finish in time
+            # instead of admitting it and wasting pool pages
+            while self.waiting and self._infeasible(self.waiting[0], now):
+                req = self.waiting.popleft()
+                self._drop_waiting(
+                    req, SHED,
+                    f"deadline infeasible at admission "
+                    f"(needs ~{self._estimated_service_steps(req)} steps "
+                    f"at {self._step_s_estimate() * 1e3:.1f} ms/step)")
+            if not self.waiting:
+                break
             req = self.waiting[0]
             if not self.kv.pool.can_allocate(
                     self.kv.pool.pages_for_tokens(len(req.prompt))):
@@ -207,33 +366,43 @@ class ServingScheduler:
             self.waiting.popleft()
             self.slot_req[slot] = req
             req.state = PREFILL
-            req.t_admit = time.time()
+            req.t_admit = time.monotonic()
             self.lengths[slot] = 0
 
-        # 3. one prompt chunk per prefilling slot (chunked prefill)
+        # 3. one prompt chunk per prefilling slot (chunked prefill).
+        # The whole body is attributable to ONE request, so containment
+        # wraps it: a per-request failure frees the slot and moves on.
         for slot in range(self.num_slots):
             req = self.slot_req[slot]
             if req is None or req.state != PREFILL:
                 continue
-            chunk = req.prompt[req.prefill_pos:
-                               req.prefill_pos + self.prefill_chunk]
-            n_valid = len(chunk)
-            if not self._grow_or_evict(slot, req.prefill_pos + n_valid):
-                continue      # self-preempted: back in the queue
-            ids = np.zeros((1, self.prefill_chunk), np.int32)
-            ids[0, :n_valid] = chunk
-            logits, self.pools = self.engine.prefill_into_slots(
-                ids, slot, n_valid, self.kv.table, self.lengths, self.pools)
-            self.lengths[slot] += n_valid
-            req.prefill_pos += n_valid
-            if req.prefill_pos == len(req.prompt):
-                tok = self.engine.sample_from_logits(logits, **self.sampling)
-                self._emit(req, tok)
-                if req._finished_by(tok):
-                    self._retire(slot)
-                else:
-                    self.last_tok[slot] = tok
-                    req.state = RUNNING
+            try:
+                chunk = req.prompt[req.prefill_pos:
+                                   req.prefill_pos + self.prefill_chunk]
+                n_valid = len(chunk)
+                if not self._grow_or_evict(slot, req.prefill_pos + n_valid):
+                    continue      # self-preempted: back in the queue
+                ids = np.zeros((1, self.prefill_chunk), np.int32)
+                ids[0, :n_valid] = chunk
+                logits, self.pools = self.engine.prefill_into_slots(
+                    ids, slot, n_valid, self.kv.table, self.lengths,
+                    self.pools)
+                self.lengths[slot] += n_valid
+                req.prefill_pos += n_valid
+                if req.prefill_pos == len(req.prompt):
+                    tok = self.engine.sample_from_logits(logits,
+                                                         **self.sampling)
+                    self._emit(req, tok)
+                    if req._finished_by(tok):
+                        self._retire(slot)
+                    else:
+                        self.last_tok[slot] = tok
+                        req.state = RUNNING
+            except PagePoolExhausted as e:
+                self._close_slot(slot, SHED, f"page capacity: {e}")
+            except Exception as e:   # containment: fail one, not all
+                self._close_slot(slot, FAILED,
+                                 f"{type(e).__name__}: {e}")
 
         # 4. one decode step over every running slot
         candidates = [s for s in range(self.num_slots)
@@ -247,12 +416,20 @@ class ServingScheduler:
             # the pending token writes at position lengths[slot] — make
             # sure its page exists (this is where decode-time growth and
             # eviction happen)
-            if self._grow_or_evict(slot, int(self.lengths[slot]) + 1):
-                kept.append(slot)
+            try:
+                if self._grow_or_evict(slot, int(self.lengths[slot]) + 1):
+                    kept.append(slot)
+            except PagePoolExhausted as e:
+                self._close_slot(slot, SHED, f"page capacity: {e}")
+            except Exception as e:   # same containment as prefill: the
+                self._close_slot(slot, FAILED,  # growth is per-slot work
+                                 f"{type(e).__name__}: {e}")
         # a later slot's growth can evict an earlier kept slot too
         running = [s for s in kept if self.slot_req[s] is not None and
                    self.slot_req[s].state == RUNNING]
         if running:
+            # the batched dispatch is shared — an error here is NOT
+            # attributable to one request and must surface loudly
             active = np.zeros(self.num_slots, bool)
             active[running] = True
             toks, self.pools = self.engine.decode_step(
@@ -263,13 +440,22 @@ class ServingScheduler:
             for slot in running:
                 req = self.slot_req[slot]
                 tok = int(toks[slot])
-                self._emit(req, tok)
+                try:
+                    self._emit(req, tok)
+                except Exception as e:  # per-request emit/callback fault
+                    self._close_slot(slot, FAILED,
+                                     f"{type(e).__name__}: {e}")
+                    continue
                 if req._finished_by(tok):
                     self._retire(slot)
                 else:
                     self.last_tok[slot] = tok
 
         # 5. observability
+        dt = time.monotonic() - t_step
+        self._step_window.append(dt)
+        self._ema_step_s = dt if self._ema_step_s is None \
+            else 0.8 * self._ema_step_s + 0.2 * dt
         n_running = sum(r is not None for r in self.slot_req)
         self.metrics.record_step(
             self.step_idx, queue_depth=len(self.waiting),
@@ -278,17 +464,51 @@ class ServingScheduler:
         return bool(self.waiting) or n_running > 0
 
     def run(self, max_steps=100000):
-        """Drive step() until idle; returns {rid: generated tokens}."""
-        t0 = time.time()
-        for _ in range(max_steps):
-            if not self.step():
-                break
-        self._wall_s = time.time() - t0
+        """Drive step() until idle; returns {rid: generated tokens} for
+        requests that FINISHED (failed/shed/cancelled requests are
+        reported distinctly — see ``health()`` and each request's
+        ``.state``/``.error``). The result set is exact for everything
+        that finished during (or before) this call even when the bounded
+        ``completed`` history has rotated old entries out."""
+        self._collect = {r.rid: list(r.out_tokens) for r in self.completed
+                         if r.state == FINISHED}
+        t0 = time.monotonic()
+        try:
+            for _ in range(max_steps):
+                if not self.step():
+                    break
+        finally:
+            results, self._collect = self._collect, None
+        self._wall_s = time.monotonic() - t0
         # max_steps exhausted with live work is a legitimate outcome (a
         # bounded drain): finished requests are returned, the rest stay
         # queued/running for further step() calls
-        return {r.rid: list(r.out_tokens) for r in self.requests
-                if r.state == FINISHED}
+        return results
+
+    # ------------------------------------------------------------- health
+    def health(self):
+        """Liveness/saturation snapshot for operators (exposed by
+        ``bin/ds_serve``): current load, pool pressure, step latency,
+        and terminal counts by kind."""
+        m = self.metrics
+        return {
+            "step": self.step_idx,
+            "running": sum(r is not None for r in self.slot_req),
+            "waiting": len(self.waiting),
+            "live_requests": len(self.requests),
+            "queue_capacity": self.max_queue,
+            "free_pages": self.kv.pool.free_pages,
+            "page_utilization": round(self.kv.utilization(), 4),
+            "ema_step_ms": None if self._ema_step_s is None
+            else round(self._ema_step_s * 1e3, 3),
+            "completed": m.completed,
+            "failed": m.failed,
+            "shed": m.shed,
+            "cancelled": m.cancelled,
+            "preemptions": m.preemptions,
+            "tokens_emitted": m.tokens_emitted,
+            "last_error": self._last_error,
+        }
 
     def summary(self):
         return self.metrics.summary(getattr(self, "_wall_s", None))

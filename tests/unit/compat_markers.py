@@ -22,3 +22,14 @@ needs_pinned_host = pytest.mark.skipif(
 mp_collectives = pytest.mark.skipif(
     jax_compat.LEGACY_SHARD_MAP,
     reason="CPU multiprocess collectives need jax>=0.5")
+
+# jax<0.5's CPU SPMD partitioner miscompiles OVERSUBSCRIBED tensor
+# parallelism (tp > num_heads, so the head axis shards mid-head): tp=8
+# over a 4-head model drifts ~1e-2 from single-device while tp=2/4 stay
+# bitwise-clean on the same runtime (seed-era failure, triaged PR 2).
+# Gate only the oversubscribed case on modern jax.
+legacy_spmd_oversubscribed_tp = pytest.mark.skipif(
+    jax_compat.LEGACY_SHARD_MAP,
+    reason="jax<0.5 CPU SPMD partitioner miscompiles intra-head "
+           "(tp > num_heads) sharding; tp<=num_heads covers TP "
+           "equivalence on this runtime")

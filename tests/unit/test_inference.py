@@ -7,7 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tests.unit.compat_markers import needs_pinned_host
+from tests.unit.compat_markers import (legacy_spmd_oversubscribed_tp,
+                                       needs_pinned_host)
 
 import deepspeed_tpu
 
@@ -76,24 +77,31 @@ def test_sampling_reproducible_and_topk(tiny_llama):
     assert (out[:, 4:] < model.cfg.vocab_size).all()
 
 
-def test_tensor_parallel_serving(tiny_llama):
-    """tp_size=8: weights sharded over the model axis, output identical to
-    single-device (auto-TP equivalence, reference AutoTP)."""
+@pytest.mark.parametrize("tp", [
+    4,
+    pytest.param(8, marks=legacy_spmd_oversubscribed_tp),
+])
+def test_tensor_parallel_serving(tiny_llama, tp):
+    """TP-sharded weights over the model axis, output identical to
+    single-device (auto-TP equivalence, reference AutoTP). tp=4 equals
+    num_heads (clean per-head sharding, exact on every runtime); tp=8
+    oversubscribes the 4-head axis — intra-head sharding the legacy
+    jax<0.5 CPU partitioner miscompiles, hence the env-bound skip."""
     model, params = tiny_llama
     e1 = deepspeed_tpu.init_inference(model=model, dtype="float32",
                                       params=params,
                                       mesh={"data": 1, "model": 1})
-    e8 = deepspeed_tpu.init_inference(model=model, dtype="float32",
-                                      params=params,
-                                      tensor_parallel={"tp_size": 8},
-                                      mesh={"data": 1, "model": 8})
+    etp = deepspeed_tpu.init_inference(model=model, dtype="float32",
+                                       params=params,
+                                       tensor_parallel={"tp_size": tp},
+                                       mesh={"data": 1, "model": tp})
     ids = np.arange(8, dtype=np.int32)[None] % 256
     l1 = np.asarray(e1(ids))
-    l8 = np.asarray(e8(ids))
-    np.testing.assert_allclose(l1, l8, atol=1e-4, rtol=1e-4)
+    ltp = np.asarray(etp(ids))
+    np.testing.assert_allclose(l1, ltp, atol=1e-4, rtol=1e-4)
     # check at least one weight is actually sharded over 'model'
     specs = jax.tree.leaves(jax.tree.map(
-        lambda x: str(x.sharding.spec), e8.params))
+        lambda x: str(x.sharding.spec), etp.params))
     assert any("model" in s for s in specs), specs
 
 

@@ -1,0 +1,402 @@
+"""Fault-tolerant run supervision (deepspeed_tpu/resilience).
+
+Deterministic fault injection drives every recovery path:
+
+* (a) preemption (SIGTERM) mid-training resumes from the auto-checkpoint
+  with BITWISE-identical params to an uninterrupted run at the same step;
+* (b) a corrupt/truncated shard file rolls back to the previous intact
+  tag — never a silent partial restore;
+* (c) with an injected per-request error and an injected page-exhaustion
+  episode, the serving loop completes every other request token-exact
+  vs generate() and reports the failed/shed ones distinctly.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.engine import (save_state, load_state,
+                                             verify_checkpoint)
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.supervisor import (DivergenceError,
+                                                 ResilientTrainer)
+
+from tests.unit.simple_model import (SimpleModel, random_regression_data,
+                                     simple_loss_fn)
+
+# ----------------------------------------------------------- injector unit
+
+
+def test_injector_triggers_are_deterministic_and_one_shot():
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("p", step=3, exc=IOError("x"))
+    inj.fire("p", step=1)
+    inj.fire("p", step=2)
+    with pytest.raises(IOError):
+        inj.fire("p", step=3)
+    inj.fire("p", step=3)     # times=1 default: one-shot
+    assert plan.fired == 1
+    assert [(pt, st) for pt, st, _ in inj.log] == [("p", 3)]
+
+
+def test_injector_nth_match_and_transform():
+    inj = faults.FaultInjector(seed=0)
+    inj.on("w", nth=2, exc=IOError("second write"))
+    inj.fire("w", path="a")                     # 1st: clean
+    with pytest.raises(IOError):
+        inj.fire("w", path="b")                 # 2nd: fault
+    inj.on("loss", step=4, replace=float("nan"))
+    assert inj.transform("loss", 1.25, step=3) == 1.25
+    assert np.isnan(inj.transform("loss", 1.25, step=4))
+    inj.on("req", match={"rid": 7}, exc=RuntimeError("boom"))
+    inj.fire("req", step=1, rid=6)
+    with pytest.raises(RuntimeError):
+        inj.fire("req", step=1, rid=7)
+
+
+def test_injector_seeded_probability_replays():
+    def decisions(seed):
+        inj = faults.FaultInjector(seed=seed)
+        inj.on("p", prob=0.3, times=None, action=lambda ctx: None)
+        out = []
+        for i in range(64):
+            before = len(inj.log)
+            inj.fire("p", step=i)
+            out.append(len(inj.log) > before)
+        return out
+    a, b = decisions(7), decisions(7)
+    assert a == b, "same seed must replay the same fault schedule"
+    assert decisions(8) != a, "different seed must differ somewhere"
+    assert 5 < sum(a) < 40
+
+
+def test_uninstalled_hooks_are_no_ops():
+    faults.uninstall()
+    faults.fire("anything", step=1)
+    assert faults.transform("anything", 42, step=1) == 42
+
+
+# ------------------------------------------------- checkpoint integrity
+
+
+def test_verify_checkpoint_detects_corruption_and_truncation(tmp_path):
+    import jax.numpy as jnp
+    state = {"a": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+             "b": np.float32(7.0)}
+    d = str(tmp_path / "t")
+    save_state(d, state)
+    ok, problems = verify_checkpoint(d)
+    assert ok and not problems
+    shard = os.path.join(
+        d, [f for f in os.listdir(d) if f.startswith("shards_p")][0])
+    faults.corrupt_file()({"path": shard})
+    ok, problems = verify_checkpoint(d)
+    assert not ok and any("CRC" in p or "crc" in p for p in problems)
+    with pytest.raises(Exception):   # BadZipFile or CheckpointCorrupt
+        load_state(d, state)
+    d2 = str(tmp_path / "t2")
+    save_state(d2, state)
+    shard2 = os.path.join(
+        d2, [f for f in os.listdir(d2) if f.startswith("shards_p")][0])
+    faults.truncate_file(64)({"path": shard2})
+    ok2, problems2 = verify_checkpoint(d2)
+    assert not ok2 and problems2
+
+
+# ------------------------------------------------------ training fixture
+
+
+def make_engine():
+    model = SimpleModel()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, loss_fn=simple_loss_fn(model))
+    return engine
+
+
+def batch_fn(step):
+    """Data keyed on the persisted step counter: an interrupted+resumed
+    run replays the exact byte stream of an uninterrupted one."""
+    return random_regression_data(n=32, seed=step)
+
+
+def params_of(engine):
+    return [np.asarray(x) for x in
+            jax.tree.leaves(jax.device_get(engine.state.params))]
+
+
+# ---------------------------------------------- acceptance (a): preemption
+
+
+def test_preemption_resume_is_bitwise_identical(tmp_path):
+    """SIGTERM mid-training: the in-flight step finishes, a checkpoint
+    lands, the run exits cleanly — and a fresh process resuming from it
+    reaches the SAME step with bitwise-identical params to a run that
+    was never interrupted."""
+    ref = make_engine()
+    ResilientTrainer(ref, str(tmp_path / "ref")).train(
+        8, batch_fn=batch_fn)
+
+    victim = make_engine()
+    sup = ResilientTrainer(victim, str(tmp_path / "run"), save_interval=3)
+    inj = faults.FaultInjector(seed=0)
+    # a REAL SIGTERM delivered mid-run (cloud preemption notice)
+    inj.on("train.step", step=5, action=faults.sigterm_self())
+    with faults.injected(inj):
+        rep = sup.train(8, batch_fn=batch_fn)
+    assert rep.status == "preempted"
+    assert rep.preempted_at_step == 6, \
+        "the in-flight step (5) must finish before the exit checkpoint"
+    assert sup._read_latest() == "step6"
+
+    fresh = make_engine()
+    sup2 = ResilientTrainer(fresh, str(tmp_path / "run"))
+    assert sup2.resume(example_batch=batch_fn(0)) == "step6"
+    assert fresh.global_steps == 6
+    rep2 = sup2.train(8, batch_fn=batch_fn)
+    assert rep2.status == "completed" and fresh.global_steps == 8
+    for a, b in zip(params_of(ref), params_of(fresh)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------ acceptance (b): rollback
+
+
+def test_corrupt_shard_rolls_back_to_intact_tag(tmp_path):
+    """An injected corrupt shard in the newest tag: load never silently
+    partial-restores — the supervisor quarantines the tag and restores
+    the previous intact one, bitwise."""
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_interval=3)
+    sup.train(3, batch_fn=batch_fn)
+    good = params_of(eng)                  # params at the step-3 save
+    sup.train(6, batch_fn=batch_fn)        # second tag at step 6
+    assert sup._tags() == ["step3", "step6"]
+
+    tag6 = str(tmp_path / "d" / "step6")
+    shard = os.path.join(
+        tag6, [f for f in os.listdir(tag6) if f.startswith("shards_p")][0])
+    faults.corrupt_file()({"path": shard})
+
+    fresh = make_engine()
+    sup2 = ResilientTrainer(fresh, str(tmp_path / "d"))
+    assert sup2.resume(example_batch=batch_fn(0)) == "step3"
+    assert fresh.global_steps == 3
+    assert sup2._read_latest() == "step3", "latest must be repaired"
+    assert os.path.isdir(tag6 + ".corrupt"), "corrupt tag not quarantined"
+    for a, b in zip(good, params_of(fresh)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_truncated_shard_rolls_back_too(tmp_path):
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_interval=2)
+    sup.train(4, batch_fn=batch_fn)
+    tag4 = str(tmp_path / "d" / "step4")
+    shard = os.path.join(
+        tag4, [f for f in os.listdir(tag4) if f.startswith("shards_p")][0])
+    faults.truncate_file(128)({"path": shard})
+    fresh = make_engine()
+    sup2 = ResilientTrainer(fresh, str(tmp_path / "d"))
+    assert sup2.resume(example_batch=batch_fn(0)) == "step2"
+    assert fresh.global_steps == 2
+
+
+# --------------------------------------- save retry + latest gating + rotation
+
+
+def test_save_retries_transient_failures_and_gates_latest(tmp_path):
+    """Two distinct save-failure modes, both healed by bounded retry:
+    an IOError before the write, and silent corruption AFTER the durable
+    rename (caught by post-save verification — the `latest` pointer
+    never advances past a checkpoint that fails its integrity check)."""
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_retries=3,
+                           retry_backoff_s=0.01)
+    eng.train_batch(batches=[batch_fn(0)])
+
+    inj = faults.FaultInjector(seed=0)
+    inj.on("ckpt.shard_write", nth=1, exc=IOError("transient disk error"))
+    with faults.injected(inj):
+        sup.save("tagA")
+    assert sup.report.save_retries == 1 and sup.report.saves == 1
+    assert sup._read_latest() == "tagA"
+
+    inj2 = faults.FaultInjector(seed=0)
+    inj2.on("ckpt.shard_written", nth=1, action=faults.corrupt_file())
+    with faults.injected(inj2):
+        sup.save("tagB")
+    assert sup.report.save_retries == 2, \
+        "post-rename corruption must fail verification and retry"
+    assert sup._read_latest() == "tagB"
+    assert verify_checkpoint(str(tmp_path / "d" / "tagB"))[0]
+
+    # retry budget exhausted -> the LAST error surfaces, latest untouched
+    inj3 = faults.FaultInjector(seed=0)
+    inj3.on("ckpt.shard_write", times=None, exc=IOError("disk is gone"))
+    with faults.injected(inj3):
+        with pytest.raises(IOError):
+            sup.save("tagC")
+    assert sup._read_latest() == "tagB"
+
+
+def test_preemption_save_respects_grace_budget(tmp_path):
+    """The SIGTERM-to-SIGKILL window (DS_PREEMPTION_GRACE_S / the
+    agent's term_grace_s): the preemption save must not retry-and-sleep
+    past it — surface the error while the process can still log it."""
+    import time as _time
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_retries=3,
+                           retry_backoff_s=1.0)
+    eng.train_batch(batches=[batch_fn(0)])
+    inj = faults.FaultInjector(seed=0)
+    inj.on("ckpt.shard_write", times=None, exc=IOError("disk is gone"))
+    t0 = _time.monotonic()
+    with faults.injected(inj):
+        with pytest.raises(IOError):
+            sup.save("t", budget_s=0.05)
+    assert _time.monotonic() - t0 < 1.0, \
+        "save slept into the SIGKILL window instead of giving up"
+    assert sup.report.save_retries == 1
+
+
+def test_retention_rotates_old_tags(tmp_path):
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_interval=1,
+                           keep_last=2)
+    sup.train(4, batch_fn=batch_fn)
+    assert sup._tags() == ["step3", "step4"], sup._tags()
+    assert sup._read_latest() == "step4"
+
+
+# ------------------------------------------------------------ NaN watchdog
+
+
+def test_nan_watchdog_restores_from_last_good(tmp_path):
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), save_interval=2,
+                           nan_policy="restore", max_nan_events=2)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.loss", step=4, replace=float("nan"))
+    with faults.injected(inj):
+        rep = sup.train(6, batch_fn=batch_fn)
+    assert rep.status == "completed" and eng.global_steps == 6
+    assert rep.nan_events == 1 and rep.restores == 1
+    assert np.isfinite(rep.last_loss)
+    tags = [t for t, *_ in sup.ring.events]
+    assert "resilience/nan_loss" in tags and "resilience/resumed" in tags
+
+
+def test_nan_watchdog_skip_policy_and_divergence_budget(tmp_path):
+    eng = make_engine()
+    sup = ResilientTrainer(eng, str(tmp_path / "d"), nan_policy="skip",
+                           max_nan_events=2)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.loss", step=2, replace=float("nan"))
+    with faults.injected(inj):
+        rep = sup.train(5, batch_fn=batch_fn)
+    assert rep.status == "completed" and rep.nan_events == 1
+
+    eng2 = make_engine()
+    sup2 = ResilientTrainer(eng2, str(tmp_path / "d2"), nan_policy="skip",
+                            max_nan_events=2)
+    inj2 = faults.FaultInjector(seed=0)
+    inj2.on("train.loss", times=None, replace=float("nan"))
+    with faults.injected(inj2):
+        with pytest.raises(DivergenceError):
+            sup2.train(8, batch_fn=batch_fn)
+
+
+# --------------------------------------------- acceptance (c): serving
+
+
+@pytest.fixture(scope="module")
+def gpt2_engine():
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+    model = GPT2(gpt2_tiny())
+    engine = deepspeed_tpu.init_inference(
+        model=model, dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    engine.init_params()
+    return engine
+
+
+def _oracle(engine, prompts, max_new):
+    return [
+        [int(t) for t in
+         engine.generate(p[None], max_new_tokens=m, do_sample=False)[
+             0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+def test_serving_contains_request_error_and_page_exhaustion(gpt2_engine):
+    """Acceptance (c): one request hits an injected error, a page-
+    exhaustion episode is injected mid-run — every OTHER request
+    completes token-exact vs generate(), and the failed/shed ones are
+    reported distinctly (never returned as answers)."""
+    from deepspeed_tpu.serving import ServingScheduler
+    from deepspeed_tpu.serving.page_manager import PagePoolExhausted
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (5, 11, 5, 11)]
+    max_new = [8, 6, 10, 8]
+
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+
+    inj = faults.FaultInjector(seed=0)
+    inj.on("serve.request", match={"rid": reqs[1].rid},
+           exc=RuntimeError("boom"))
+    inj.on("serve.page_alloc", step=6,
+           exc=PagePoolExhausted("injected exhaustion episode"))
+    with faults.injected(inj):
+        got = sched.run()
+
+    assert reqs[1].state == "failed"
+    assert "RuntimeError: boom" in reqs[1].error
+    assert reqs[1].rid not in got, "a failed request is never an answer"
+    shed = [r for r in reqs if r.state == "shed"]
+    assert len(shed) == 1 and "capacity" in shed[0].error
+    assert shed[0].rid not in got
+
+    survivors = [r for r in reqs if r.state == "finished"]
+    assert len(survivors) == 2, [r.state for r in reqs]
+    want = _oracle(gpt2_engine,
+                   [prompts[reqs.index(r)] for r in survivors],
+                   [max_new[reqs.index(r)] for r in survivors])
+    for r, w in zip(survivors, want):
+        assert got[r.rid] == w, \
+            f"request {r.rid} diverged under injected faults"
+
+    # containment cleaned up: every page back, counts distinct
+    assert sched.kv.pool.pages_in_use == 0
+    h = sched.health()
+    assert h["failed"] == 1 and h["shed"] == 1 and h["completed"] == 2
+    assert h["last_error"] and "boom" in h["last_error"]
+
+
+def test_serving_slow_step_injection_feeds_ema(gpt2_engine):
+    """A slow-step fault inflates the EMA the deadline-admission
+    estimate uses — the knob chaos tests turn to exercise shedding."""
+    from deepspeed_tpu.serving import ServingScheduler
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8)
+    r = sched.submit(np.zeros(5, np.int32), max_new_tokens=2)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("serve.step", step=1, action=faults.sleep_s(0.05))
+    with faults.injected(inj):
+        got = sched.run()
+    assert got[r.rid] and sched._ema_step_s > 0.005

@@ -2,6 +2,8 @@
 invariants, the scheduler oracle (token-exact vs per-request generate()),
 backpressure/eviction edge cases, and the single-jit-signature guarantee."""
 
+from collections import deque
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -209,6 +211,110 @@ def test_submit_backpressure_and_oversize_rejection(gpt2_engine):
     sched.submit(np.zeros(4, np.int32), max_new_tokens=2)
     with pytest.raises(QueueFull):
         sched.submit(np.zeros(4, np.int32), max_new_tokens=2)
+
+
+def test_queue_full_backpressure_round_trip(gpt2_engine):
+    """The 429-then-retry cycle: QueueFull at max_queue, the loop drains
+    the queue, and the SAME submission succeeds afterwards — the
+    backpressure signal is transient, not a terminal rejection."""
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8, max_queue=2)
+    prompt = np.zeros(5, np.int32)
+    r1 = sched.submit(prompt, max_new_tokens=2)
+    r2 = sched.submit(prompt, max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        sched.submit(prompt, max_new_tokens=2)
+    # drain: admission frees queue space on the very first step
+    sched.step()
+    r3 = sched.submit(prompt, max_new_tokens=2)   # retry now succeeds
+    got = sched.run()
+    assert set(got) == {r1.rid, r2.rid, r3.rid}
+    assert all(len(t) == 2 for t in got.values())
+    assert sched.kv.pool.pages_in_use == 0
+
+
+def test_page_pool_exhausted_dead_end():
+    """_grow_or_evict's dead-end: the pool is exhausted, the growing
+    slot holds no request, and there is no evictable victim — the
+    PagePoolExhausted raise (not a silent False) is the contract the
+    step loop's shed-on-capacity containment is built on. Pure host
+    logic: no engine needed."""
+    kv = PagedKVManager(num_pages=4, page_size=8, num_slots=2,
+                        max_pages_per_slot=4)
+    sched = ServingScheduler.__new__(ServingScheduler)
+    sched.kv = kv
+    sched.num_slots = 2
+    sched.slot_req = [None, None]
+    sched.lengths = np.zeros(2, np.int32)
+    sched.waiting = deque()
+    sched.step_idx = 0
+    kv.pool.allocate(4)          # a foreign reservation drains the pool
+    with pytest.raises(PagePoolExhausted, match="no evictable request"):
+        sched._grow_or_evict(1, 8)
+    assert kv.slot_page_count(1) == 0, "dead-end leaked pages"
+
+
+def test_cancel_releases_pages_at_step_boundary(gpt2_engine):
+    """req.cancel() mid-flight: the request leaves at the next step
+    boundary with its pages recycled; the others are token-exact."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, 5).astype(np.int32) for _ in range(2)]
+    want = _oracle(gpt2_engine, prompts, [8, 8])
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8)
+    keep = sched.submit(prompts[0], max_new_tokens=8)
+    victim = sched.submit(prompts[1], max_new_tokens=8)
+    sched.step()                  # both admitted + prefilled
+    assert victim.state in ("prefill", "running")
+    victim.cancel()
+    got = sched.run()
+    assert victim.state == "cancelled" and victim.rid not in got
+    assert got[keep.rid] == want[0]
+    assert sched.kv.pool.pages_in_use == 0, "cancel leaked pages"
+    assert sched.metrics.cancelled == 1
+    assert sched.health()["cancelled"] == 1
+
+
+def test_deadline_shedding_is_distinct_from_errors(gpt2_engine):
+    """An already-expired deadline sheds in the queue; an infeasible
+    deadline sheds at admission (EMA-based estimate); both are counted
+    as shed — never failed, never finished-with-partial-tokens."""
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8)
+    ok = sched.submit(np.zeros(5, np.int32), max_new_tokens=3)
+    expired = sched.submit(np.zeros(5, np.int32), max_new_tokens=3,
+                           deadline_s=0.0)
+    got = sched.run()
+    assert expired.state == "shed" and "deadline" in expired.error
+    assert expired.rid not in got and len(got[ok.rid]) == 3
+    # infeasible-at-admission: the EMA from the run above prices a step;
+    # a deadline far below (#steps x EMA) cannot be met
+    assert sched._ema_step_s is not None
+    hopeless = sched.submit(np.zeros(5, np.int32), max_new_tokens=64,
+                            deadline_s=sched._ema_step_s * 0.5)
+    sched.run()
+    # shed either at admission (infeasible estimate) or by the queue
+    # sweep if the deadline already lapsed — never failed, never served
+    assert hopeless.state == "shed"
+    assert "deadline" in hopeless.error or "infeasible" in hopeless.error
+    assert sched.metrics.shed == 2 and sched.metrics.failed == 0
+
+
+def test_completed_history_is_bounded(gpt2_engine):
+    """The memory-leak fix: finished requests drain from the live map
+    into a bounded deque instead of accumulating forever."""
+    sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8, completed_history=4)
+    for _ in range(6):
+        sched.submit(np.zeros(5, np.int32), max_new_tokens=1)
+    sched.run()
+    assert len(sched.requests) == 0, "live map must drain on retire"
+    assert len(sched.completed) == 4, "history must stay bounded"
+    assert sched.metrics.completed == 6
 
 
 def test_single_jit_signature_across_churn(gpt2_engine):

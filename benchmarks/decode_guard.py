@@ -57,8 +57,11 @@ def main():
         pos[..., 3 * L // 4:] = -1e30
         bias = jnp.asarray(pos)
 
+        # force_kernel: off-TPU decode_attention now routes interpret
+        # mode to the jnp reference (serving hot path); this guard
+        # exists to time the KERNEL, so pin it explicitly
         kernel = jax.jit(lambda q, k, v, bias: decode_attention(
-            q, k, v, bias=bias))
+            q, k, v, bias=bias, force_kernel=True))
 
         def ref(q, k, v, bias):
             kf = _repeat_kv(k, h // kv_h)

@@ -508,6 +508,47 @@ class InferenceEngine:
                                  top_k, top_p)
             return nxt.astype(jnp.int32), {"layers": cache["layers"]}
 
+        def decode_multi(params, tok, active, page_table, lengths, pools,
+                         emitted, budgets, eos_ids, rng, horizon, do_sample,
+                         temperature, top_k, top_p):
+            """``horizon`` fused decode steps as ONE dispatch (lax.scan):
+            token feedback, the active mask, per-slot lengths and EOS /
+            budget freezing all stay on device — the host sees one token
+            block per horizon instead of one round-trip per token (the
+            continuous-batching counterpart of generate()'s
+            _decode_loop_fn).
+
+            Per-slot freeze rules, matching the scheduler's host logic
+            exactly so fused output is token-identical to the single-step
+            path: a slot freezes after sampling ``eos_ids[slot]`` (-1 =
+            no eos) or once its cumulative ``emitted`` count reaches
+            ``budgets[slot]`` (= remaining_new at the chain's start;
+            ``emitted`` is a carry so chained dispatches continue the
+            count). Frozen slots write no K/V, advance no length, and
+            emit ``valid=False`` rows."""
+            def body(carry, i):
+                tok, active, lengths, emitted, layers = carry
+                cache = {"layers": layers, "page_table": page_table,
+                         "lengths": lengths, "active": active}
+                logits, cache = module.apply(
+                    {"params": materialize(params)}, tok[:, None],
+                    cache=cache)
+                nxt = _sample_tokens(logits[:, 0],
+                                     jax.random.fold_in(rng, i), do_sample,
+                                     temperature, top_k, top_p)
+                nxt = jnp.where(active, nxt.astype(jnp.int32), tok)
+                emitted = emitted + active.astype(jnp.int32)
+                new_active = active & (nxt != eos_ids) & (emitted < budgets)
+                return (nxt, new_active, cache["lengths"], emitted,
+                        cache["layers"]), (nxt, active)
+            (tok, active, lengths, emitted, layers), (toks, valid) = \
+                jax.lax.scan(body,
+                             (tok, active, lengths, emitted,
+                              pools["layers"]),
+                             jnp.arange(horizon))
+            return (toks.T, valid.T, tok, active, lengths, emitted,
+                    {"layers": layers})
+
         # pools replicate over the mesh (pinned out_shardings so the
         # donated round-trip keeps ONE jit signature: an inferred
         # sharding that differed from init_paged_cache's would compile a
@@ -518,6 +559,13 @@ class InferenceEngine:
         self._paged_decode_fn = jax.jit(decode, donate_argnums=(5,),
                                         static_argnums=(7, 8, 9, 10),
                                         out_shardings=(rep, rep))
+        # one compiled signature per (horizon, sampling) combo — the
+        # scheduler quantizes horizons to a small bucket set so the
+        # compile count stays bounded across slot churn
+        self._paged_decode_multi_fn = jax.jit(
+            decode_multi, donate_argnums=(5,),
+            static_argnums=(10, 11, 12, 13, 14),
+            out_shardings=tuple([rep] * 7))
 
     def prefill_into_slots(self, ids_chunk, slot, n_valid, page_table,
                            lengths, pools):
@@ -554,20 +602,75 @@ class InferenceEngine:
                 bool(do_sample), float(temperature), int(top_k),
                 float(top_p))
 
+    def decode_multi(self, toks, active, page_table, lengths, pools, *,
+                     horizon, budgets, eos_ids, emitted=None,
+                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
+        """``horizon`` continuous-batching decode steps as ONE dispatch.
+
+        Returns ``(toks_block [slots, H] i32, valid [slots, H] bool,
+        tok_end, active_end, lengths_end, emitted_end, new pools)``.
+        ``valid[s, i]`` marks a genuinely sampled token; rows after a
+        slot hits its eos id or exhausts ``budgets[slot]`` are frozen
+        padding. The ``*_end`` carries are device arrays that can feed
+        the next ``decode_multi`` call directly (the overlapped serving
+        loop chains horizons without a host round-trip); ``emitted``
+        must then be threaded through so budget accounting spans the
+        chain. ``toks``/``active``/``lengths`` accept host numpy or the
+        previous call's device carries interchangeably."""
+        assert self.params is not None, "set_params/init_params first"
+        if getattr(self, "_paged_decode_multi_fn", None) is None:
+            self._build_serving_fns()
+        self._rng, rng = jax.random.split(self._rng)
+        rep = NamedSharding(self.mesh, P())
+        if emitted is None:
+            emitted = np.zeros(np.shape(budgets), np.int32)
+        # host inputs get the SAME committed (replicated) sharding the
+        # *_end carries come back with, so barrier dispatches and chained
+        # dispatches share one compiled signature per horizon bucket
+        put = lambda x, dt: jax.device_put(jnp.asarray(x, dt), rep)
+        with dist.mesh_scope(self.mesh):
+            return self._paged_decode_multi_fn(
+                self.params, put(toks, jnp.int32), put(active, bool),
+                put(page_table, jnp.int32), put(lengths, jnp.int32),
+                pools, put(emitted, jnp.int32), put(budgets, jnp.int32),
+                put(eos_ids, jnp.int32), rng, int(horizon),
+                bool(do_sample), float(temperature), int(top_k),
+                float(top_p))
+
     def sample_from_logits(self, logits, do_sample=False, temperature=1.0,
                            top_k=0, top_p=1.0):
-        """Sample one token from a [vocab] logits row (the serving
-        scheduler's prefill-boundary sample — same `_sample_tokens` math
-        as generate())."""
+        """Sample from logits (same `_sample_tokens` math as generate()).
+        A single [vocab] row returns an int; a list of rows (or an
+        [n, vocab] batch) samples every row in ONE device call and
+        returns a list — the serving scheduler batches all slots
+        finishing prefill in a step this way instead of paying one tiny
+        dispatch per slot. Sampled mode draws one rng split per CALL
+        (not per row), so batching changes the stream; greedy decoding
+        is unaffected."""
+        if isinstance(logits, (list, tuple)):
+            rows = jnp.stack([jnp.asarray(r) for r in logits])
+        else:
+            rows = jnp.asarray(logits)
+        single = rows.ndim == 1
+        if single:
+            rows = rows[None]
         self._rng, rng = jax.random.split(self._rng)
-        tok = _sample_tokens(jnp.asarray(logits)[None], rng, do_sample,
-                             temperature, top_k, top_p)
-        return int(np.asarray(jax.device_get(tok))[0])
+        toks = _sample_tokens(rows, rng, do_sample, temperature, top_k,
+                              top_p)
+        out = [int(t) for t in np.asarray(jax.device_get(toks))]
+        return out[0] if single else out
 
     def serving_decode_compile_count(self):
         """Number of compiled signatures behind decode_step (the
         no-per-step-recompilation guarantee: stays 1 across churn)."""
         fn = getattr(self, "_paged_decode_fn", None)
+        return 0 if fn is None else fn._cache_size()
+
+    def serving_decode_multi_compile_count(self):
+        """Compiled signatures behind decode_multi — bounded by the
+        scheduler's horizon bucket set (one per distinct horizon, per
+        sampling combo), never by request churn."""
+        fn = getattr(self, "_paged_decode_multi_fn", None)
         return 0 if fn is None else fn._cache_size()
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

@@ -258,6 +258,13 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
     ``bias`` (optional, broadcastable to [slots, heads, 1, max_len])
     carries extra additive terms (ALiBi); when present the fallback path
     runs (the paged kernel computes only the positional mask in-kernel).
+
+    Both paths are ``lax.scan``-compatible: every branch decision here
+    is made on static python values, and ``positions``/``page_table``
+    may be traced carries — the fused multi-step serving decode
+    (``InferenceEngine.decode_multi``) scans this step with on-device
+    token feedback, so nothing in here may force a host sync or a
+    per-iteration retrace.
     """
     slots, l, h, d = q.shape
     page_size = k_pages.shape[1]
@@ -295,14 +302,22 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
 
 
 def decode_attention(q, k_cache, v_cache, *, bias, scale=None,
-                     interpret=None, block_k=None):
+                     interpret=None, block_k=None, force_kernel=False):
     """Attention of `q` [b, l, heads, d] over a cache buffer
     [b, max_len, kv_heads, d] with additive `bias` (broadcastable to
     [b, heads, l, max_len]) carrying the validity mask.
 
-    Single-token decode (l == 1) runs the Pallas kernel; multi-token
-    (prefill into a cache) falls back to the jnp oracle. GQA caches
-    (kv_heads < heads) are consumed directly by the kernel.
+    Single-token decode (l == 1) runs the Pallas kernel on TPU;
+    multi-token (prefill into a cache) falls back to the jnp oracle. GQA
+    caches (kv_heads < heads) are consumed directly by the kernel.
+
+    Off-TPU the kernel would run in interpret mode — a grid of emulated
+    Mosaic steps that is both slower at runtime than the plain jnp
+    reference and much heavier to trace, which matters now that the
+    serving decode loops this step under ``lax.scan``
+    (``InferenceEngine.decode_multi`` traces the body once per horizon
+    bucket). Interpret-mode decode therefore routes to the reference
+    path unless ``force_kernel`` pins the kernel (parity tests).
     """
     from deepspeed_tpu.ops.attention.reference import mha_reference
 
@@ -313,7 +328,8 @@ def decode_attention(q, k_cache, v_cache, *, bias, scale=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    if l == 1 and h % kv_h == 0 and max_len % (block_k or 128) == 0:
+    if l == 1 and h % kv_h == 0 and max_len % (block_k or 128) == 0 and \
+            (force_kernel or not interpret):
         block_k = block_k or _pick_block(max_len)
         bias_full = jnp.broadcast_to(
             bias.astype(jnp.float32), (b, h, 1, max_len))

@@ -25,14 +25,29 @@ point                 site                                     ctx keys
                       SIGTERM to self (preemption)
 ``train.loss``        transform of train_batch's returned      ``step``
                       loss — force NaN for watchdog tests
-``serve.step``        entry of ``ServingScheduler.step``       ``step``
+``serve.step``        entry of ``ServingScheduler.step``.      ``step``
+                      Since the fused-decode change one step
+                      is one decode HORIZON (up to
+                      ``decode_horizon_steps`` tokens per
+                      slot), not one token: step-keyed plans
+                      written against per-token timing should
+                      pin ``decode_horizon_steps=1``
 ``serve.request``     per-request, before a token is emitted   ``step``,
                       — containment: the error must fail one   ``rid``
-                      request, not the loop
-``serve.page_alloc``  inside ``_grow_or_evict`` — raise
-                      :class:`PagePoolExhausted` to force a    ``step``,
-                      page-exhaustion episode on an exact      ``slot``,
-                      step regardless of actual pool size      ``rid``
+                      request, not the loop. Fires once at the
+                      prefill-boundary first token and then
+                      per token during horizon HARVEST, so a
+                      raised decode-phase error lands at the
+                      horizon boundary
+``serve.page_alloc``  inside ``_grow_or_evict`` (horizon page
+                      pre-reservation + prefill growth) and    ``step``,
+                      the chained-dispatch reservation — raise ``slot``,
+                      :class:`PagePoolExhausted` to force a    ``rid``
+                      page-exhaustion episode on an exact
+                      step regardless of actual pool size
+                      (during a chained dispatch it aborts
+                      the chain to the barrier path instead
+                      of shedding)
 ====================  =======================================  ==========
 
 Usage::

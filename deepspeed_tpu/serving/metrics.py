@@ -24,6 +24,7 @@ class ServingMetrics:
         self.monitor = monitor        # MonitorMaster-compatible (or None)
         self.ttft_s = []              # submit -> first token, per request
         self.tpot_s = []              # inter-token gaps, per token
+        self.tbt_s = []               # horizon-boundary gaps, per request
         self.completed = 0
         self.failed = 0               # per-request error, contained
         self.shed = 0                 # deadline/capacity load shedding
@@ -32,21 +33,51 @@ class ServingMetrics:
         self.tokens_emitted = 0
         self.page_util = []           # pool utilization per step
         self.queue_depths = []
+        self.horizons = []            # fused decode horizon per harvest
+        self.device_wait_s = 0.0      # step time blocked on the device
+        self.host_s = 0.0             # step time doing host bookkeeping
         self._events = []
 
     # ---------------------------------------------------------- recording
     def record_step(self, step, *, queue_depth, running, waiting,
-                    page_utilization):
+                    page_utilization, device_wait_s=0.0, host_s=0.0):
         self.page_util.append(page_utilization)
         self.queue_depths.append(queue_depth)
+        self.device_wait_s += device_wait_s
+        self.host_s += host_s
         self._events = [
             ("serving/queue_depth", queue_depth, step),
             ("serving/running", running, step),
             ("serving/waiting", waiting, step),
             ("serving/page_utilization", page_utilization, step),
+            ("serving/device_wait_ms", device_wait_s * 1e3, step),
+            ("serving/host_ms", host_s * 1e3, step),
         ]
         if self.monitor is not None:
             self.monitor.write_events(self._events)
+
+    def record_tbt(self, step, gap_s):
+        """Time-between-token-bursts at HORIZON granularity: the gap a
+        streaming client sees between one request's consecutive token
+        deliveries.  With fused horizons tokens arrive in bursts, so
+        this — not the intra-burst tpot gap — is the client-visible
+        latency cadence."""
+        self.tbt_s.append(gap_s)
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("serving/tbt_ms", gap_s * 1e3, step)])
+
+    def record_horizon(self, step, horizon, tokens, device_wait_s):
+        """One fused decode horizon was harvested: its step count, the
+        tokens it delivered, and how long the host blocked waiting for
+        the device (0 when the overlapped copy had already landed)."""
+        self.horizons.append(horizon)
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serving/horizon", horizon, step),
+                ("serving/horizon_tokens", tokens, step),
+                ("serving/horizon_wait_ms", device_wait_s * 1e3, step),
+            ])
 
     def record_first_token(self, step, ttft_s):
         self.ttft_s.append(ttft_s)
@@ -96,6 +127,14 @@ class ServingMetrics:
             "tpot_ms_p50": round(_percentile(self.tpot_s, 50) * 1e3, 3),
             "tpot_ms_p90": round(_percentile(self.tpot_s, 90) * 1e3, 3),
             "tpot_ms_p99": round(_percentile(self.tpot_s, 99) * 1e3, 3),
+            "tbt_ms_p50": round(_percentile(self.tbt_s, 50) * 1e3, 3),
+            "tbt_ms_p90": round(_percentile(self.tbt_s, 90) * 1e3, 3),
+            "tbt_ms_p99": round(_percentile(self.tbt_s, 99) * 1e3, 3),
+            "horizon_mean": round(float(np.mean(self.horizons)), 3)
+            if self.horizons else 0.0,
+            "device_wait_frac": round(
+                self.device_wait_s / (self.device_wait_s + self.host_s), 4)
+            if (self.device_wait_s + self.host_s) > 0 else 0.0,
             "page_util_mean": round(float(np.mean(self.page_util)), 4)
             if self.page_util else 0.0,
             "page_util_peak": round(float(np.max(self.page_util)), 4)

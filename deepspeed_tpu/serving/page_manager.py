@@ -10,8 +10,9 @@ sequence and external fragmentation is zero by construction.
 
 The device never sees this class: the scheduler passes ``table`` /
 lengths as small int32 inputs into the fixed-shape jitted primitives
-(``InferenceEngine.prefill_into_slots`` / ``decode_step``), so request
-churn never changes a jit signature.
+(``InferenceEngine.prefill_into_slots`` / ``decode_multi``), so request
+churn never changes a jit signature (fused decode compiles once per
+horizon bucket, never per churn).
 """
 
 import numpy as np
@@ -105,6 +106,15 @@ class PagedKVManager:
 
     def slot_page_count(self, slot):
         return len(self._slot_pages[slot])
+
+    def pages_needed(self, slot, target_len):
+        """Additional pages ``slot`` must allocate to hold positions
+        < target_len (0 when already covered).  The serving scheduler's
+        horizon pre-reservation sums this across running slots to decide
+        whether a fused multi-step decode fits in free pages before
+        dispatching it."""
+        return max(0, self.pool.pages_for_tokens(target_len) -
+                   len(self._slot_pages[slot]))
 
     def ensure_capacity(self, slot, target_len):
         """Grow ``slot``'s table until positions < target_len are

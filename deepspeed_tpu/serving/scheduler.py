@@ -12,15 +12,46 @@ granularity.  Each ``step()`` is one scheduler iteration:
   3. advance every admitted-but-unprefilled slot by ONE prompt chunk
      (chunked prefill — long prompts never stall running decoders for
      more than a chunk),
-  4. run ONE fixed-shape decode step over all running slots,
+  4. run ONE fused multi-step decode ("horizon") over all running
+     slots: up to ``decode_horizon_steps`` tokens per slot in a single
+     ``decode_multi`` dispatch, with token feedback, EOS detection and
+     length advancement all on device,
   5. emit observability events.
 
-All device work goes through the two jit-stable primitives on
-``InferenceEngine`` (``prefill_into_slots`` / ``decode_step``); the
+All device work goes through the jit-stable primitives on
+``InferenceEngine`` (``prefill_into_slots`` / ``decode_multi``); the
 scheduler itself is pure host logic.  When the page pool runs dry the
 youngest running request is preempted (recompute-style eviction: its
 pages recycle, the request re-queues at the queue head with its
 already-emitted tokens folded into the prompt).
+
+**The horizon model.**  A horizon of H steps costs ONE dispatch and one
+host round-trip for H tokens — the per-token host loop that dominates
+decode latency over a TPU relay is amortized H-fold (the same trick
+``generate()`` plays with its bucketed ``lax.scan``).  The price is
+granularity: scheduler interventions — admission, cancellation,
+deadline shedding, eviction — take effect at horizon boundaries, so H
+bounds added reaction latency at roughly H x per-token time.  Horizons
+are quantized to a small power-of-two bucket set (compile count stays
+bounded) and adapt down when remaining token budgets, the tightest
+admitted deadline, or page-pool pressure make a full horizon wasteful
+or unaffordable.  Before each dispatch every running slot's pages for
+the whole horizon are pre-reserved, so allocation never interrupts the
+fused scan.
+
+**Overlap.**  With ``overlap=True`` the scheduler keeps one horizon in
+flight: when membership is provably frozen (nothing waiting, nothing
+prefilling, no cancel/deadline pressure, next horizon's pages free), it
+dispatches horizon k+1 directly off horizon k's on-device carries
+(token/active/lengths/emitted), *then* pulls k's token block (started
+as an async host copy at dispatch) and runs emit/retire bookkeeping
+while the device crunches k+1.  Any membership change falls back to a
+conservative barrier: drain in-flight work, apply host-authoritative
+state, dispatch fresh.  Per-request terminations discovered while a
+chained horizon is in flight (a failing emit callback, a cancel, an
+expired deadline) close the request immediately but defer the page
+release until the in-flight horizon is harvested — the device may still
+be writing that slot's pages.
 
 Failure policy (the serving half of docs/resilience.md):
 
@@ -115,7 +146,8 @@ class ServingScheduler:
     def __init__(self, engine, *, num_slots=8, num_pages=64, page_size=None,
                  max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
-                 top_p=1.0, completed_history=4096):
+                 top_p=1.0, completed_history=4096, decode_horizon_steps=8,
+                 overlap=True):
         if page_size is None:
             # the paged Pallas decode kernel needs 128-multiple pages
             # (TPU lane tiling); anything smaller silently drops every
@@ -153,6 +185,23 @@ class ServingScheduler:
         self._last_error = None
         self.sampling = dict(do_sample=do_sample, temperature=temperature,
                              top_k=top_k, top_p=top_p)
+        # fused decode horizons: power-of-two buckets up to the max so
+        # varying horizon choices share a bounded set of compiled
+        # signatures (decode_horizon_steps=1 recovers the legacy
+        # one-token-per-step loop exactly)
+        self.decode_horizon_steps = max(1, int(decode_horizon_steps))
+        buckets, b = {1}, 1
+        while b < self.decode_horizon_steps:
+            b = min(b * 2, self.decode_horizon_steps)
+            buckets.add(b)
+        self.horizon_buckets = sorted(buckets)
+        self.overlap = bool(overlap)
+        self._inflight = deque()       # dispatched horizons, FIFO, depth<=2
+        self._zombies = set()          # slots terminated host-side while a
+                                       # chained horizon still runs them
+        self._chain_budgets = None     # budgets baseline for the live chain
+        self._eos_ids = np.full(num_slots, -1, np.int32)
+        self._tok_window = deque(maxlen=32)   # per-token wall time samples
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -285,12 +334,14 @@ class ServingScheduler:
     # ----------------------------------------------------- failure policy
     def _estimated_service_steps(self, req):
         """Scheduler iterations this request still needs if admitted
-        now: remaining prefill chunks + one decode step per remaining
-        token (ignores queueing ahead of it — a deliberately optimistic
-        bound, so shedding only fires on certainly-hopeless requests)."""
+        now: remaining prefill chunks + one decode horizon per
+        ``decode_horizon_steps`` remaining tokens (ignores queueing
+        ahead of it — a deliberately optimistic bound, so shedding only
+        fires on certainly-hopeless requests)."""
         prefill = -(-max(0, len(req.prompt) - req.prefill_pos)
                     // self.prefill_chunk)
-        return prefill + max(1, req.remaining_new)
+        horizons = -(-max(1, req.remaining_new) // self.decode_horizon_steps)
+        return prefill + horizons
 
     def _step_s_estimate(self):
         """Robust per-step wall-time estimate for admission decisions:
@@ -307,10 +358,10 @@ class ServingScheduler:
         eta = now + self._estimated_service_steps(req) * est
         return eta > req.deadline
 
-    def _sweep(self):
+    def _sweep(self, now):
         """Step-boundary honoring of cancellations and deadlines, for
-        both queued and running requests."""
-        now = time.monotonic()
+        both queued and running requests.  ``now`` is the phase's single
+        timestamp: every decision in one sweep prices time identically."""
         for slot in range(self.num_slots):
             req = self.slot_req[slot]
             if req is None:
@@ -333,20 +384,74 @@ class ServingScheduler:
 
     # -------------------------------------------------------------- step
     def step(self):
-        """One scheduler iteration; returns True if any work remains."""
+        """One scheduler iteration; returns True if any work remains.
+
+        One iteration dispatches (and harvests) one fused decode
+        *horizon* — up to ``decode_horizon_steps`` tokens per running
+        slot — rather than a single token.  Boundary work (sweep, admit,
+        prefill) runs on every step whose host state is authoritative,
+        i.e. every step that is not a purely chained continuation of an
+        in-flight horizon."""
         self.step_idx += 1
         t_step = time.monotonic()
-        # fault point: slow-step / loop-level fault injection
+        # fault point: slow-step / loop-level fault injection. Fires per
+        # HORIZON since the fused-decode change — with
+        # decode_horizon_steps > 1 a "step" covers up to that many
+        # tokens (docs/resilience.md documents the timing change).
         faults.fire("serve.step", step=self.step_idx)
 
-        # 1. cancellations + deadlines leave at the boundary
-        self._sweep()
+        t_wait, pulled = 0.0, 0
+        chained = False
+        if self._inflight:
+            if self.overlap:
+                # overlap: put the NEXT horizon on the device before
+                # doing this one's host bookkeeping
+                chained = self._try_chain()
+            w, n = self._harvest()
+            t_wait += w
+            pulled += n
+        if not chained:
+            # conservative barrier: membership may change below, so no
+            # horizon may remain in flight (its page-table snapshot
+            # would go stale and eviction could corrupt live pages)
+            while self._inflight:
+                w, n = self._harvest()
+                t_wait += w
+                pulled += n
+            now = time.monotonic()
+            # 1. cancellations + deadlines leave at the boundary
+            self._sweep(now)
+            # 2. admit waiting requests into free slots (retirement
+            # happens at harvest, so slots are already recycled)
+            self._admit(now)
+            # 3. one prompt chunk per prefilling slot (chunked prefill)
+            self._prefill()
+            # 4. dispatch ONE fused decode horizon over running slots
+            self._dispatch()
+            if not self.overlap and self._inflight:
+                w, n = self._harvest()
+                t_wait += w
+                pulled += n
 
-        # 2. admit waiting requests into free slots (retirement happens
-        # inline as tokens are observed, so slots are already recycled)
-        now = time.monotonic()
+        # 5. observability
+        dt = time.monotonic() - t_step
+        self._step_window.append(dt)
+        if pulled:
+            self._tok_window.append(dt / pulled)
+        self._ema_step_s = dt if self._ema_step_s is None \
+            else 0.8 * self._ema_step_s + 0.2 * dt
+        n_running = sum(r is not None for r in self.slot_req)
+        self.metrics.record_step(
+            self.step_idx, queue_depth=len(self.waiting),
+            running=n_running, waiting=len(self.waiting),
+            page_utilization=self.kv.utilization(),
+            device_wait_s=t_wait, host_s=max(0.0, dt - t_wait))
+        return bool(self.waiting) or n_running > 0 or bool(self._inflight)
+
+    # ------------------------------------------------- boundary phases
+    def _admit(self, now):
         for slot in range(self.num_slots):
-            if self.slot_req[slot] is not None:
+            if self.slot_req[slot] is not None or slot in self._zombies:
                 continue
             # deadline-aware admission: shed what cannot finish in time
             # instead of admitting it and wasting pool pages
@@ -366,12 +471,20 @@ class ServingScheduler:
             self.waiting.popleft()
             self.slot_req[slot] = req
             req.state = PREFILL
-            req.t_admit = time.monotonic()
+            # one timestamp per phase: admission decisions within a step
+            # price time identically (no per-slot clock reads)
+            req.t_admit = now
+            self._eos_ids[slot] = -1 if req.eos_token_id is None \
+                else int(req.eos_token_id)
             self.lengths[slot] = 0
 
-        # 3. one prompt chunk per prefilling slot (chunked prefill).
-        # The whole body is attributable to ONE request, so containment
-        # wraps it: a per-request failure frees the slot and moves on.
+    def _prefill(self):
+        """One prompt chunk per prefilling slot.  The per-slot body is
+        attributable to ONE request, so containment wraps it: a
+        per-request failure frees the slot and moves on.  Slots
+        finishing their prompt this step sample their first token in
+        ONE batched device call instead of one tiny dispatch each."""
+        finishing = []
         for slot in range(self.num_slots):
             req = self.slot_req[slot]
             if req is None or req.state != PREFILL:
@@ -390,34 +503,80 @@ class ServingScheduler:
                 self.lengths[slot] += n_valid
                 req.prefill_pos += n_valid
                 if req.prefill_pos == len(req.prompt):
-                    tok = self.engine.sample_from_logits(logits,
-                                                         **self.sampling)
-                    self._emit(req, tok)
-                    if req._finished_by(tok):
-                        self._retire(slot)
-                    else:
-                        self.last_tok[slot] = tok
-                        req.state = RUNNING
+                    finishing.append((slot, req, logits))
             except PagePoolExhausted as e:
                 self._close_slot(slot, SHED, f"page capacity: {e}")
             except Exception as e:   # containment: fail one, not all
                 self._close_slot(slot, FAILED,
                                  f"{type(e).__name__}: {e}")
-
-        # 4. one decode step over every running slot
-        candidates = [s for s in range(self.num_slots)
-                      if self.slot_req[s] is not None and
-                      self.slot_req[s].state == RUNNING]
-        kept = []
-        for slot in candidates:
-            if self.slot_req[slot] is None or \
-                    self.slot_req[slot].state != RUNNING:
-                continue   # evicted by an earlier slot's growth
-            # the pending token writes at position lengths[slot] — make
-            # sure its page exists (this is where decode-time growth and
-            # eviction happen)
+        if not finishing:
+            return
+        # the batched sample is shared work (like the decode dispatch);
+        # emit/callback stays contained per request below
+        toks = self.engine.sample_from_logits(
+            [lg for _, _, lg in finishing], **self.sampling)
+        for (slot, req, _), tok in zip(finishing, toks):
+            if self.slot_req[slot] is not req or req.state != PREFILL:
+                continue   # a later slot's growth evicted this one
             try:
-                if self._grow_or_evict(slot, int(self.lengths[slot]) + 1):
+                self._emit(req, tok)
+            except Exception as e:
+                self._close_slot(slot, FAILED, f"{type(e).__name__}: {e}")
+                continue
+            if req._finished_by(tok):
+                self._retire(slot)
+            else:
+                self.last_tok[slot] = tok
+                req.state = RUNNING
+
+    # -------------------------------------------------- horizon decode
+    def _bucket_floor(self, h):
+        out = 1
+        for b in self.horizon_buckets:
+            if b <= h:
+                out = b
+        return out
+
+    def _pick_horizon(self, running, now):
+        """Largest useful horizon, quantized to the bucket set: capped
+        by the largest remaining token budget among running slots (scan
+        steps past every budget are pure waste) and by the tightest live
+        deadline (a horizon overshooting a deadline generates tokens the
+        sweep will throw away)."""
+        h = min(self.decode_horizon_steps,
+                max(self.slot_req[s].remaining_new for s in running))
+        deadlines = [self.slot_req[s].deadline for s in running
+                     if self.slot_req[s].deadline is not None]
+        if deadlines and self._tok_window:
+            per_tok = float(np.median(self._tok_window))
+            if per_tok > 0:
+                slack = min(deadlines) - now
+                h = max(1, min(h, int(slack / per_tok)))
+        return self._bucket_floor(h)
+
+    def _reserve(self, running, horizon):
+        """Pre-reserve every running slot's pages for the whole horizon
+        so growth never interrupts the fused scan.  Under pool pressure
+        the horizon shrinks bucket-by-bucket before any eviction runs;
+        at horizon 1 the legacy evict/shed policy applies unchanged.
+        Returns (horizon, surviving slots)."""
+        while horizon > 1:
+            need = sum(self.kv.pages_needed(
+                s, int(self.lengths[s]) +
+                min(horizon, self.slot_req[s].remaining_new))
+                for s in running)
+            if need <= self.kv.pool.free_pages:
+                break
+            horizon = self._bucket_floor(horizon - 1)
+        kept = []
+        for slot in running:
+            req = self.slot_req[slot]
+            if req is None or req.state != RUNNING:
+                continue   # evicted by an earlier slot's growth
+            budget = min(horizon, req.remaining_new)
+            try:
+                if self._grow_or_evict(slot,
+                                       int(self.lengths[slot]) + budget):
                     kept.append(slot)
             except PagePoolExhausted as e:
                 self._close_slot(slot, SHED, f"page capacity: {e}")
@@ -425,43 +584,205 @@ class ServingScheduler:
                 self._close_slot(slot, FAILED,  # growth is per-slot work
                                  f"{type(e).__name__}: {e}")
         # a later slot's growth can evict an earlier kept slot too
-        running = [s for s in kept if self.slot_req[s] is not None and
+        return horizon, [s for s in kept if self.slot_req[s] is not None
+                         and self.slot_req[s].state == RUNNING]
+
+    def _dispatch(self):
+        """Reserve pages and launch one fused horizon over every running
+        slot.  The batched dispatch is shared — an error here is NOT
+        attributable to one request and must surface loudly."""
+        running = [s for s in range(self.num_slots)
+                   if self.slot_req[s] is not None and
                    self.slot_req[s].state == RUNNING]
-        if running:
-            # the batched dispatch is shared — an error here is NOT
-            # attributable to one request and must surface loudly
-            active = np.zeros(self.num_slots, bool)
-            active[running] = True
-            toks, self.pools = self.engine.decode_step(
-                self.last_tok, active, self.kv.table, self.lengths,
-                self.pools, **self.sampling)
-            toks = np.asarray(toks)
-            self.lengths[running] += 1
-            for slot in running:
-                req = self.slot_req[slot]
-                tok = int(toks[slot])
+        if not running:
+            return
+        horizon, running = self._reserve(
+            running, self._pick_horizon(running, time.monotonic()))
+        if not running:
+            return
+        active = np.zeros(self.num_slots, bool)
+        active[running] = True
+        budgets = np.zeros(self.num_slots, np.int32)
+        for s in running:
+            budgets[s] = self.slot_req[s].remaining_new
+        # budgets baseline for any chained continuation: the device's
+        # `emitted` carry counts from THIS dispatch
+        self._chain_budgets = budgets
+        out = self.engine.decode_multi(
+            self.last_tok, active, self.kv.table, self.lengths, self.pools,
+            horizon=horizon, budgets=budgets, eos_ids=self._eos_ids,
+            **self.sampling)
+        self._commit_dispatch(out, running, horizon,
+                              {s: self.slot_req[s] for s in running})
+
+    def _commit_dispatch(self, out, running, horizon, reqs):
+        toks, valid, tok_end, active_end, lengths_end, emitted_end, pools \
+            = out
+        self.pools = pools
+        for arr in (toks, valid):
+            # overlap: the host copy starts NOW, so the harvest one
+            # horizon later rarely stalls on the device
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        self._inflight.append({
+            "slots": list(running), "reqs": reqs, "horizon": horizon,
+            # per-slot upper bound on length advance during this horizon
+            # (drives the NEXT chained reservation; actual advance is
+            # only known at harvest)
+            "max_advance": {s: int(min(horizon, reqs[s].remaining_new))
+                            for s in running},
+            "toks": toks, "valid": valid, "tok_end": tok_end,
+            "active_end": active_end, "lengths_end": lengths_end,
+            "emitted_end": emitted_end, "release_after": set(),
+        })
+
+    def _try_chain(self):
+        """Dispatch the next horizon straight off the in-flight
+        horizon's device carries — no host round-trip — when membership
+        is provably frozen: nothing waiting or prefilling, no
+        cancel/deadline pressure, and the next horizon's worst-case page
+        growth fits in FREE pages.  A chained dispatch never evicts:
+        eviction while the device is still writing a victim's pages
+        would corrupt the new owner's cache.  Returns True when the
+        chained horizon was dispatched."""
+        prev = self._inflight[-1]
+        if self.waiting:
+            return False
+        live = [r for r in self.slot_req if r is not None]
+        if any(r.state == PREFILL for r in live):
+            return False
+        if any(r.cancelled or r.deadline is not None for r in live):
+            return False
+        cont = [s for s in prev["slots"]
+                if self.slot_req[s] is prev["reqs"][s] and
+                prev["reqs"][s].state == RUNNING and
+                s not in self._zombies]
+        if not cont:
+            return False
+        if all(prev["reqs"][s].remaining_new - prev["max_advance"][s] <= 0
+               for s in cont):
+            # the in-flight horizon exhausts every continuing slot's
+            # budget: the chained dispatch would scan H steps over
+            # all-frozen slots and emit nothing — take the barrier path
+            return False
+        # remaining_new is an upper bound here (the in-flight horizon's
+        # tokens are not appended yet): safe for horizon sizing and page
+        # reservation, both of which only over-provision
+        horizon = self._bucket_floor(
+            min(self.decode_horizon_steps,
+                max(prev["reqs"][s].remaining_new for s in cont)))
+        targets, need = {}, 0
+        for s in cont:
+            req = prev["reqs"][s]
+            cap = len(req.orig_prompt) + req.max_new_tokens
+            targets[s] = min(int(self.lengths[s]) + prev["max_advance"][s]
+                             + horizon, cap)
+            need += self.kv.pages_needed(s, targets[s])
+        if need > self.kv.pool.free_pages:
+            return False
+        try:
+            for s in cont:
+                faults.fire("serve.page_alloc", step=self.step_idx,
+                            slot=s, rid=prev["reqs"][s].rid)
+                if not self.kv.ensure_capacity(s, targets[s]):
+                    return False
+        except PagePoolExhausted:
+            return False   # injected exhaustion: take the barrier path
+        active = prev["active_end"]
+        if self._zombies:
+            # freeze slots whose requests were terminated host-side
+            # while the previous horizon still had them active
+            import jax.numpy as jnp
+            keep = np.ones(self.num_slots, bool)
+            keep[list(self._zombies)] = False
+            active = jnp.logical_and(active, jnp.asarray(keep))
+        out = self.engine.decode_multi(
+            prev["tok_end"], active, self.kv.table, prev["lengths_end"],
+            self.pools, horizon=horizon, budgets=self._chain_budgets,
+            eos_ids=self._eos_ids, emitted=prev["emitted_end"],
+            **self.sampling)
+        self._commit_dispatch(out, cont, horizon,
+                              {s: prev["reqs"][s] for s in cont})
+        return True
+
+    def _harvest(self):
+        """Pull the oldest in-flight horizon's token block and run the
+        host bookkeeping: emit (streaming callbacks + metrics), retire,
+        honor cancellations/deadlines/emit-failures discovered mid-
+        horizon, and release any deferred pages parked on this horizon.
+        Returns (device_wait_s, tokens_delivered)."""
+        rec = self._inflight.popleft()
+        t0 = time.monotonic()
+        toks = np.asarray(rec["toks"])    # blocks until the device (and
+        valid = np.asarray(rec["valid"])  # async host copy) catch up
+        wait = time.monotonic() - t0
+        now = time.monotonic()
+        pulled = 0
+        for slot in rec["slots"]:
+            req = rec["reqs"][slot]
+            if req.state in TERMINAL or self.slot_req[slot] is not req:
+                continue       # closed at an earlier boundary (zombie)
+            if req.cancelled:
+                # tokens generated past the cancel are dropped: honored
+                # at the horizon boundary, like the legacy step boundary
+                self._close_slot_or_defer(slot, CANCELLED, "cancelled")
+                continue
+            if req.past_deadline(now):
+                self._close_slot_or_defer(slot, SHED,
+                                          "deadline expired mid-flight")
+                continue
+            n = int(valid[slot].sum())
+            if n and req.t_last is not None:
+                # horizon-granularity time-between-tokens: the client-
+                # visible burst cadence (per-token gaps within a burst
+                # are ~0 and still land in tpot)
+                self.metrics.record_tbt(self.step_idx, now - req.t_last)
+            for i in range(rec["horizon"]):
+                if not valid[slot, i]:
+                    continue
+                tok = int(toks[slot, i])
                 try:
                     self._emit(req, tok)
+                    pulled += 1   # only tokens actually DELIVERED count
                 except Exception as e:  # per-request emit/callback fault
-                    self._close_slot(slot, FAILED,
-                                     f"{type(e).__name__}: {e}")
-                    continue
+                    self._close_slot_or_defer(
+                        slot, FAILED, f"{type(e).__name__}: {e}")
+                    break
                 if req._finished_by(tok):
+                    # the device froze the slot at this same token, so
+                    # its pages are read-only in any chained horizon:
+                    # immediate release is safe
                     self._retire(slot)
-                else:
-                    self.last_tok[slot] = tok
+                    break
+            if self.slot_req[slot] is req and req.state == RUNNING:
+                self.lengths[slot] += n
+                if n:
+                    self.last_tok[slot] = int(toks[slot][valid[slot]][-1])
+        for slot in rec["release_after"]:
+            self.kv.release_slot(slot)
+            self.lengths[slot] = 0
+            self._zombies.discard(slot)
+        self.metrics.record_horizon(self.step_idx, rec["horizon"], pulled,
+                                    wait)
+        return wait, pulled
 
-        # 5. observability
-        dt = time.monotonic() - t_step
-        self._step_window.append(dt)
-        self._ema_step_s = dt if self._ema_step_s is None \
-            else 0.8 * self._ema_step_s + 0.2 * dt
-        n_running = sum(r is not None for r in self.slot_req)
-        self.metrics.record_step(
-            self.step_idx, queue_depth=len(self.waiting),
-            running=n_running, waiting=len(self.waiting),
-            page_utilization=self.kv.utilization())
-        return bool(self.waiting) or n_running > 0
+    def _close_slot_or_defer(self, slot, state, reason):
+        """Terminal removal discovered at a horizon boundary.  If a
+        chained horizon is still in flight with this slot unfrozen, the
+        device may be writing the slot's pages: close the request's
+        bookkeeping NOW (state, metrics, history) but hold the pages
+        until that horizon is harvested."""
+        if not self._inflight:
+            self._close_slot(slot, state, reason)
+            return
+        req = self.slot_req[slot]
+        self.slot_req[slot] = None
+        self._finalize(req, state, reason)
+        self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
+        if state == FAILED:
+            self._last_error = f"rid={req.rid}: {reason}"
+        self._zombies.add(slot)
+        self._inflight[-1]["release_after"].add(slot)
 
     def run(self, max_steps=100000):
         """Drive step() until idle; returns {rid: generated tokens} for
@@ -501,6 +822,10 @@ class ServingScheduler:
             "page_utilization": round(self.kv.utilization(), 4),
             "ema_step_ms": None if self._ema_step_s is None
             else round(self._ema_step_s * 1e3, 3),
+            "decode_horizon_steps": self.decode_horizon_steps,
+            "horizon_buckets": list(self.horizon_buckets),
+            "overlap": self.overlap,
+            "inflight_horizons": len(self._inflight),
             "completed": m.completed,
             "failed": m.failed,
             "shed": m.shed,

@@ -350,9 +350,15 @@ def test_serving_contains_request_error_and_page_exhaustion(gpt2_engine):
                for n in (5, 11, 5, 11)]
     max_new = [8, 6, 10, 8]
 
+    # horizon pinned to 1 (and overlap off): this plan keys the
+    # exhaustion episode to an exact step number, and with fused
+    # horizons a "step" covers up to decode_horizon_steps tokens — the
+    # legacy configuration keeps the step<->token timing this plan was
+    # written against (docs/resilience.md documents the change)
     sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
                              page_size=16, max_pages_per_slot=8,
-                             prefill_chunk=8)
+                             prefill_chunk=8, decode_horizon_steps=1,
+                             overlap=False)
     reqs = [sched.submit(p, max_new_tokens=m)
             for p, m in zip(prompts, max_new)]
 

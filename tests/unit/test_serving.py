@@ -318,12 +318,13 @@ def test_completed_history_is_bounded(gpt2_engine):
 
 
 def test_single_jit_signature_across_churn(gpt2_engine):
-    """The no-per-step-recompilation guarantee: one decode compile and
-    one prefill compile regardless of request churn, lengths, joins and
-    retirements. The scheduler here uses the SAME (slots, pages,
-    page_size, chunk) constants as every other gpt2 serving test in this
-    module, so the count also covers the earlier full serving sessions —
-    only a different scheduler CONFIG is a new signature, by design."""
+    """The no-per-step-recompilation guarantee: one prefill compile and
+    at most one fused-decode compile PER HORIZON BUCKET regardless of
+    request churn, lengths, joins and retirements. The scheduler here
+    uses the SAME (slots, pages, page_size, chunk) constants as every
+    other gpt2 serving test in this module, so the count also covers the
+    earlier full serving sessions — only a different scheduler CONFIG is
+    a new signature, by design."""
     rng = np.random.default_rng(2)
     sched = ServingScheduler(gpt2_engine, num_slots=3, num_pages=16,
                              page_size=16, max_pages_per_slot=8,
@@ -332,7 +333,8 @@ def test_single_jit_signature_across_churn(gpt2_engine):
         sched.submit(rng.integers(0, 256, n).astype(np.int32),
                      max_new_tokens=m)
     sched.run()
-    assert gpt2_engine.serving_decode_compile_count() == 1
+    assert 1 <= gpt2_engine.serving_decode_multi_compile_count() <= \
+        len(sched.horizon_buckets)
     assert gpt2_engine._paged_prefill_fn._cache_size() == 1
 
 

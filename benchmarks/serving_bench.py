@@ -21,8 +21,16 @@ loop, larger H amortize the host round-trip over H tokens per dispatch
 host/device loop on by default. TBT (time between token bursts) is the
 client-visible streaming cadence — the latency price of a horizon.
 
+`--prefix-share` switches to the radix-prefix-cache workload: N
+requests sharing a long system prompt with distinct tails (plus a
+zero-share control of equal-length distinct prompts), each served with
+the prefix cache ON vs OFF — the cache-on run should win tokens/s and
+TTFT roughly in proportion to the shared fraction, while the control
+stays within noise of cache-off.
+
 Usage: python benchmarks/serving_bench.py [--model gpt2-tiny]
        [--requests 32] [--rate 4.0] [--seed 0] [--horizons 1,2,4,8]
+       [--prefix-share [--shared-prefix-len 96] [--tail-len 8]]
        [--json-out results.json]
 """
 
@@ -47,15 +55,38 @@ def make_workload(vocab, n_requests, rate, seed):
     return prompts, max_new, arrivals
 
 
+def make_prefix_workload(vocab, n_requests, rate, seed, shared_len,
+                         tail_len, share=True):
+    """The --prefix-share workload: N requests sharing one long system
+    prompt with distinct short tails (share=True — the radix cache's
+    target traffic), or fully distinct prompts of the SAME total length
+    (share=False — the zero-share control that must sit within noise of
+    cache-off)."""
+    rng = np.random.default_rng(seed)
+    sys_prompt = rng.integers(0, vocab, shared_len).astype("i4")
+    prompts = []
+    for _ in range(n_requests):
+        if share:
+            tail = rng.integers(0, vocab, tail_len).astype("i4")
+            prompts.append(np.concatenate([sys_prompt, tail]))
+        else:
+            prompts.append(rng.integers(0, vocab,
+                                        shared_len + tail_len).astype("i4"))
+    max_new = [int(rng.integers(4, 16)) for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    return prompts, max_new, arrivals
+
+
 def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
-                   overlap=True):
+                   overlap=True, prefix_cache=False):
     from deepspeed_tpu.serving import ServingScheduler
     sched = ServingScheduler(
         engine, num_slots=cfg["num_slots"], num_pages=cfg["num_pages"],
         page_size=cfg["page_size"],
         max_pages_per_slot=cfg["max_pages_per_slot"],
         prefill_chunk=cfg["prefill_chunk"],
-        decode_horizon_steps=horizon, overlap=overlap)
+        decode_horizon_steps=horizon, overlap=overlap,
+        prefix_cache=prefix_cache)
     t0 = time.time()
     pending = list(zip(prompts, max_new, arrivals))
     submitted = []
@@ -75,6 +106,11 @@ def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
     out = sched.metrics.summary(wall)
     out.update({"wall_s": round(wall, 3), "tokens": toks,
                 "tokens_per_sec": round(toks / wall, 2)})
+    if prefix_cache:
+        h = sched.health()
+        out.update({k: h[k] for k in
+                    ("prefix_hit_rate", "tokens_reused", "pages_shared",
+                     "cached_pages", "cow_copies")})
     return out
 
 
@@ -118,6 +154,93 @@ def run_static(engine, prompts, max_new, arrivals, batch):
     }
 
 
+_PREFIX_KEYS = ("tokens_per_sec", "wall_s", "tokens", "ttft_ms_p50",
+                "ttft_ms_p99", "tbt_ms_p50", "tpot_ms_p50", "preemptions",
+                "page_util_peak", "prefix_hit_rate", "prefill_tokens_saved",
+                "cache_evictions", "tokens_reused", "pages_shared",
+                "cached_pages", "cow_copies")
+
+
+def run_prefix_share(engine, vocab, cfg, args, horizon, overlap):
+    """Cache-on vs cache-off over the shared-prefix workload plus the
+    zero-share control (which must land within noise of cache-off: a
+    cache that only helps when prefixes actually repeat)."""
+    # the section carries its own run metadata: the merge path below
+    # drops it into a results file whose top-level model/requests/rate
+    # may come from a DIFFERENT standard run with different settings
+    section = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "overlap": overlap,
+        "shared_prefix_len": args.shared_prefix_len,
+        "tail_len": args.tail_len,
+        "shared_fraction": round(args.shared_prefix_len /
+                                 (args.shared_prefix_len + args.tail_len),
+                                 3),
+        "horizon": horizon,
+    }
+    for name, share in (("shared", True), ("control", False)):
+        prompts, max_new, arrivals = make_prefix_workload(
+            vocab, args.requests, args.rate, args.seed,
+            args.shared_prefix_len, args.tail_len, share=share)
+        entry = {}
+        for label, pc in (("cache_off", False), ("cache_on", True)):
+            # warmup: one full untimed replay of the workload — the
+            # staggered arrivals produce batched-sampling shapes (and
+            # the COW page-copy signature) an all-at-once pass never
+            # compiles, and they must not land in the timed run
+            run_continuous(engine, prompts, max_new, arrivals, cfg,
+                           horizon=horizon, overlap=overlap,
+                           prefix_cache=pc)
+            # best-of-N: the cache's WORK is deterministic (hit rates
+            # and tokens saved repeat exactly); only the wall clock is
+            # noisy on shared/throttled rigs, so the fastest replay is
+            # the least-perturbed measurement of the same computation
+            r = None
+            for _ in range(max(1, args.repeats)):
+                cand = run_continuous(engine, prompts, max_new, arrivals,
+                                      cfg, horizon=horizon,
+                                      overlap=overlap, prefix_cache=pc)
+                if r is None or cand["tokens_per_sec"] > \
+                        r["tokens_per_sec"]:
+                    r = cand
+            entry[label] = {k: r[k] for k in _PREFIX_KEYS if k in r}
+        off, on = entry["cache_off"], entry["cache_on"]
+        entry["speedup_tokens_per_sec"] = round(
+            on["tokens_per_sec"] / off["tokens_per_sec"], 3) \
+            if off["tokens_per_sec"] else None
+        entry["ttft_p50_speedup"] = round(
+            off["ttft_ms_p50"] / on["ttft_ms_p50"], 3) \
+            if on["ttft_ms_p50"] else None
+        section[name] = entry
+        print(json.dumps({
+            "metric": f"serving_prefix_share_{name}_speedup",
+            "value": entry["speedup_tokens_per_sec"], "unit": "x",
+            "extra": entry,
+        }))
+    results = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "overlap": overlap,
+        "prefix_share": section,
+    }
+    if args.json_out:
+        # merge into an existing results file instead of clobbering it:
+        # refreshing the committed serving_results_cpu.json with
+        # --prefix-share must not destroy the horizon-sweep/static/
+        # previous_committed data a separate standard run produced
+        out = results
+        if os.path.exists(args.json_out):
+            try:
+                with open(args.json_out) as f:
+                    out = json.load(f)
+                out["prefix_share"] = section
+            except (OSError, ValueError):
+                out = results
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return results
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2-tiny",
@@ -137,6 +260,22 @@ def main():
                         "for the continuous system")
     p.add_argument("--no-overlap", action="store_true",
                    help="disable the overlapped host/device loop")
+    p.add_argument("--prefix-share", action="store_true",
+                   help="run the shared-prefix workload instead of the "
+                        "mixed one: N requests sharing a long system "
+                        "prompt + distinct tails (and a zero-share "
+                        "control), each served with the radix prefix "
+                        "cache ON vs OFF")
+    p.add_argument("--shared-prefix-len", type=int, default=96,
+                   help="system-prompt length for --prefix-share")
+    p.add_argument("--tail-len", type=int, default=8,
+                   help="distinct per-request tail length for "
+                        "--prefix-share")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="--prefix-share timed repetitions per "
+                        "configuration; the best run is reported (the "
+                        "work is deterministic — repeats only shed "
+                        "rig-level clock noise)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json-out", default=None)
     args = p.parse_args()
@@ -160,6 +299,10 @@ def main():
 
     horizons = [int(h) for h in args.horizons.split(",") if h.strip()]
     overlap = not args.no_overlap
+
+    if args.prefix_share:
+        run_prefix_share(engine, vocab, cfg, args, max(horizons), overlap)
+        return
 
     # warmup: compile every signature both systems will hit (the serving
     # primitives at every swept horizon's bucket set, plus generate() at

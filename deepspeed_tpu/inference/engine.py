@@ -567,12 +567,47 @@ class InferenceEngine:
             static_argnums=(10, 11, 12, 13, 14),
             out_shardings=tuple([rep] * 7))
 
+    def copy_page(self, pools, src_page, dst_page):
+        """Copy ONE KV page across every layer's pool (the prefix
+        cache's copy-on-write primitive: a partially matched cached page
+        is duplicated into a fresh private page before the owning slot
+        may append to it).  Page ids are traced scalars, so churn in
+        which pages get copied never adds a jit signature — ONE compile
+        per serving config, like the other paged primitives."""
+        if getattr(self, "_copy_page_fn", None) is None:
+            rep = NamedSharding(self.mesh, P())
+
+            def copy(pools, src, dst):
+                return {"layers": [
+                    {"k_pages": L["k_pages"].at[dst].set(L["k_pages"][src]),
+                     "v_pages": L["v_pages"].at[dst].set(L["v_pages"][src])}
+                    for L in pools["layers"]]}
+
+            self._copy_page_fn = jax.jit(copy, donate_argnums=(0,),
+                                         out_shardings=rep)
+        with dist.mesh_scope(self.mesh):
+            return self._copy_page_fn(pools, jnp.int32(src_page),
+                                      jnp.int32(dst_page))
+
+    def serving_page_copy_compile_count(self):
+        """Compiled signatures behind copy_page (stays <= 1 per serving
+        config: cache hits/misses must never grow the compile set)."""
+        fn = getattr(self, "_copy_page_fn", None)
+        return 0 if fn is None else fn._cache_size()
+
     def prefill_into_slots(self, ids_chunk, slot, n_valid, page_table,
                            lengths, pools):
         """One prefill chunk of one slot: write the chunk's K/V through
         the page table and return (boundary logits [vocab], new pools).
         ``ids_chunk`` is [1, chunk] (padded past ``n_valid``); the pages
-        covering positions lengths[slot] .. +n_valid must be allocated."""
+        covering positions lengths[slot] .. +n_valid must be allocated.
+
+        The chunk's positions (and rotary offsets) start at
+        ``lengths[slot]``, which need not be 0 OR page-aligned: a
+        prefix-cache hit seeds ``lengths[slot]`` to the cached boundary
+        and prefill resumes there with this same single jit signature —
+        per-row start offsets are data (the lengths array), never
+        shape."""
         assert self.params is not None, "set_params/init_params first"
         if getattr(self, "_paged_prefill_fn", None) is None:
             self._build_serving_fns()

@@ -160,7 +160,15 @@ class SelfAttention(nn.Module):
             if "slot" in cache:
                 # chunked prefill into ONE slot: b == 1, l == chunk;
                 # rows past n_valid are padding — their K/V writes drop
-                # (out-of-bounds page id) and their outputs are unused
+                # (out-of-bounds page id) and their outputs are unused.
+                # The chunk starts at lengths[slot], which a prefix-
+                # cache hit seeds to the cached boundary (not 0, not
+                # page-aligned): writes only touch positions >= it, so
+                # shared read-only pages below the boundary stay
+                # immutable, and the write-before-gather order makes
+                # the copy-on-write tail page's stale region harmless
+                # (every stale position is either overwritten first or
+                # masked out by k_pos <= position)
                 slot = cache["slot"]
                 pos = positions[0]                       # [l]
                 valid = jnp.arange(l) < cache["n_valid"]

@@ -105,6 +105,12 @@ class LlamaAttention(nn.Module):
             pt = cache["page_table"]
             max_len = pt.shape[1] * ps
             if "slot" in cache:          # chunked prefill (b == 1)
+                # the chunk starts at lengths[slot] — a prefix-cache
+                # hit seeds it to the cached (possibly mid-page)
+                # boundary: rotary offsets follow the positions array,
+                # writes never touch shared read-only pages below the
+                # boundary, and the copy-on-write tail page's stale
+                # region is overwritten-before-gather or masked
                 slot = cache["slot"]
                 pos = positions[0]
                 valid = jnp.arange(l) < cache["n_valid"]

@@ -47,7 +47,12 @@ point                 site                                     ctx keys
                       step regardless of actual pool size
                       (during a chained dispatch it aborts
                       the chain to the barrier path instead
-                      of shedding)
+                      of shedding). With the radix prefix
+                      cache enabled the episode first DRAINS
+                      refcount-free cached pages — cached
+                      pages are reclaimable capacity — and
+                      only sheds a victim once the cache is
+                      empty/pinned
 ====================  =======================================  ==========
 
 Usage::

@@ -36,11 +36,17 @@ class ServingMetrics:
         self.horizons = []            # fused decode horizon per harvest
         self.device_wait_s = 0.0      # step time blocked on the device
         self.host_s = 0.0             # step time doing host bookkeeping
+        # prefix-cache aggregates (admission-time KV reuse)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefill_tokens_saved = 0  # == cached prefix tokens reused
+        self.cache_evictions = 0       # cached pages drained under pressure
         self._events = []
 
     # ---------------------------------------------------------- recording
     def record_step(self, step, *, queue_depth, running, waiting,
-                    page_utilization, device_wait_s=0.0, host_s=0.0):
+                    page_utilization, device_wait_s=0.0, host_s=0.0,
+                    cached_pages=None):
         self.page_util.append(page_utilization)
         self.queue_depths.append(queue_depth)
         self.device_wait_s += device_wait_s
@@ -53,8 +59,38 @@ class ServingMetrics:
             ("serving/device_wait_ms", device_wait_s * 1e3, step),
             ("serving/host_ms", host_s * 1e3, step),
         ]
+        if cached_pages is not None:
+            self._events.append(
+                ("serving/prefix_cache/cached_pages", cached_pages, step))
         if self.monitor is not None:
             self.monitor.write_events(self._events)
+
+    def record_prefix(self, step, cached_tokens, prompt_tokens):
+        """One admission-time prefix-cache lookup: ``cached_tokens`` of
+        the ``prompt_tokens``-long prompt were served from cached pages
+        (0 = miss).  Every cached token is a prefill token NOT
+        computed."""
+        self.prefix_lookups += 1
+        if cached_tokens > 0:
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += cached_tokens
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serving/prefix_cache/cached_prefix_tokens",
+                 cached_tokens, step),
+                ("serving/prefix_cache/hit_rate",
+                 self.prefix_hits / self.prefix_lookups, step),
+                ("serving/prefix_cache/prefill_tokens_saved",
+                 self.prefill_tokens_saved, step),
+            ])
+
+    def record_cache_eviction(self, step, pages):
+        """Cached pages drained back to the free list under pool
+        pressure (reclaim, not failure)."""
+        self.cache_evictions += pages
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("serving/prefix_cache/evicted_pages", pages, step)])
 
     def record_tbt(self, step, gap_s):
         """Time-between-token-bursts at HORIZON granularity: the gap a
@@ -141,6 +177,11 @@ class ServingMetrics:
             if self.page_util else 0.0,
             "queue_depth_peak": int(np.max(self.queue_depths))
             if self.queue_depths else 0,
+            "prefix_hit_rate": round(
+                self.prefix_hits / self.prefix_lookups, 4)
+            if self.prefix_lookups else 0.0,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "cache_evictions": self.cache_evictions,
         }
         if wall_s:
             out["tokens_per_sec"] = round(self.tokens_emitted / wall_s, 2)

@@ -24,9 +24,17 @@ class PagePoolExhausted(RuntimeError):
 
 
 class PagePool:
-    """Fixed pool of fixed-size cache pages with a free list and
-    allocation accounting (the reference counterpart is vLLM's
-    BlockAllocator)."""
+    """Fixed pool of fixed-size cache pages with a free list, per-page
+    reference counts and allocation accounting (the reference
+    counterpart is vLLM's BlockAllocator).
+
+    Reference counting is what makes cross-request KV sharing free:
+    ``allocate`` hands out pages at refcount 1, ``share`` adds a holder
+    (a second slot mapping the same physical page read-only, or the
+    prefix cache retaining a donated page), and ``free`` drops one
+    holder — the page only returns to the free list when its last
+    holder lets go.  Non-sharing callers see the PR-1 semantics
+    unchanged (allocate -> refcount 1, free -> back on the list)."""
 
     def __init__(self, num_pages, page_size):
         if num_pages <= 0 or page_size <= 0:
@@ -36,10 +44,11 @@ class PagePool:
         # LIFO free list: recently freed pages are re-used first (their
         # pool slices are most likely still warm in cache hierarchies)
         self._free = list(range(self.num_pages - 1, -1, -1))
-        self._allocated = set()
+        self._refs = {}              # page id -> holder count (>= 1)
         self.peak_in_use = 0
-        self.total_allocs = 0
-        self.total_frees = 0
+        self.total_allocs = 0        # pages taken off the free list
+        self.total_frees = 0         # pages returned to the free list
+        self.total_shares = 0        # extra holders added via share()
 
     @property
     def free_pages(self):
@@ -52,26 +61,45 @@ class PagePool:
     def can_allocate(self, n):
         return n <= len(self._free)
 
+    def ref_count(self, page):
+        """Current holder count of an allocated page (0 when free)."""
+        return self._refs.get(page, 0)
+
     def allocate(self, n):
-        """Take ``n`` pages off the free list; raises PagePoolExhausted
-        if fewer are free (callers gate with can_allocate / evict)."""
+        """Take ``n`` pages off the free list at refcount 1; raises
+        PagePoolExhausted if fewer are free (callers gate with
+        can_allocate / evict)."""
         if n > len(self._free):
             raise PagePoolExhausted(
                 f"need {n} pages, {len(self._free)} free "
                 f"({self.pages_in_use}/{self.num_pages} in use)")
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         self.total_allocs += n
         self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
         return pages
 
-    def free(self, pages):
+    def share(self, pages):
+        """Add one holder to each already-allocated page (read-only
+        prefix sharing / prefix-cache retention)."""
         for p in pages:
-            if p not in self._allocated:
+            if p not in self._refs:
+                raise ValueError(f"cannot share free/foreign page {p}")
+            self._refs[p] += 1
+        self.total_shares += len(pages)
+
+    def free(self, pages):
+        """Drop one holder per page; a page returns to the free list
+        only when its last holder releases it."""
+        for p in pages:
+            if p not in self._refs:
                 raise ValueError(f"double free / foreign page {p}")
-            self._allocated.remove(p)
-            self._free.append(p)
-        self.total_frees += len(pages)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+                self.total_frees += 1
 
     def utilization(self):
         return self.pages_in_use / self.num_pages
@@ -137,9 +165,54 @@ class PagedKVManager:
         self._slot_pages[slot].extend(new)
         return True
 
+    def attach_prefix(self, slot, pages):
+        """Map a cached page chain read-only into an EMPTY slot's table
+        (prefix-cache hit): each page gains one holder — the slot — on
+        top of the cache's own reference, so neither a slot release nor
+        a cache eviction alone can recycle a page the other still needs.
+        The slot must never write positions below the attached boundary
+        (``len(pages) * page_size`` tokens); the scheduler guarantees
+        this by resuming prefill/decode at that boundary."""
+        if self._slot_pages[slot]:
+            raise ValueError(
+                f"slot {slot} already holds pages; prefix attach must "
+                "seed an empty slot")
+        if len(pages) > self.max_pages_per_slot:
+            raise ValueError(
+                f"prefix of {len(pages)} pages > max_pages_per_slot="
+                f"{self.max_pages_per_slot}")
+        self.pool.share(pages)
+        for i, p in enumerate(pages):
+            self.table[slot, i] = p
+        self._slot_pages[slot] = list(pages)
+
+    def adopt_page(self, slot, page):
+        """Append an already-allocated page to a slot's chain (the
+        copy-on-write private copy of a partially matched cached page:
+        allocated fresh, filled by the engine's page-copy primitive,
+        then owned by the slot like any grown page)."""
+        have = len(self._slot_pages[slot])
+        if have >= self.max_pages_per_slot:
+            raise ValueError(
+                f"slot {slot} page chain full "
+                f"(max_pages_per_slot={self.max_pages_per_slot})")
+        self.table[slot, have] = page
+        self._slot_pages[slot].append(page)
+
+    def take_slot_pages(self, slot):
+        """Detach and return a slot's page chain WITHOUT releasing the
+        pool references (retirement donating pages to the prefix cache:
+        ownership of each page's reference transfers to the caller, who
+        either hands it to the cache or frees it)."""
+        pages = self._slot_pages[slot]
+        self._slot_pages[slot] = []
+        self.table[slot, :] = 0
+        return pages
+
     def release_slot(self, slot):
-        """Return all of a slot's pages to the pool (sequence retired or
-        preempted)."""
+        """Drop the slot's hold on all of its pages (sequence retired or
+        preempted); pages shared with the prefix cache stay allocated
+        under the cache's reference."""
         pages = self._slot_pages[slot]
         self.pool.free(pages)
         self._slot_pages[slot] = []

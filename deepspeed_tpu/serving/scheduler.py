@@ -20,10 +20,23 @@ granularity.  Each ``step()`` is one scheduler iteration:
 
 All device work goes through the jit-stable primitives on
 ``InferenceEngine`` (``prefill_into_slots`` / ``decode_multi``); the
-scheduler itself is pure host logic.  When the page pool runs dry the
-youngest running request is preempted (recompute-style eviction: its
-pages recycle, the request re-queues at the queue head with its
+scheduler itself is pure host logic.  When the page pool runs dry,
+refcount-free pages held by the prefix cache drain first (they are
+reclaimable capacity, not live state); only then is the youngest
+running request preempted (recompute-style eviction: its pages
+recycle, the request re-queues at the queue head with its
 already-emitted tokens folded into the prompt).
+
+**Prefix cache.**  With ``prefix_cache=True`` the scheduler keeps a
+radix index (``serving/prefix_cache.py``) over pages donated by
+finished requests.  Admission longest-prefix matches each prompt:
+matched full pages are shared read-only into the slot's table
+(``PagePool`` refcounts), a partially matched page is copied into a
+fresh private page on-device (copy-on-write) so the cached original
+stays immutable, and chunked prefill resumes from the cached boundary
+(``lengths[slot]`` seeds the positions — no new jit signatures).
+Prefill compute and page footprint scale with UNIQUE tokens, not total
+tokens, on shared-prefix traffic.
 
 **The horizon model.**  A horizon of H steps costs ONE dispatch and one
 host round-trip for H tokens — the per-token host loop that dominates
@@ -83,6 +96,7 @@ from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.page_manager import (PagedKVManager,
                                                 PagePoolExhausted)
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -113,6 +127,7 @@ class Request:
         self.out_tokens = []
         self.state = WAITING
         self.prefill_pos = 0
+        self.cached_prefix_tokens = 0   # prefix-cache reuse at last admit
         self.error = None            # reason string for failed/shed
         self.cancelled = False
         self.t_submit = time.monotonic()
@@ -147,7 +162,7 @@ class ServingScheduler:
                  max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
-                 overlap=True):
+                 overlap=True, prefix_cache=False, prefix_cache_pages=None):
         if page_size is None:
             # the paged Pallas decode kernel needs 128-multiple pages
             # (TPU lane tiling); anything smaller silently drops every
@@ -164,6 +179,12 @@ class ServingScheduler:
             max_pages_per_slot = -(-num_pages // 2) or 1
         self.kv = PagedKVManager(num_pages, page_size, num_slots,
                                  max_pages_per_slot)
+        # radix prefix cache: finished requests donate their full pages
+        # to a token-keyed index; admissions longest-prefix match and
+        # share the chain read-only. Cached pages are reclaimable
+        # capacity (LRU-drained under pool pressure), never a leak.
+        self.prefix_cache = None if not prefix_cache else PrefixCache(
+            self.kv.pool, max_pages=prefix_cache_pages)
         self.pools = engine.init_paged_cache(num_pages, page_size)
         self.lengths = np.zeros(num_slots, np.int32)
         self.last_tok = np.zeros(num_slots, np.int32)
@@ -261,9 +282,30 @@ class ServingScheduler:
         self.requests.pop(req.rid, None)
         self.completed.append(req)
 
+    def _donate_pages(self, slot, req):
+        """Retirement hands the slot's FULL pages to the prefix cache
+        instead of freeing them.  The true token sequence is
+        ``orig_prompt + out_tokens`` — NOT ``req.prompt``, which after a
+        preemption already contains the then-emitted tokens folded in
+        (keying on it would duplicate them and donate pages under keys
+        their KV does not match).  The KV-valid length drops the final
+        sampled token (eos / budget boundary): it was never fed back, so
+        its KV was never written — donating past it would break the
+        coherence invariant.  Pages the cache declines (duplicate
+        chains, cap) and the partial tail are released normally."""
+        seq = req.orig_prompt + req.out_tokens
+        n_full = max(0, len(seq) - 1) // self.kv.page_size
+        pages = self.kv.take_slot_pages(slot)
+        keep, tail = pages[:n_full], pages[n_full:]
+        leftover = self.prefix_cache.insert(seq, keep) if keep else []
+        self.kv.pool.free(leftover + tail)
+
     def _retire(self, slot):
         req = self.slot_req[slot]
-        self.kv.release_slot(slot)
+        if self.prefix_cache is not None:
+            self._donate_pages(slot, req)
+        else:
+            self.kv.release_slot(slot)
         self.slot_req[slot] = None
         self.lengths[slot] = 0
         self._finalize(req, FINISHED)
@@ -312,16 +354,43 @@ class ServingScheduler:
         self.metrics.record_preemption(self.step_idx)
         return victim
 
+    def _reclaim_cached(self, n_pages, protect=frozenset()):
+        """Drain up to ``n_pages`` refcount-free cached pages (LRU) back
+        into the free list.  Returns pages actually freed (0 when the
+        cache is off, empty, or fully pinned by live sharers)."""
+        if self.prefix_cache is None or n_pages <= 0:
+            return 0
+        freed = self.prefix_cache.evict(n_pages, protect)
+        if freed:
+            self.metrics.record_cache_eviction(self.step_idx, freed)
+        return freed
+
     def _grow_or_evict(self, slot, target_len):
-        """ensure_capacity with the eviction policy behind it. Returns
-        False when ``slot`` itself was preempted. Raises
-        :class:`PagePoolExhausted` on a genuine dead-end (no evictable
-        victim) — callers shed the slot's request rather than letting
-        the loop die."""
+        """ensure_capacity with the reclaim/eviction policy behind it:
+        under pool pressure, refcount-free CACHED pages drain first
+        (they are reclaimable capacity, not live state), then the
+        legacy preempt-the-youngest eviction runs. Returns False when
+        ``slot`` itself was preempted. Raises
+        :class:`PagePoolExhausted` on a genuine dead-end (cache drained
+        AND no evictable victim) — callers shed the slot's request
+        rather than letting the loop die."""
         req = self.slot_req[slot]
-        faults.fire("serve.page_alloc", step=self.step_idx, slot=slot,
-                    rid=None if req is None else req.rid)
+        try:
+            faults.fire("serve.page_alloc", step=self.step_idx, slot=slot,
+                        rid=None if req is None else req.rid)
+        except PagePoolExhausted:
+            # an injected exhaustion episode models pool pressure: the
+            # cache must drain before any victim is shed — only a
+            # drained cache makes the episode terminal
+            if not self._reclaim_cached(self.kv.pool.num_pages):
+                raise
         while not self.kv.ensure_capacity(slot, target_len):
+            # reclaim the whole known shortfall in ONE batched drain
+            # (evict() amortizes its tree scans per layer, not per page)
+            short = self.kv.pages_needed(slot, target_len) - \
+                self.kv.pool.free_pages
+            if self._reclaim_cached(max(1, short)):
+                continue
             victim = self._preempt_youngest(protect=slot)
             if victim is None:
                 raise PagePoolExhausted(
@@ -337,9 +406,19 @@ class ServingScheduler:
         now: remaining prefill chunks + one decode horizon per
         ``decode_horizon_steps`` remaining tokens (ignores queueing
         ahead of it — a deliberately optimistic bound, so shedding only
-        fires on certainly-hopeless requests)."""
-        prefill = -(-max(0, len(req.prompt) - req.prefill_pos)
-                    // self.prefill_chunk)
+        fires on certainly-hopeless requests).  With the prefix cache
+        on, tokens a hit would skip are subtracted — a request the
+        cache makes feasible must not be shed for the prefill it will
+        never run (match() is a pure host trie walk, cheap enough to
+        price in here)."""
+        pending = max(0, len(req.prompt) - req.prefill_pos)
+        if self.prefix_cache is not None and req.prefill_pos == 0 \
+                and pending > 1:
+            full, _, plen = self.prefix_cache.match(
+                req.prompt, limit=len(req.prompt) - 1)
+            pending = max(1, pending - len(full) * self.kv.page_size
+                          - plen)
+        prefill = -(-pending // self.prefill_chunk)
         horizons = -(-max(1, req.remaining_new) // self.decode_horizon_steps)
         return prefill + horizons
 
@@ -445,7 +524,9 @@ class ServingScheduler:
             self.step_idx, queue_depth=len(self.waiting),
             running=n_running, waiting=len(self.waiting),
             page_utilization=self.kv.utilization(),
-            device_wait_s=t_wait, host_s=max(0.0, dt - t_wait))
+            device_wait_s=t_wait, host_s=max(0.0, dt - t_wait),
+            cached_pages=None if self.prefix_cache is None
+            else self.prefix_cache.cached_pages)
         return bool(self.waiting) or n_running > 0 or bool(self._inflight)
 
     # ------------------------------------------------- boundary phases
@@ -465,9 +546,35 @@ class ServingScheduler:
             if not self.waiting:
                 break
             req = self.waiting[0]
-            if not self.kv.pool.can_allocate(
-                    self.kv.pool.pages_for_tokens(len(req.prompt))):
-                break   # admission control: whole prompt must fit now
+            hit = None
+            if self.prefix_cache is not None:
+                # longest-prefix match, capped at len(prompt)-1 so at
+                # least one prompt token remains to prefill (the
+                # boundary logits the first sampled token comes from)
+                hit = self.prefix_cache.match(req.prompt,
+                                              limit=len(req.prompt) - 1)
+            # admission control: the UNIQUE part of the prompt must fit
+            # now — matched full pages are shared, not allocated, and
+            # refcount-free cached pages count as reclaimable capacity
+            # (drained on demand, with the matched chain protected)
+            need = self.kv.pool.pages_for_tokens(len(req.prompt))
+            protect = frozenset()
+            if hit is not None:
+                need -= len(hit[0])
+                protect = frozenset(id(n) for n in hit[0] +
+                                    ([hit[1]] if hit[1] is not None else []))
+            short = need - self.kv.pool.free_pages
+            if short > 0:
+                # pre-check with the EXACT drainable count (under the
+                # same protect set the drain will honor) before touching
+                # the cache: a shortfall the drain provably cannot cover
+                # must not destroy the cache every step while the head
+                # request stays blocked anyway
+                if self.prefix_cache is None or short > \
+                        self.prefix_cache.reclaimable_pages(protect):
+                    break
+                if self._reclaim_cached(short, protect) < short:
+                    break
             self.waiting.popleft()
             self.slot_req[slot] = req
             req.state = PREFILL
@@ -477,6 +584,55 @@ class ServingScheduler:
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
             self.lengths[slot] = 0
+            req.cached_prefix_tokens = 0
+            if hit is not None:
+                try:
+                    self._attach_prefix(slot, req, hit)
+                except Exception as e:   # containment: the attach (incl.
+                    # the COW device copy) is per-request work — fail
+                    # ONE request, never the admission loop
+                    self._close_slot(slot, FAILED,
+                                     f"{type(e).__name__}: {e}")
+
+    def _attach_prefix(self, slot, req, hit):
+        """Map a matched cached chain into the admitted slot: full pages
+        are shared read-only (refcount++), a partially matched page is
+        duplicated on-device into a fresh PRIVATE page (copy-on-write —
+        decode will append into it, and the cached original must stay
+        immutable for its other readers).  Prefill then resumes from the
+        cached boundary: ``lengths[slot]`` seeds the position/rotary
+        offset, so the jit signature is untouched."""
+        full_nodes, pnode, plen = hit
+        cached = 0
+        if full_nodes:
+            self.kv.attach_prefix(slot,
+                                  self.prefix_cache.acquire(full_nodes))
+            cached = len(full_nodes) * self.kv.page_size
+        if pnode is not None and self.kv.pool.can_allocate(1):
+            page = self.kv.pool.allocate(1)[0]
+            # adopt BEFORE the device copy: if the copy throws, the
+            # containment close releases the page with the slot instead
+            # of leaking it
+            self.kv.adopt_page(slot, page)
+            self.pools = self.engine.copy_page(self.pools, pnode.page,
+                                               page)
+            self.prefix_cache.touch(pnode)
+            self.prefix_cache.cow_copies += 1
+            cached += plen
+        if cached:
+            self.prefix_cache.tokens_reused += cached
+            self.lengths[slot] = cached
+            req.prefill_pos = cached
+            req.cached_prefix_tokens = cached
+        # one lookup per ADMISSION, counted when the outcome is known —
+        # a hit iff tokens were actually reused (match() itself is
+        # pure, so a capacity-blocked request re-matched every step
+        # cannot inflate the rate, and health()'s hit rate counts the
+        # same event as metrics.summary()'s)
+        self.prefix_cache.lookups += 1
+        if cached:
+            self.prefix_cache.hits += 1
+        self.metrics.record_prefix(self.step_idx, cached, len(req.prompt))
 
     def _prefill(self):
         """One prompt chunk per prefilling slot.  The per-slot body is
@@ -560,12 +716,23 @@ class ServingScheduler:
         the horizon shrinks bucket-by-bucket before any eviction runs;
         at horizon 1 the legacy evict/shed policy applies unchanged.
         Returns (horizon, surviving slots)."""
+        reclaimable = None   # lazy: the cache can't change mid-loop
         while horizon > 1:
             need = sum(self.kv.pages_needed(
                 s, int(self.lengths[s]) +
                 min(horizon, self.slot_req[s].remaining_new))
                 for s in running)
-            if need <= self.kv.pool.free_pages:
+            avail = self.kv.pool.free_pages
+            if need > avail and self.prefix_cache is not None:
+                # refcount-free cached pages are reclaimable capacity:
+                # don't shrink the horizon while a drain would cover it
+                # (the exact tree walk only runs when free pages alone
+                # don't already answer the question, and once per
+                # dispatch)
+                if reclaimable is None:
+                    reclaimable = self.prefix_cache.reclaimable_pages()
+                avail += reclaimable
+            if need <= avail:
                 break
             horizon = self._bucket_floor(horizon - 1)
         kept = []
@@ -678,8 +845,21 @@ class ServingScheduler:
             targets[s] = min(int(self.lengths[s]) + prev["max_advance"][s]
                              + horizon, cap)
             need += self.kv.pages_needed(s, targets[s])
-        if need > self.kv.pool.free_pages:
-            return False
+        short = need - self.kv.pool.free_pages
+        if short > 0:
+            # a chained dispatch never evicts a live slot (the device
+            # may still be writing the victim's pages) — but cache-only
+            # pages are not referenced by any LIVE row of an in-flight
+            # dispatch (frozen rows read them at worst, and frozen
+            # output is discarded), so draining them here is safe and
+            # keeps the overlap alive under a warm cache.  Pre-check
+            # the exact drainable count so a hopeless chain attempt
+            # does not flush the cache on its way to the barrier.
+            if self.prefix_cache is None or \
+                    short > self.prefix_cache.reclaimable_pages():
+                return False
+            if self._reclaim_cached(short) < short:
+                return False
         try:
             for s in cont:
                 faults.fire("serve.page_alloc", step=self.step_idx,
@@ -812,8 +992,16 @@ class ServingScheduler:
         ``bin/ds_serve``): current load, pool pressure, step latency,
         and terminal counts by kind."""
         m = self.metrics
+        pc = self.prefix_cache
         return {
             "step": self.step_idx,
+            "prefix_cache": pc is not None,
+            "prefix_hit_rate": None if pc is None
+            else round(pc.hit_rate(), 4),
+            "tokens_reused": 0 if pc is None else pc.tokens_reused,
+            "pages_shared": 0 if pc is None else pc.pages_shared,
+            "cached_pages": 0 if pc is None else pc.cached_pages,
+            "cow_copies": 0 if pc is None else pc.cow_copies,
             "running": sum(r is not None for r in self.slot_req),
             "waiting": len(self.waiting),
             "live_requests": len(self.requests),

@@ -159,6 +159,11 @@ def test_pld_config_drives_model():
     assert e_on.progressive_layer_drop.get_theta() < 1.0
 
 
+# slow lane: the heaviest test in tier-1 (~42s — multi-run REAL
+# training); the wiring it guards is also covered by the pld/random_ltd
+# unit tests, and the tier-1 wall budget (870s on the 2-core rig) needs
+# the headroom (same budget policy as the PR-1 slow-lane moves)
+@pytest.mark.slow
 def test_random_ltd_schedule_drives_training():
     """random_ltd in the json config reaches the GPT2 forward (VERDICT
     r4 missing #2 — the library existed but nothing consumed it): the

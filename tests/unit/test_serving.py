@@ -249,6 +249,7 @@ def test_page_pool_exhausted_dead_end():
     sched.lengths = np.zeros(2, np.int32)
     sched.waiting = deque()
     sched.step_idx = 0
+    sched.prefix_cache = None     # nothing cached -> nothing reclaimable
     kv.pool.allocate(4)          # a foreign reservation drains the pool
     with pytest.raises(PagePoolExhausted, match="no evictable request"):
         sched._grow_or_evict(1, 8)

@@ -36,9 +36,18 @@ DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
 
 
 def _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p):
-    """Next-token selection on [batch, vocab] logits, fully traced."""
+    """Next-token selection on [batch, vocab] logits, fully traced.
+
+    THE greedy contract (speculative-decode verification depends on it):
+    ``do_sample=False`` OR ``temperature == 0`` is a deterministic
+    argmax over the fp32 logits — no rng is consumed — and ties break
+    to the LOWEST token id (``jnp.argmax`` returns the first maximal
+    index).  Verification compares drafted tokens against exactly this
+    argmax, so any change here silently breaks token-exactness between
+    spec-decode serving and ``generate()``.
+    """
     logits = logits.astype(jnp.float32)
-    if not do_sample:
+    if not do_sample or not temperature:
         return jnp.argmax(logits, axis=-1)
     if temperature and temperature != 1.0:
         logits = logits / temperature
@@ -549,6 +558,68 @@ class InferenceEngine:
             return (toks.T, valid.T, tok, active, lengths, emitted,
                     {"layers": layers})
 
+        def verify_multi(params, tok, drafts, widths, active, page_table,
+                         lengths, pools, emitted, budgets, eos_ids):
+            """Teacher-forced speculative verification: score K drafted
+            tokens per slot in ONE forward over the paged cache (the
+            draft/verify counterpart of ``decode_multi``'s scan).
+
+            The input row is ``[tok, d_1 .. d_K]`` (K+1 columns): column
+            j's logits are the target model's prediction for the
+            (j+1)-th new token, so the longest prefix of drafts matching
+            the greedy argmax is accepted and the first non-matching
+            argmax is emitted as the bonus/correction token — by
+            construction exactly the token sequential greedy decode
+            would have produced, so acceptance only changes SPEED, never
+            output.  K/V is written for all ``widths[s]+1`` columns;
+            ``lengths_end`` rewinds to count only emitted tokens (the
+            host mirrors with ``PagedKVManager.truncate_slot``) and the
+            stale tail is overwritten before any later gather can read
+            it.  EOS / budget freezing replays ``decode_multi``'s rules
+            over the emitted stream so the carries stay
+            loop-compatible."""
+            slots, K = drafts.shape
+            x = jnp.concatenate([tok[:, None], drafts], axis=1)
+            cols = jnp.where(active, widths + 1, 0)
+            cache = dict(pools, page_table=page_table, lengths=lengths,
+                         active=active, widths=cols)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         x, cache=cache)
+            # the greedy contract: fp32 argmax, ties to the lowest id
+            g = jnp.argmax(logits.astype(jnp.float32),
+                           axis=-1).astype(jnp.int32)       # [slots, K+1]
+            jK = jnp.arange(K)
+            ok = (drafts == g[:, :K]) & (jK[None, :] < widths[:, None])
+            a = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+            bonus = jnp.take_along_axis(g, a[:, None], axis=1)
+            jW = jnp.arange(K + 1)
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+            # emitted stream: accepted drafts then the bonus token
+            # (positions past it are frozen padding, masked by `valid`)
+            out_toks = jnp.where(jW[None, :] < a[:, None], drafts_pad,
+                                 bonus)
+            nominal = a + 1
+            is_eos = (out_toks == eos_ids[:, None]) & \
+                (eos_ids[:, None] >= 0)
+            has_eos = jnp.any(is_eos, axis=1)
+            n_eos = jnp.where(has_eos, jnp.argmax(is_eos, axis=1) + 1,
+                              K + 2)
+            n = jnp.minimum(jnp.minimum(nominal, n_eos),
+                            jnp.maximum(budgets - emitted, 0))
+            n = jnp.where(active, n, 0)
+            valid = jW[None, :] < n[:, None]
+            emitted_end = emitted + n
+            last = jnp.take_along_axis(
+                out_toks, jnp.maximum(n - 1, 0)[:, None], axis=1)[:, 0]
+            tok_end = jnp.where(n > 0, last, tok)
+            emitted_eos = has_eos & (n_eos <= n)
+            active_end = active & ~emitted_eos & (emitted_end < budgets)
+            lengths_end = lengths + n
+            accepted = jnp.minimum(a, n)
+            return (out_toks, valid, tok_end, active_end, lengths_end,
+                    emitted_end, accepted, {"layers": cache["layers"]})
+
         # pools replicate over the mesh (pinned out_shardings so the
         # donated round-trip keeps ONE jit signature: an inferred
         # sharding that differed from init_paged_cache's would compile a
@@ -566,6 +637,12 @@ class InferenceEngine:
             decode_multi, donate_argnums=(5,),
             static_argnums=(10, 11, 12, 13, 14),
             out_shardings=tuple([rep] * 7))
+        # K is baked into the drafts shape, so the compile count is
+        # bounded by the scheduler's spec-K bucket set (greedy-only: no
+        # sampling statics)
+        self._paged_verify_fn = jax.jit(
+            verify_multi, donate_argnums=(7,),
+            out_shardings=tuple([rep] * 8))
 
     def copy_page(self, pools, src_page, dst_page):
         """Copy ONE KV page across every layer's pool (the prefix
@@ -611,12 +688,15 @@ class InferenceEngine:
         assert self.params is not None, "set_params/init_params first"
         if getattr(self, "_paged_prefill_fn", None) is None:
             self._build_serving_fns()
+        ids_chunk, slot, n_valid, page_table, lengths = \
+            self._stage_host_inputs([
+                (ids_chunk, np.int32), (slot, np.int32),
+                (n_valid, np.int32), (page_table, np.int32),
+                (lengths, np.int32)])
         with dist.mesh_scope(self.mesh):
             return self._paged_prefill_fn(
-                self.params, jnp.asarray(ids_chunk, jnp.int32),
-                jnp.int32(slot), jnp.int32(n_valid),
-                jnp.asarray(page_table, jnp.int32),
-                jnp.asarray(lengths, jnp.int32), pools)
+                self.params, ids_chunk, slot, n_valid, page_table,
+                lengths, pools)
 
     def decode_step(self, toks, active, page_table, lengths, pools,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
@@ -637,6 +717,19 @@ class InferenceEngine:
                 bool(do_sample), float(temperature), int(top_k),
                 float(top_p))
 
+    def _stage_host_inputs(self, pairs):
+        """Move the per-dispatch host arrays to the shared replicated
+        sharding in ONE batched ``device_put`` (per-array puts cost
+        ~0.2 ms each of pure dispatch machinery on the CPU rig — at 7-9
+        small arrays per decode/verify round that overhead was rivaling
+        the model compute itself).  Device-resident carries from a
+        previous dispatch pass through untouched: they are already
+        committed to this exact sharding by ``out_shardings``."""
+        rep = NamedSharding(self.mesh, P())
+        staged = [x if isinstance(x, jax.Array) and x.dtype == dt
+                  else np.asarray(x, dt) for x, dt in pairs]
+        return jax.device_put(tuple(staged), rep)
+
     def decode_multi(self, toks, active, page_table, lengths, pools, *,
                      horizon, budgets, eos_ids, emitted=None,
                      do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
@@ -656,21 +749,67 @@ class InferenceEngine:
         if getattr(self, "_paged_decode_multi_fn", None) is None:
             self._build_serving_fns()
         self._rng, rng = jax.random.split(self._rng)
-        rep = NamedSharding(self.mesh, P())
         if emitted is None:
             emitted = np.zeros(np.shape(budgets), np.int32)
         # host inputs get the SAME committed (replicated) sharding the
         # *_end carries come back with, so barrier dispatches and chained
         # dispatches share one compiled signature per horizon bucket
-        put = lambda x, dt: jax.device_put(jnp.asarray(x, dt), rep)
+        toks, active, page_table, lengths, emitted, budgets, eos_ids = \
+            self._stage_host_inputs([
+                (toks, np.int32), (active, bool), (page_table, np.int32),
+                (lengths, np.int32), (emitted, np.int32),
+                (budgets, np.int32), (eos_ids, np.int32)])
         with dist.mesh_scope(self.mesh):
             return self._paged_decode_multi_fn(
-                self.params, put(toks, jnp.int32), put(active, bool),
-                put(page_table, jnp.int32), put(lengths, jnp.int32),
-                pools, put(emitted, jnp.int32), put(budgets, jnp.int32),
-                put(eos_ids, jnp.int32), rng, int(horizon),
+                self.params, toks, active, page_table, lengths,
+                pools, emitted, budgets, eos_ids, rng, int(horizon),
                 bool(do_sample), float(temperature), int(top_k),
                 float(top_p))
+
+    def verify_multi(self, toks, drafts, active, page_table, lengths,
+                     pools, *, widths, budgets, eos_ids, emitted=None):
+        """Speculative-decode verification: score ``drafts`` [slots, K]
+        proposed tokens per slot in ONE teacher-forced dispatch over the
+        paged cache, accept the longest greedy-matching prefix plus the
+        target model's one bonus/correction token.
+
+        ``widths[s] <= K`` is the real draft count for slot ``s`` (the
+        rest of the row is padding); pages covering positions
+        ``lengths[s] .. lengths[s] + widths[s]`` must be allocated.
+        Greedy-only by design: acceptance compares against the
+        ``temperature=0`` argmax contract of ``sample_from_logits``, so
+        spec-decode output is token-exact vs ``generate()``.
+
+        Returns ``(toks_block [slots, K+1] i32, valid [slots, K+1]
+        bool, tok_end, active_end, lengths_end, emitted_end,
+        accepted [slots] i32, new pools)``.  The carries have exactly
+        ``decode_multi``'s shapes/meanings — ``lengths_end`` already
+        reflects the KV rollback (count of emitted tokens only), so a
+        follow-up dispatch can run straight off them; the host mirrors
+        the rollback with ``PagedKVManager.truncate_slot``.  One
+        compiled signature per K (the scheduler's spec-K bucket set)."""
+        assert self.params is not None, "set_params/init_params first"
+        if getattr(self, "_paged_verify_fn", None) is None:
+            self._build_serving_fns()
+        if emitted is None:
+            emitted = np.zeros(np.shape(budgets), np.int32)
+        (toks, drafts, widths, active, page_table, lengths, emitted,
+         budgets, eos_ids) = self._stage_host_inputs([
+             (toks, np.int32), (drafts, np.int32), (widths, np.int32),
+             (active, bool), (page_table, np.int32), (lengths, np.int32),
+             (emitted, np.int32), (budgets, np.int32),
+             (eos_ids, np.int32)])
+        with dist.mesh_scope(self.mesh):
+            return self._paged_verify_fn(
+                self.params, toks, drafts, widths, active, page_table,
+                lengths, pools, emitted, budgets, eos_ids)
+
+    def serving_verify_compile_count(self):
+        """Compiled signatures behind verify_multi — bounded by the
+        scheduler's spec-K bucket set (one per draft width K), never by
+        request churn or acceptance outcomes."""
+        fn = getattr(self, "_paged_verify_fn", None)
+        return 0 if fn is None else fn._cache_size()
 
     def sample_from_logits(self, logits, do_sample=False, temperature=1.0,
                            top_k=0, top_p=1.0):
@@ -681,7 +820,12 @@ class InferenceEngine:
         finishing prefill in a step this way instead of paying one tiny
         dispatch per slot. Sampled mode draws one rng split per CALL
         (not per row), so batching changes the stream; greedy decoding
-        is unaffected."""
+        is unaffected.
+
+        Greedy contract: ``do_sample=False`` OR ``temperature=0`` is a
+        deterministic fp32 argmax, ties breaking to the LOWEST token id
+        — the exact comparison ``verify_multi`` replays on device, so
+        speculative verification stays token-exact vs this function."""
         if isinstance(logits, (list, tuple)):
             rows = jnp.stack([jnp.asarray(r) for r in logits])
         else:

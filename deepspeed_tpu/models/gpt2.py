@@ -185,6 +185,34 @@ class SelfAttention(nn.Module):
                 if alibi is not None:
                     bias = bias + alibi
                 out = decode_attention(q, k_slot, v_slot, bias=bias)
+            elif "widths" in cache:
+                # teacher-forced multi-token verify (speculative decode):
+                # b == slots, l == K+1 candidate tokens per slot. Column
+                # j of slot s writes position lengths[s] + j when
+                # j < widths[s] (widths is already 0 for inactive slots)
+                # and attends causally through the page table — one
+                # batched forward scores every draft instead of one scan
+                # step per token. Columns the verifier later rejects
+                # leave stale K/V past the rolled-back length; that tail
+                # is either overwritten before any later gather reads it
+                # or masked out by the k_pos <= position bias.
+                widths = cache["widths"]
+                pos = positions                          # [slots, l]
+                write = jnp.arange(l)[None, :] < widths[:, None]
+                page_ids = jnp.where(
+                    write, pt[jnp.arange(b)[:, None], pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k.astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v.astype(v_pages.dtype), mode="drop")
+                k_slot = gather_pages(k_pages, pt)
+                v_slot = gather_pages(v_pages, pt)
+                mask = k_pos[None, None, :] <= pos[:, :, None]
+                bias = jnp.where(mask, 0.0,
+                                 jnp.finfo(jnp.float32).min)[:, None]
+                if alibi is not None:
+                    bias = bias + alibi
+                out = decode_attention(q, k_slot, v_slot, bias=bias)
             else:
                 # continuous-batch decode: b == slots, l == 1; inactive
                 # slots write nowhere and produce ignored outputs
@@ -408,6 +436,8 @@ class GPT2(nn.Module):
                 if "slot" in cache:      # chunked prefill (b == 1)
                     positions = (lens[cache["slot"]] +
                                  jnp.arange(l))[None, :]
+                elif "widths" in cache:  # teacher-forced verify (l == K+1)
+                    positions = lens[:, None] + jnp.arange(l)[None, :]
                 else:                    # continuous-batch decode (l == 1)
                     positions = lens[:, None]
                 positions = jnp.broadcast_to(positions, (b, l))
@@ -480,7 +510,7 @@ class GPT2(nn.Module):
                 if paged:
                     layer_cache = dict(layer_cache,
                                        page_table=cache["page_table"])
-                    for key in ("slot", "n_valid", "active"):
+                    for key in ("slot", "n_valid", "active", "widths"):
                         if key in cache:
                             layer_cache[key] = cache[key]
                 pk = None if pld_keeps is None else pld_keeps[i]
@@ -517,6 +547,11 @@ class GPT2(nn.Module):
             if "slot" in cache:
                 lengths = cache["lengths"].at[cache["slot"]].add(
                     cache["n_valid"])
+            elif "widths" in cache:
+                # verify: widths columns written per slot (already 0 for
+                # inactive slots); the engine's verify primitive rewinds
+                # this to the emitted-token count after acceptance
+                lengths = cache["lengths"] + cache["widths"]
             else:
                 lengths = cache["lengths"] + \
                     cache["active"].astype(jnp.int32)

@@ -126,6 +126,31 @@ class LlamaAttention(nn.Module):
                 bias = jnp.where(mask, 0.0,
                                  jnp.finfo(jnp.float32).min)[:, None]
                 out = decode_attention(q, k_slot, v_slot, bias=bias)
+            elif "widths" in cache:
+                # teacher-forced multi-token verify (speculative decode):
+                # b == slots, l == K+1 candidate tokens; column j of
+                # slot s writes position lengths[s] + j when
+                # j < widths[s] (0 for inactive slots) and attends
+                # causally through the page table in ONE batched
+                # forward — same contract as models/gpt2.py. Rotary
+                # offsets ride the positions array; GQA pools stay
+                # grouped through the gather + decode_attention path.
+                widths = cache["widths"]
+                pos = positions                          # [slots, l]
+                write = jnp.arange(l)[None, :] < widths[:, None]
+                page_ids = jnp.where(
+                    write, pt[jnp.arange(b)[:, None], pos // ps], num_pages)
+                k_pages = k_pages.at[page_ids, pos % ps].set(
+                    k.astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, pos % ps].set(
+                    v.astype(v_pages.dtype), mode="drop")
+                k_slot = gather_pages(k_pages, pt)
+                v_slot = gather_pages(v_pages, pt)
+                k_pos = jnp.arange(max_len)
+                mask = k_pos[None, None, :] <= pos[:, :, None]
+                bias = jnp.where(mask, 0.0,
+                                 jnp.finfo(jnp.float32).min)[:, None]
+                out = decode_attention(q, k_slot, v_slot, bias=bias)
             else:                        # continuous-batch decode (l == 1)
                 active = cache["active"]
                 pos = positions[:, 0]
@@ -228,6 +253,8 @@ class Llama(nn.Module):
                 if "slot" in cache:      # chunked prefill (b == 1)
                     positions = (lens[cache["slot"]] +
                                  jnp.arange(l))[None, :]
+                elif "widths" in cache:  # teacher-forced verify (l == K+1)
+                    positions = lens[:, None] + jnp.arange(l)[None, :]
                 else:                    # continuous-batch decode (l == 1)
                     positions = lens[:, None]
                 positions = jnp.broadcast_to(positions, (b, l))
@@ -254,7 +281,7 @@ class Llama(nn.Module):
             if paged:
                 layer_cache = dict(layer_cache,
                                    page_table=cache["page_table"])
-                for key in ("slot", "n_valid", "active"):
+                for key in ("slot", "n_valid", "active", "widths"):
                     if key in cache:
                         layer_cache[key] = cache[key]
             x, new_c = block(cfg, name=f"layers_{i}")(x, positions,
@@ -275,6 +302,10 @@ class Llama(nn.Module):
             if "slot" in cache:
                 lengths = cache["lengths"].at[cache["slot"]].add(
                     cache["n_valid"])
+            elif "widths" in cache:
+                # verify: widths columns written per slot; the engine's
+                # verify primitive rewinds this after acceptance
+                lengths = cache["lengths"] + cache["widths"]
             else:
                 lengths = cache["lengths"] + \
                     cache["active"].astype(jnp.int32)

@@ -53,6 +53,20 @@ point                 site                                     ctx keys
                       pages are reclaimable capacity — and
                       only sheds a victim once the cache is
                       empty/pinned
+``serve.spec_verify`` speculative-decode rounds, twice: per    ``step``,
+                      request while drafts are collected       ``slot``,
+                      (ctx carries slot+rid — a raised         ``rid``
+                      exception degrades THAT request to       (per-req
+                      normal decode, sticky for its            firing
+                      lifetime) and once per round just        only)
+                      before the fused verify dispatch (ctx
+                      is step-only — a raised exception
+                      degrades the whole round to the
+                      normal fused-horizon path). Either
+                      way every request completes
+                      token-exact and the loop survives;
+                      contained degrades count in
+                      ``health()['spec_degraded']``
 ====================  =======================================  ==========
 
 Usage::

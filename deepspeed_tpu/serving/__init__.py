@@ -8,6 +8,9 @@ from deepspeed_tpu.serving.page_manager import (PagedKVManager,  # noqa: F401
                                                 PagePool,
                                                 PagePoolExhausted)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from deepspeed_tpu.serving.spec_decode import (Drafter,  # noqa: F401
+                                               DraftModelDrafter,
+                                               NgramDrafter)
 from deepspeed_tpu.serving.scheduler import (CANCELLED,  # noqa: F401
                                              FAILED,
                                              FINISHED,

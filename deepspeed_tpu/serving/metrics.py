@@ -9,6 +9,8 @@ cannot produce negative or wild TTFT/ITL values.  Terminal outcomes are
 counted distinctly (completed / failed / shed / cancelled): an operator
 must be able to tell "we errored" from "we refused load"."""
 
+from collections import deque
+
 import numpy as np
 
 
@@ -41,6 +43,16 @@ class ServingMetrics:
         self.prefix_hits = 0
         self.prefill_tokens_saved = 0  # == cached prefix tokens reused
         self.cache_evictions = 0       # cached pages drained under pressure
+        # speculative decoding (draft/verify rounds)
+        self.spec_dispatches = 0       # verify_multi rounds harvested
+        self.spec_proposed = 0         # draft tokens scored (sum widths)
+        self.spec_accepted = 0         # drafts the target's argmax matched
+        self.spec_emitted = 0          # tokens a verify round produced
+        self.spec_rollbacks = 0        # rounds that discarded written KV
+        self.spec_rollback_tokens = 0  # KV positions rolled back
+        self.spec_slot_rounds = 0      # (slot, round) pairs that proposed
+        self.spec_degraded = 0         # drafter/verify faults contained
+        self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
         self._events = []
 
     # ---------------------------------------------------------- recording
@@ -115,6 +127,59 @@ class ServingMetrics:
                 ("serving/horizon_wait_ms", device_wait_s * 1e3, step),
             ])
 
+    def record_spec(self, step, *, proposed, accepted, emitted, rollbacks,
+                    rollback_tokens, k, slot_rounds=0):
+        """One speculative draft/verify round was harvested: ``proposed``
+        draft tokens were scored in one dispatch, ``accepted`` matched
+        the target's argmax, ``emitted`` tokens came out (accepted
+        prefixes + one bonus token per live slot), and
+        ``rollback_tokens`` KV positions written for rejected drafts
+        were rolled back across ``rollbacks`` slots."""
+        self.spec_dispatches += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.spec_emitted += emitted
+        self.spec_rollbacks += rollbacks
+        self.spec_rollback_tokens += rollback_tokens
+        self.spec_slot_rounds += slot_rounds
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serving/spec/k", k, step),
+                ("serving/spec/proposed", proposed, step),
+                ("serving/spec/accepted", accepted, step),
+                ("serving/spec/emitted", emitted, step),
+                ("serving/spec/acceptance_rate",
+                 accepted / proposed if proposed else 0.0, step),
+                ("serving/spec/rollback_tokens", rollback_tokens, step),
+            ])
+
+    def record_spec_degrade(self, step, rid=None, reason=None):
+        """A drafter exception or injected verify failure was contained:
+        the request (or the round) degraded to normal decode.  The
+        monitor sinks are scalar-only, so the which/why goes into
+        ``spec_degrade_log`` (bounded) for operator inspection."""
+        self.spec_degraded += 1
+        self.spec_degrade_log.append((step, rid, reason))
+        if self.monitor is not None:
+            self.monitor.write_events([("serving/spec/degraded", 1, step)])
+
+    def record_spec_wait(self, step, device_wait_s):
+        """Host time blocked pulling a verify round's results."""
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("serving/spec/wait_ms", device_wait_s * 1e3, step)])
+
+    def spec_acceptance_rate(self):
+        return self.spec_accepted / self.spec_proposed \
+            if self.spec_proposed else 0.0
+
+    def spec_mean_accepted(self):
+        """Mean accepted draft tokens per proposing slot per round (the
+        speedup driver: each slot-round costs ~one shared target
+        forward and yields mean_accepted + 1 tokens)."""
+        return self.spec_accepted / self.spec_slot_rounds \
+            if self.spec_slot_rounds else 0.0
+
     def record_first_token(self, step, ttft_s):
         self.ttft_s.append(ttft_s)
         self.tokens_emitted += 1
@@ -182,6 +247,14 @@ class ServingMetrics:
             if self.prefix_lookups else 0.0,
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "cache_evictions": self.cache_evictions,
+            "spec_dispatches": self.spec_dispatches,
+            "spec_draft_tokens": self.spec_proposed,
+            "spec_accepted_tokens": self.spec_accepted,
+            "spec_acceptance_rate": round(self.spec_acceptance_rate(), 4),
+            "spec_mean_accepted": round(self.spec_mean_accepted(), 3),
+            "spec_rollbacks": self.spec_rollbacks,
+            "spec_rollback_tokens": self.spec_rollback_tokens,
+            "spec_degraded": self.spec_degraded,
         }
         if wall_s:
             out["tokens_per_sec"] = round(self.tokens_emitted / wall_s, 2)

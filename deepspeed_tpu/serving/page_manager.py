@@ -18,6 +18,17 @@ horizon bucket, never per churn).
 import numpy as np
 
 
+def default_page_size():
+    """Backend-dependent page-size default, shared by every pool builder
+    (ServingScheduler, bin/ds_serve's draft pool): the paged Pallas
+    decode kernel needs 128-multiple pages (TPU lane tiling; anything
+    smaller silently drops every decode step to the gather fallback),
+    while off-TPU the gather fallback runs regardless, so small pages
+    (finer-grained pool sharing) are the better default there."""
+    import jax
+    return 128 if jax.default_backend() == "tpu" else 16
+
+
 class PagePoolExhausted(RuntimeError):
     """Raised when a required allocation cannot be satisfied even after
     the caller's eviction policy ran out of victims."""
@@ -198,6 +209,26 @@ class PagedKVManager:
                 f"(max_pages_per_slot={self.max_pages_per_slot})")
         self.table[slot, have] = page
         self._slot_pages[slot].append(page)
+
+    def truncate_slot(self, slot, new_len):
+        """Rewind ``slot`` to ``new_len`` tokens (speculative-decode KV
+        rollback): pages that fall ENTIRELY past the new boundary leave
+        the slot's chain and drop one holder each (``pool.free`` — a
+        page the prefix cache or another slot still references survives
+        under its remaining holders; only refcount-0 pages recycle).
+        The boundary page keeps its stale tail: positions >= new_len
+        are overwritten before any later gather can read them, or
+        masked out by the attention's length-driven validity mask.
+        Returns the number of page references released."""
+        keep = self.pool.pages_for_tokens(new_len)
+        pages = self._slot_pages[slot]
+        if keep >= len(pages):
+            return 0
+        drop = pages[keep:]
+        del pages[keep:]
+        self.table[slot, keep:keep + len(drop)] = 0
+        self.pool.free(drop)
+        return len(drop)
 
     def take_slot_pages(self, slot):
         """Detach and return a slot's page chain WITHOUT releasing the

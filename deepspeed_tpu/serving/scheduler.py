@@ -52,6 +52,27 @@ or unaffordable.  Before each dispatch every running slot's pages for
 the whole horizon are pre-reserved, so allocation never interrupts the
 fused scan.
 
+**Speculative decoding.**  With ``spec_decode="ngram"`` (or ``"draft"``
+plus a :class:`~deepspeed_tpu.serving.spec_decode.DraftModelDrafter`)
+greedy decode dispatches become draft/verify rounds: a pluggable
+drafter proposes up to K tokens per slot (adaptive per-request K,
+shrunk on low acceptance and capped under page-pool pressure through
+the same pre-reservation path as horizons), one teacher-forced
+``verify_multi`` dispatch scores them all, the longest greedy-matching
+prefix plus the target's bonus token is emitted, and KV written past
+the rejection point rolls back (``truncate_slot``).  Verification
+compares against the exact ``temperature=0`` argmax contract, so
+output is token-exact vs ``generate()`` and vs ``spec_decode=off``
+regardless of drafter quality.  Spec rounds need host-authoritative
+token history to draft from, so every step runs as a barrier step
+while a drafter is configured (no horizon chaining — a chained round
+never consults the drafter, and chaining plain rounds would starve it
+in exactly the steady state spec decode targets); slots with nothing
+to propose ride the verify dispatch as plain one-token decodes, and
+when NO slot has a proposal the step falls back to the normal fused
+horizon dispatch.  ``spec_decode=off`` leaves the PR-3/PR-4 loop
+byte-identical.
+
 **Overlap.**  With ``overlap=True`` the scheduler keeps one horizon in
 flight: when membership is provably frozen (nothing waiting, nothing
 prefilling, no cancel/deadline pressure, next horizon's pages free), it
@@ -95,7 +116,8 @@ import numpy as np
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.page_manager import (PagedKVManager,
-                                                PagePoolExhausted)
+                                                PagePoolExhausted,
+                                                default_page_size)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
@@ -162,15 +184,10 @@ class ServingScheduler:
                  max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
-                 overlap=True, prefix_cache=False, prefix_cache_pages=None):
+                 overlap=True, prefix_cache=False, prefix_cache_pages=None,
+                 spec_decode=None, spec_k=8, spec_drafter=None):
         if page_size is None:
-            # the paged Pallas decode kernel needs 128-multiple pages
-            # (TPU lane tiling); anything smaller silently drops every
-            # decode step to the gather fallback. Off-TPU the gather
-            # fallback runs regardless, so small pages (finer-grained
-            # pool sharing) are the better default there.
-            import jax
-            page_size = 128 if jax.default_backend() == "tpu" else 16
+            page_size = default_page_size()
         self.engine = engine
         self.num_slots = int(num_slots)
         self.prefill_chunk = int(prefill_chunk)
@@ -223,6 +240,45 @@ class ServingScheduler:
         self._chain_budgets = None     # budgets baseline for the live chain
         self._eos_ids = np.full(num_slots, -1, np.int32)
         self._tok_window = deque(maxlen=32)   # per-token wall time samples
+        # speculative decoding: a drafter proposes K tokens per slot,
+        # ONE verify_multi dispatch scores them (greedy-only — the
+        # acceptance test replays the temperature=0 argmax contract, so
+        # sampled mode disables spec rather than silently changing the
+        # sampled stream)
+        self.spec_k = max(1, int(spec_k))
+        buckets, b = {1}, 1
+        while b < self.spec_k:
+            b = min(b * 2, self.spec_k)
+            buckets.add(b)
+        self.spec_k_buckets = sorted(buckets)
+        self._spec = None
+        self.spec_mode = "off"
+        greedy = not do_sample or not temperature
+        if spec_decode not in (None, False, "off", "ngram", "draft"):
+            # validate the mode string unconditionally — a typo must not
+            # slip through just because a custom drafter was supplied
+            # (custom drafters pass spec_decode=None and name themselves
+            # via their .name attribute)
+            raise ValueError(f"unknown spec_decode mode {spec_decode!r}; "
+                             "pick 'ngram', 'draft' (+spec_drafter) or "
+                             "'off'")
+        if spec_decode in ("off", False):
+            pass  # explicit off wins even when a drafter is supplied
+        elif spec_drafter is not None:
+            self._spec = spec_drafter
+            self.spec_mode = spec_decode or getattr(spec_drafter, "name",
+                                                    "custom")
+        elif spec_decode in ("ngram",):
+            from deepspeed_tpu.serving.spec_decode import NgramDrafter
+            self._spec = NgramDrafter()
+            self.spec_mode = "ngram"
+        elif spec_decode == "draft":
+            raise ValueError(
+                "spec_decode='draft' needs a spec_drafter="
+                "DraftModelDrafter(...) carrying the draft engine")
+        if self._spec is not None and not greedy:
+            self._spec = None
+            self.spec_mode = "off (sampled mode)"
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -300,8 +356,19 @@ class ServingScheduler:
         leftover = self.prefix_cache.insert(seq, keep) if keep else []
         self.kv.pool.free(leftover + tail)
 
+    def _spec_release(self, slot, req):
+        """Drop any drafter state for a vacated slot (every terminal and
+        preemption path funnels through here, so a stateful drafter —
+        the draft model's private KV pages — cannot leak)."""
+        if self._spec is not None and req is not None:
+            try:
+                self._spec.on_release(slot, req)
+            except Exception:   # a broken drafter must not break retire
+                pass
+
     def _retire(self, slot):
         req = self.slot_req[slot]
+        self._spec_release(slot, req)
         if self.prefix_cache is not None:
             self._donate_pages(slot, req)
         else:
@@ -319,6 +386,7 @@ class ServingScheduler:
         """Terminal removal of a live slot for cancel/shed/fail: release
         pages at the step boundary, record the reason distinctly."""
         req = self.slot_req[slot]
+        self._spec_release(slot, req)
         self.kv.release_slot(slot)
         self.slot_req[slot] = None
         self.lengths[slot] = 0
@@ -344,6 +412,7 @@ class ServingScheduler:
             return None
         victim = max(candidates, key=lambda s: self.slot_req[s].t_admit)
         req = self.slot_req[victim]
+        self._spec_release(victim, req)
         self.kv.release_slot(victim)
         self.slot_req[victim] = None
         self.lengths[victim] = 0
@@ -754,12 +823,207 @@ class ServingScheduler:
         return horizon, [s for s in kept if self.slot_req[s] is not None
                          and self.slot_req[s].state == RUNNING]
 
+    # --------------------------------------------- speculative decoding
+    def _spec_bucket(self, k):
+        """Smallest spec-K bucket >= k (compile count stays bounded by
+        the bucket set, like horizons)."""
+        for b in self.spec_k_buckets:
+            if b >= k:
+                return b
+        return self.spec_k_buckets[-1]
+
+    def _spec_bucket_floor(self, k):
+        """Largest spec-K bucket <= k (the pressure-shrink ladder)."""
+        out = 1
+        for b in self.spec_k_buckets:
+            if b <= k:
+                out = b
+        return out
+
+    def _update_spec_k(self, req, proposed, accepted):
+        """Per-request adaptive K: EWMA of the per-round acceptance
+        fraction; shrink a bucket when drafts mostly miss (each
+        rejected draft column is wasted verify compute + a rolled-back
+        KV write), grow back toward ``spec_k`` when they mostly hit."""
+        if proposed <= 0:
+            return
+        rate = accepted / proposed
+        prev = getattr(req, "_spec_accept", None)
+        req._spec_accept = rate if prev is None else 0.5 * prev + 0.5 * rate
+        k = getattr(req, "_spec_k", self.spec_k)
+        if req._spec_accept < 0.35:
+            k = max(1, k // 2)
+        elif req._spec_accept > 0.75:
+            k = min(self.spec_k, max(1, k) * 2)
+        req._spec_k = self._spec_bucket(k)
+
+    def _collect_drafts(self, running):
+        """Ask the drafter for proposals, per-request containment
+        included: the ``serve.spec_verify`` fault point fires per
+        request here, and an exception from it (or from the drafter)
+        degrades THAT request to normal decode — sticky via
+        ``_spec_off`` — without touching the loop or its peers."""
+        items = []
+        for slot in running:
+            req = self.slot_req[slot]
+            if getattr(req, "_spec_off", False):
+                continue
+            # never draft past the request's budget (the verify bonus
+            # token supplies the last one) or the slot's page table
+            k = min(getattr(req, "_spec_k", self.spec_k),
+                    req.remaining_new - 1,
+                    self.kv.max_tokens_per_slot() - int(self.lengths[slot])
+                    - 1)
+            if k <= 0:
+                continue
+            try:
+                faults.fire("serve.spec_verify", step=self.step_idx,
+                            slot=slot, rid=req.rid)
+                items.append((slot, req, k))
+            except Exception as e:
+                req._spec_off = True
+                self.metrics.record_spec_degrade(
+                    self.step_idx, req.rid, f"{type(e).__name__}: {e}")
+        if not items:
+            return {}
+        try:
+            drafts = self._spec.propose(items)
+        except Exception:
+            # the batch call hides WHICH request blew up — re-propose
+            # item by item so the offender(s) degrade sticky while
+            # innocent peers keep their drafts (containment: fail one
+            # request's speculation, never the round, never the loop)
+            drafts = {}
+            for item in items:
+                slot, req = item[0], item[1]
+                try:
+                    drafts.update(self._spec.propose([item]))
+                except Exception as e:
+                    req._spec_off = True
+                    self.metrics.record_spec_degrade(
+                        self.step_idx, req.rid,
+                        f"{type(e).__name__}: {e}")
+        out = {}
+        for s, _, _ in items:
+            # no truthiness on the proposal — a drafter handing back a
+            # numpy array would raise on `or`/bool() here, OUTSIDE the
+            # containment try/excepts above, and kill the whole loop
+            d = drafts.get(s)
+            out[s] = [int(t) for t in d] if d is not None and len(d) else []
+        return out
+
+    def _dispatch_spec(self, running):
+        """One draft/verify round over the running slots.  Returns True
+        when a verify dispatch was launched (or the round consumed the
+        step by closing slots); False falls back to the normal fused
+        horizon — the cold-start/no-proposal path, where the plain
+        loop (including overlap) is strictly better."""
+        drafts = self._collect_drafts(running)
+        proposing = [s for s in running if drafts.get(s)]
+        if not proposing:
+            return False
+        # mixed-batch gate: a verify round runs every NON-proposing
+        # slot as a 1-token decode, so when proposers are a minority
+        # of the batch the plain fused horizon (decode_horizon_steps
+        # tokens for EVERY slot) out-produces the round server-wide —
+        # fall back and let the minority ride it this step.  Abandoned
+        # proposals are safe to discard: the ngram drafter is
+        # stateless and DraftModelDrafter._sync truncates
+        # never-harvested draft KV (same contract as the round-level
+        # fault degrade below).
+        if 2 * len(proposing) < len(running):
+            return False
+        k = self._spec_bucket(max(len(d) for d in drafts.values()))
+        # page pre-reservation, spec flavor: a verify writes
+        # widths[s]+1 positions (rollback releases the surplus), so
+        # shrink the K bucket before any eviction would run — same
+        # policy ladder as the horizon pre-reservation
+        reclaimable = None
+        while k > 1:
+            need = sum(self.kv.pages_needed(
+                s, int(self.lengths[s]) + min(len(drafts.get(s, ())), k)
+                + 1) for s in running)
+            avail = self.kv.pool.free_pages
+            if need > avail and self.prefix_cache is not None:
+                if reclaimable is None:
+                    reclaimable = self.prefix_cache.reclaimable_pages()
+                avail += reclaimable
+            if need <= avail:
+                break
+            k = self._spec_bucket_floor(k - 1)
+        kept = []
+        for slot in running:
+            req = self.slot_req[slot]
+            if req is None or req.state != RUNNING:
+                continue
+            w = min(len(drafts.get(slot, ())), k)
+            try:
+                if self._grow_or_evict(slot, int(self.lengths[slot]) + w
+                                       + 1):
+                    kept.append(slot)
+            except PagePoolExhausted as e:
+                self._close_slot(slot, SHED, f"page capacity: {e}")
+            except Exception as e:
+                self._close_slot(slot, FAILED, f"{type(e).__name__}: {e}")
+        running = [s for s in kept if self.slot_req[s] is not None and
+                   self.slot_req[s].state == RUNNING]
+        if not running:
+            return True
+        try:
+            # dispatch-level fault point: a raised verify failure
+            # degrades the whole round to normal decode (the loop and
+            # every request survive; tokens stay exact either way)
+            faults.fire("serve.spec_verify", step=self.step_idx)
+        except Exception as e:
+            self.metrics.record_spec_degrade(
+                self.step_idx, None, f"{type(e).__name__}: {e}")
+            return False
+        draft_arr = np.zeros((self.num_slots, k), np.int32)
+        widths = np.zeros(self.num_slots, np.int32)
+        active = np.zeros(self.num_slots, bool)
+        budgets = np.zeros(self.num_slots, np.int32)
+        for s in running:
+            d = drafts.get(s, [])[:k]
+            draft_arr[s, :len(d)] = d
+            widths[s] = len(d)
+            active[s] = True
+            budgets[s] = self.slot_req[s].remaining_new
+        self._chain_budgets = budgets
+        out = self.engine.verify_multi(
+            self.last_tok, draft_arr, active, self.kv.table, self.lengths,
+            self.pools, widths=widths, budgets=budgets,
+            eos_ids=self._eos_ids)
+        (toks, valid, tok_end, active_end, lengths_end, emitted_end,
+         accepted, pools) = out
+        self.pools = pools
+        for arr in (toks, valid):
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        self._inflight.append({
+            "spec": True,
+            "slots": list(running),
+            "reqs": {s: self.slot_req[s] for s in running},
+            "horizon": k + 1,
+            "widths": {s: int(widths[s]) for s in running},
+            "accepted": accepted,
+            "toks": toks, "valid": valid, "tok_end": tok_end,
+            "active_end": active_end, "lengths_end": lengths_end,
+            "emitted_end": emitted_end, "release_after": set(),
+        })
+        return True
+
     def _dispatch(self):
         """Reserve pages and launch one fused horizon over every running
         slot.  The batched dispatch is shared — an error here is NOT
         attributable to one request and must surface loudly."""
         running = [s for s in range(self.num_slots)
                    if self.slot_req[s] is not None and
+                   self.slot_req[s].state == RUNNING]
+        if not running:
+            return
+        if self._spec is not None and self._dispatch_spec(running):
+            return
+        running = [s for s in running if self.slot_req[s] is not None and
                    self.slot_req[s].state == RUNNING]
         if not running:
             return
@@ -813,6 +1077,11 @@ class ServingScheduler:
         would corrupt the new owner's cache.  Returns True when the
         chained horizon was dispatched."""
         prev = self._inflight[-1]
+        if self._spec is not None or prev.get("spec"):
+            # spec rounds need host-authoritative token history (the
+            # drafter reads out_tokens) and a host-side rollback per
+            # verify — every spec step is a barrier step by design
+            return False
         if self.waiting:
             return False
         live = [r for r in self.slot_req if r is not None]
@@ -938,13 +1207,61 @@ class ServingScheduler:
                 self.lengths[slot] += n
                 if n:
                     self.last_tok[slot] = int(toks[slot][valid[slot]][-1])
+        if rec.get("spec"):
+            self._harvest_spec(rec, valid)
         for slot in rec["release_after"]:
             self.kv.release_slot(slot)
             self.lengths[slot] = 0
             self._zombies.discard(slot)
-        self.metrics.record_horizon(self.step_idx, rec["horizon"], pulled,
-                                    wait)
+        if rec.get("spec"):
+            self.metrics.record_spec_wait(self.step_idx, wait)
+        else:
+            self.metrics.record_horizon(self.step_idx, rec["horizon"],
+                                        pulled, wait)
         return wait, pulled
+
+    def _harvest_spec(self, rec, valid):
+        """Spec-round epilogue: roll the KV back to the emitted
+        boundary (``truncate_slot`` — pages written for rejected drafts
+        recycle), feed the drafter its acceptance outcome, adapt each
+        request's K, and record the round's telemetry.  Runs after the
+        shared emit/retire loop, so ``lengths`` already counts only
+        emitted tokens and ``out_tokens`` is current."""
+        accepted = np.asarray(rec["accepted"])
+        proposed = acc_total = rollbacks = rollback_tokens = 0
+        for slot in rec["slots"]:
+            req = rec["reqs"][slot]
+            w = rec["widths"][slot]
+            n = int(valid[slot].sum())
+            acc = int(accepted[slot])
+            proposed += w
+            acc_total += acc
+            discard = max(0, (w + 1) - n)
+            if discard:
+                rollbacks += 1
+                rollback_tokens += discard
+            req._spec_proposed = getattr(req, "_spec_proposed", 0) + w
+            req._spec_hits = getattr(req, "_spec_hits", 0) + acc
+            self._update_spec_k(req, w, acc)
+            if self.slot_req[slot] is req and req.state == RUNNING:
+                # live slot: release pages past the accepted boundary
+                # (a retiring slot's surplus pages were already freed —
+                # or donated minus the invalid tail — at retire)
+                self.kv.truncate_slot(slot, int(self.lengths[slot]))
+                if self._spec is not None:
+                    try:
+                        self._spec.on_verified(slot, req, n, acc)
+                    except Exception as e:   # containment, as ever
+                        req._spec_off = True
+                        self.metrics.record_spec_degrade(
+                            self.step_idx, req.rid,
+                            f"{type(e).__name__}: {e}")
+        self.metrics.record_spec(
+            self.step_idx, proposed=proposed, accepted=acc_total,
+            emitted=int(valid.sum()), rollbacks=rollbacks,
+            rollback_tokens=rollback_tokens, k=rec["horizon"] - 1,
+            slot_rounds=sum(1 for s in rec["slots"]
+                            if rec["widths"][s] > 0))
 
     def _close_slot_or_defer(self, slot, state, reason):
         """Terminal removal discovered at a horizon boundary.  If a
@@ -956,6 +1273,7 @@ class ServingScheduler:
             self._close_slot(slot, state, reason)
             return
         req = self.slot_req[slot]
+        self._spec_release(slot, req)
         self.slot_req[slot] = None
         self._finalize(req, state, reason)
         self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
@@ -1013,6 +1331,14 @@ class ServingScheduler:
             "decode_horizon_steps": self.decode_horizon_steps,
             "horizon_buckets": list(self.horizon_buckets),
             "overlap": self.overlap,
+            "spec_decode": self.spec_mode,
+            "spec_k": self.spec_k if self._spec is not None else None,
+            "spec_acceptance_rate": round(m.spec_acceptance_rate(), 4),
+            "spec_mean_accepted": round(m.spec_mean_accepted(), 3),
+            "spec_draft_tokens": m.spec_proposed,
+            "spec_accepted_tokens": m.spec_accepted,
+            "spec_rollbacks": m.spec_rollbacks,
+            "spec_degraded": m.spec_degraded,
             "inflight_horizons": len(self._inflight),
             "completed": m.completed,
             "failed": m.failed,

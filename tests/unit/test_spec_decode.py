@@ -1,0 +1,579 @@
+"""Speculative decoding: draft/verify serving with fused horizon
+verification and KV rollback.
+
+The oracle: greedy serving output with spec decode ON — either drafter,
+any K, adaptive K, mid-verify EOS, budgets expiring mid-verify,
+rejections forcing mid-page KV rollback, eviction under pool pressure —
+is TOKEN-EXACT vs per-request ``generate()`` AND vs the spec-off
+scheduler.  Drafter quality may only ever change speed: verification
+compares drafts against the ``temperature=0`` argmax contract and the
+bonus token IS the sequential greedy token, so even an adversarial
+always-wrong drafter must reproduce the stream exactly.
+
+Every scheduler here shares the SAME (slots, pages, page_size,
+max_pages, chunk) constants, so verify-dispatch jit signatures differ
+only by the spec-K bucket — the compile-count test's bound covers the
+whole module (the test_serving.py / test_serving_horizon.py scheme).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.faults import FaultInjector
+from deepspeed_tpu.serving import (Drafter, DraftModelDrafter,
+                                   NgramDrafter, ServingScheduler)
+from deepspeed_tpu.serving.page_manager import PagedKVManager
+
+CFG = dict(num_slots=3, num_pages=24, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = GPT2(gpt2_tiny())
+    eng = deepspeed_tpu.init_inference(
+        model=model, dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+def _oracle(engine, prompts, max_new, eos=None):
+    out = []
+    for p, m in zip(prompts, max_new):
+        toks = [int(t) for t in engine.generate(
+            p[None], max_new_tokens=m, do_sample=False)[0, len(p):]]
+        if eos is not None and eos in toks:
+            toks = toks[:toks.index(eos) + 1]
+        out.append(toks)
+    return out
+
+
+def _serve(engine, prompts, max_new, eos=None, **kw):
+    kw.setdefault("decode_horizon_steps", 8)
+    sched = ServingScheduler(engine, **CFG, **kw)
+    reqs = [sched.submit(p, max_new_tokens=m, eos_token_id=eos)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    return sched, [got.get(r.rid) for r in reqs]
+
+
+class OracleDrafter(Drafter):
+    """Proposes exactly the target's own greedy continuation (perfect
+    acceptance by construction)."""
+
+    name = "oracle"
+
+    def __init__(self, streams):
+        self.streams = streams        # rid -> full greedy stream
+
+    def propose(self, items):
+        out = {}
+        for slot, req, k in items:
+            idx = len(req.out_tokens)
+            out[slot] = self.streams[req.rid][idx:idx + k]
+        return out
+
+
+class WrongDrafter(Drafter):
+    """Adversarial: every draft misses (vocab shifted off the greedy
+    argmax), so every verify round rejects at position 0 and emits only
+    the bonus/correction token — worst case for rollback volume."""
+
+    name = "wrong"
+
+    def __init__(self, streams, vocab=256):
+        self.streams = streams
+        self.vocab = vocab
+
+    def propose(self, items):
+        out = {}
+        for slot, req, k in items:
+            idx = len(req.out_tokens)
+            truth = self.streams[req.rid][idx:idx + k]
+            out[slot] = [(t + 1) % self.vocab for t in truth]
+        return out
+
+
+# ------------------------------------------------------- greedy contract
+
+
+def test_greedy_sampling_contract(engine):
+    """``sample_from_logits(temperature=0)`` is a deterministic argmax
+    regardless of do_sample, and ties break to the LOWEST token id —
+    the exact comparison verify_multi replays on device."""
+    logits = np.full(256, -1.0, np.float32)
+    logits[[7, 40, 200]] = 3.5           # three-way exact tie
+    for kw in (dict(do_sample=False),
+               dict(do_sample=False, temperature=0.0),
+               dict(do_sample=True, temperature=0.0),
+               dict(do_sample=True, temperature=0.0, top_k=5, top_p=0.9)):
+        assert engine.sample_from_logits(logits, **kw) == 7, kw
+    # batched rows keep the same contract
+    rows = [logits, np.roll(logits, 1)]
+    assert engine.sample_from_logits(rows, do_sample=True,
+                                     temperature=0.0) == [7, 8]
+
+
+# ------------------------------------------------------------ the oracle
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_spec_ngram_oracle_token_exact(engine, k):
+    """Spec-on (ngram drafter) serving is token-exact vs generate() and
+    vs spec-off at K in {2, 4, 8}, including an EOS landing mid-verify
+    (tokens the verify scored past it must be dropped) and a max_new
+    budget expiring mid-verify."""
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (9, 5, 9, 6)]
+    max_new = [12, 6, 10, 14]
+    base = _oracle(engine, prompts, max_new)
+    # self-calibrating eos: pick it off the measured stream so it lands
+    # strictly inside a verify round (index 3 of 12)
+    eos = base[0][3]
+    want = _oracle(engine, prompts, max_new, eos=eos)
+
+    _, off = _serve(engine, prompts, max_new, eos=eos)
+    assert off == want, "spec-off baseline diverged from generate()"
+
+    sched, on = _serve(engine, prompts, max_new, eos=eos,
+                       spec_decode="ngram", spec_k=k)
+    assert on == want, f"spec-on K={k} diverged"
+    assert on == off
+    assert sched.kv.pool.pages_in_use == 0
+    assert sched.spec_k_buckets[-1] == k
+
+
+def test_spec_draft_model_oracle_token_exact(engine):
+    """Draft-model drafter: a 1-layer random-init draft of the same
+    architecture proposes from its OWN paged KV slots; output stays
+    token-exact and the draft page pool drains to empty (its rollback/
+    release accounting leaks nothing)."""
+    draft_model = GPT2(gpt2_tiny(num_layers=1))
+    draft_eng = deepspeed_tpu.init_inference(
+        model=draft_model, dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    draft_eng.init_params()
+    drafter = DraftModelDrafter(
+        draft_eng, num_slots=CFG["num_slots"], num_pages=24, page_size=16,
+        max_pages_per_slot=8, prefill_chunk=8)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 9, 7)]
+    max_new = [20, 14, 16]
+    want = _oracle(engine, prompts, max_new)
+    sched, on = _serve(engine, prompts, max_new, spec_decode="draft",
+                       spec_drafter=drafter, spec_k=4)
+    assert on == want
+    assert sched.kv.pool.pages_in_use == 0
+    assert drafter.kv.pool.pages_in_use == 0, "draft pool leaked pages"
+    assert sched.metrics.spec_dispatches > 0
+
+
+def test_adaptive_k_and_mid_page_rollback(engine):
+    """Worst case drafting: every draft rejected.  Adaptive K must
+    shrink each request's K to the smallest bucket (wasted verify width
+    is paid compute), every round must roll back its rejected KV —
+    including pages that straddled a page boundary mid-write — and the
+    stream must STILL be token-exact (each round emits the correction
+    token, which is the sequential greedy token).  The perfect drafter
+    is the control: K grows back to the cap and rollbacks stay 0."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (6, 9)]
+    max_new = [26, 26]   # long enough to cross page boundaries mid-run
+    want = _oracle(engine, prompts, max_new)
+    streams = {}   # rid assigned at submit; drafter keyed lazily
+
+    class _Wrong(WrongDrafter):
+        def propose(self, items):
+            for slot, req, k in items:
+                self.streams.setdefault(
+                    req.rid, want[[r.rid for r in reqs].index(req.rid)])
+            return super().propose(items)
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=8,
+                             spec_drafter=_Wrong(streams), **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w, "always-wrong drafter broke exactness"
+        assert getattr(r, "_spec_k", None) == 1, \
+            "adaptive K failed to shrink under 0% acceptance"
+    m = sched.metrics
+    assert m.spec_acceptance_rate() == 0.0
+    assert m.spec_rollbacks > 0 and m.spec_rollback_tokens > 0, \
+        "rejected drafts must roll KV back"
+    assert sched.kv.pool.pages_in_use == 0, \
+        "mid-page rollback leaked pages"
+
+    # control: the perfect drafter — full acceptance, zero rollback of
+    # accepted content (only the final round's unused tail), K at cap
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=8,
+                             spec_drafter=OracleDrafter(streams), **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    streams.clear()
+    streams.update({r.rid: w for r, w in zip(reqs, want)})
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+        assert getattr(r, "_spec_k", None) == 8, \
+            "adaptive K failed to grow under 100% acceptance"
+    assert sched.metrics.spec_acceptance_rate() > 0.9
+    assert sched.metrics.spec_mean_accepted() > 2.0
+
+
+def test_spec_eviction_under_pressure(engine):
+    """Pool pressure during spec rounds: the K bucket shrinks first,
+    then the legacy preempt-the-youngest eviction runs — and the
+    preempted request round-trips token-exact through re-prefill."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 9, 5)]
+    max_new = [60, 60, 60]
+    want = _oracle(engine, prompts, max_new)
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=8, **CFG)
+    hostage = sched.kv.pool.allocate(14)    # 10 pages left, 15+ needed
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    assert sched.metrics.preemptions > 0, \
+        "pool was sized to force eviction; none happened"
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+    assert sched.kv.pool.pages_in_use == 14
+    sched.kv.pool.free(hostage)
+
+
+# -------------------------------------------------- fault containment
+
+
+def test_drafter_exception_degrades_request(engine):
+    """A drafter that throws for one request degrades THAT request to
+    normal decode (sticky), token-exact; peers keep spec; loop lives."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (6, 8)]
+    max_new = [14, 14]
+    want = _oracle(engine, prompts, max_new)
+
+    class _Faulty(NgramDrafter):
+        def __init__(self, bad_rid):
+            super().__init__()
+            self.bad_rid = bad_rid
+
+        def propose(self, items):
+            for slot, req, k in items:
+                if req.rid == self.bad_rid:
+                    raise RuntimeError("drafter exploded")
+            return super().propose(items)
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=4, **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    sched._spec = _Faulty(reqs[0].rid)
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert r.state == "finished"
+        assert got[r.rid] == w
+    assert sched.metrics.spec_degraded >= 1
+    assert getattr(reqs[0], "_spec_off", False), "degrade must be sticky"
+    assert sched.kv.pool.pages_in_use == 0
+
+
+def test_numpy_array_drafts_are_accepted(engine):
+    """A drafter may hand back numpy arrays as proposals (a model-based
+    drafter naturally does) — the collection path must not evaluate
+    array truthiness, which would raise OUTSIDE the containment
+    try/excepts and kill the whole loop."""
+    motif = np.array([11, 12, 13, 14, 15, 16], np.int32)
+    prompts = [np.tile(motif, 4)]
+    max_new = [24]
+    want = _oracle(engine, prompts, max_new)
+
+    class _NumpyNgram(NgramDrafter):
+        name = "numpy-ngram"
+
+        def propose(self, items):
+            return {s: np.asarray(d, np.int64)
+                    for s, d in super().propose(items).items()}
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode=None, spec_drafter=_NumpyNgram(),
+                             spec_k=4, **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    assert got[reqs[0].rid] == want[0]
+    assert sched.metrics.spec_degraded == 0
+    assert sched.metrics.spec_accepted > 0, "array drafts never verified"
+
+
+def test_draft_pool_smaller_than_target_degrades_gracefully(engine):
+    """A draft pool sized smaller than the target's (the natural cheap-
+    draft setup): once the verified stream outgrows a draft slot's
+    table, that request must simply stop proposing — NOT trip
+    ensure_capacity's max_pages_per_slot config error, which the
+    scheduler's containment would turn into a sticky degrade with a
+    misleading reason in spec_degrade_log."""
+    draft_model = GPT2(gpt2_tiny(num_layers=1))
+    draft_eng = deepspeed_tpu.init_inference(
+        model=draft_model, dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    draft_eng.init_params()
+    # draft slots hold 16 tokens; the requests run well past that
+    drafter = DraftModelDrafter(
+        draft_eng, num_slots=CFG["num_slots"], num_pages=8, page_size=8,
+        max_pages_per_slot=2, prefill_chunk=8)
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 7)]
+    max_new = [30, 30]
+    want = _oracle(engine, prompts, max_new)
+    sched, on = _serve(engine, prompts, max_new, spec_decode="draft",
+                       spec_drafter=drafter, spec_k=4)
+    assert on == want
+    assert sched.metrics.spec_degraded == 0, \
+        "outgrown draft slots must mean no proposal, not a degrade: " \
+        f"{list(sched.metrics.spec_degrade_log)}"
+    assert sched.kv.pool.pages_in_use == 0
+    assert drafter.kv.pool.pages_in_use == 0
+
+
+def test_minority_proposer_round_rides_plain_horizon(engine):
+    """Mixed-batch gate: when proposers are a minority of the running
+    slots, the round must skip the verify (which would run every
+    non-proposing slot as a 1-token decode) and ride the plain fused
+    horizon instead — token-exact, with zero verify dispatches when the
+    drafter only ever covers 1 of 3 slots."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (6, 7, 8)]
+    max_new = [16, 16, 16]
+    want = _oracle(engine, prompts, max_new)
+    streams = {}
+
+    class _OneSlot(OracleDrafter):
+        """Perfect drafts, but only ever for the lowest live rid."""
+
+        def propose(self, items):
+            lone = min(items, key=lambda it: it[1].rid)
+            return super().propose([lone])
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode=None,
+                             spec_drafter=_OneSlot(streams), spec_k=8,
+                             **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    streams.update({r.rid: w for r, w in zip(reqs, want)})
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+    assert sched.metrics.spec_dispatches == 0, \
+        "1-of-3 proposer rounds must fall back to the plain horizon"
+    assert sched.kv.pool.pages_in_use == 0
+
+
+def test_unknown_spec_mode_rejected_even_with_drafter(engine):
+    """A typo'd spec_decode string must raise whether or not a custom
+    drafter is supplied — a drafter must not turn validation off (the
+    A/B operator would silently run mode 'ngarm')."""
+    for kw in ({}, {"spec_drafter": NgramDrafter()}):
+        with pytest.raises(ValueError, match="unknown spec_decode"):
+            ServingScheduler(engine, spec_decode="ngarm", **kw, **CFG)
+
+
+def test_spec_verify_fault_degrades_to_normal_decode(engine):
+    """Injected ``serve.spec_verify`` faults (the satellite contract):
+    a rid-matched fault degrades one request; a dispatch-level fault
+    (ctx without rid) degrades whole rounds to the normal fused
+    horizon.  Either way every request completes token-exact and the
+    loop never dies."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (7, 9)]
+    max_new = [12, 12]
+    want = _oracle(engine, prompts, max_new)
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=4, **CFG)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    inj = FaultInjector(seed=0)
+    plan_rid = inj.on("serve.spec_verify", match={"rid": reqs[1].rid},
+                      exc=RuntimeError("draft path down"))
+    # rid=None matches ONLY the dispatch-level firing (its ctx has no
+    # rid key); times=3 kills several whole rounds
+    plan_all = inj.on("serve.spec_verify", match={"rid": None},
+                      exc=RuntimeError("verify down"), times=3)
+    with faults.injected(inj):
+        got = sched.run()
+    for r, w in zip(reqs, want):
+        assert r.state == "finished"
+        assert got[r.rid] == w
+    assert plan_rid.fired == 1 and plan_all.fired >= 1
+    assert sched.metrics.spec_degraded >= plan_rid.fired + plan_all.fired
+    assert sched._last_error is None
+    assert sched.kv.pool.pages_in_use == 0
+
+
+# --------------------------------------- rollback + sharing invariants
+
+
+def test_truncate_slot_never_frees_shared_pages():
+    """``truncate_slot`` under refcounted sharing: a dropped page that
+    another holder (prefix cache, second slot) still references must
+    survive — only its reference drops — while exclusively held pages
+    recycle; the boundary page always stays."""
+    kv = PagedKVManager(num_pages=8, page_size=4, num_slots=2,
+                        max_pages_per_slot=6)
+    assert kv.ensure_capacity(0, 20)            # 5 pages
+    pages = list(kv._slot_pages[0])
+    shared = pages[3]
+    kv.pool.share([shared])                     # a second holder
+    freed = kv.truncate_slot(0, 9)              # keep ceil(9/4)=3 pages
+    assert freed == 2
+    assert kv._slot_pages[0] == pages[:3]
+    assert list(kv.table[0, :3]) == pages[:3]
+    assert all(kv.table[0, i] == 0 for i in range(3, 6))
+    assert kv.pool.ref_count(shared) == 1, \
+        "shared page lost its other holder's reference"
+    assert kv.pool.ref_count(pages[4]) == 0, "exclusive page must recycle"
+    assert kv.pool.free_pages == 8 - 4          # 3 held + 1 shared
+    # rewind-to-zero releases everything the slot still holds
+    assert kv.truncate_slot(0, 0) == 3
+    assert kv.pool.free_pages == 8 - 1 and kv.pool.ref_count(shared) == 1
+    kv.pool.free([shared])
+    assert kv.pool.free_pages == 8
+
+
+def test_spec_donates_only_accepted_tokens_to_prefix_cache(engine):
+    """Spec x prefix cache: a retiring spec-decoded request donates only
+    pages whose KV the verify ACCEPTED — the trie-walk must spell
+    exactly the request's true token sequence (coherence invariant: a
+    later identical prompt hits real KV, never rolled-back garbage),
+    and the follow-up request served off those cached pages is
+    token-exact."""
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 256, 9).astype(np.int32)
+    max_new = 30
+    want = _oracle(engine, [prompt], [max_new])[0]
+
+    sched = ServingScheduler(engine, decode_horizon_steps=8,
+                             spec_decode="ngram", spec_k=8,
+                             prefix_cache=True, **CFG)
+    r1 = sched.submit(prompt, max_new_tokens=max_new)
+    got = sched.run()
+    assert got[r1.rid] == want
+    assert sched.metrics.spec_dispatches > 0, "spec never engaged"
+
+    # trie-walk coherence: every cached chain must spell a prefix of
+    # the donated request's true sequence, and cover only KV-valid
+    # (written) positions — never the rolled-back tail
+    seq = list(prompt) + want
+    ps = CFG["page_size"]
+    n_full = (len(seq) - 1) // ps
+    node = sched.prefix_cache._root
+    depth = 0
+    while node.children:
+        assert len(node.children) == 1
+        key, node = next(iter(node.children.items()))
+        want_key = tuple(seq[depth * ps:(depth + 1) * ps])
+        assert key == want_key, \
+            f"cached page {depth} keys {key} != true tokens {want_key}"
+        depth += 1
+    assert depth == n_full, "donation must cover exactly the full pages"
+
+    # a second identical request must hit the cache AND stay exact
+    r2 = sched.submit(prompt, max_new_tokens=max_new)
+    got = sched.run()
+    assert got[r2.rid] == want
+    assert r2.cached_prefix_tokens > 0, "prefix cache missed a clean hit"
+
+
+# --------------------------------------------------- compile discipline
+
+
+def test_spec_off_leaves_loop_untouched(engine):
+    """``spec_decode=off`` must add no compiled signatures and change
+    no outputs: the verify fn is never built/called and decode_multi's
+    compile set stays within the horizon buckets."""
+    before_verify = engine.serving_verify_compile_count()
+    before_multi = engine.serving_decode_multi_compile_count()
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 8)]
+    max_new = [10, 10]
+    want = _oracle(engine, prompts, max_new)
+    sched, off = _serve(engine, prompts, max_new)
+    assert off == want
+    assert sched.spec_mode == "off" and sched._spec is None
+    assert engine.serving_verify_compile_count() == before_verify
+    assert engine.serving_decode_multi_compile_count() == before_multi
+
+
+def test_spec_off_wins_over_supplied_drafter(engine):
+    """An explicit ``spec_decode='off'`` disables speculation even when
+    a drafter instance is supplied — an A/B baseline must not silently
+    speculate while health() reports 'off'."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, 6).astype(np.int32)]
+    want = _oracle(engine, prompts, [10])
+    sched, got = _serve(engine, prompts, [10], spec_decode="off",
+                        spec_drafter=NgramDrafter(), spec_k=4)
+    assert got == want
+    assert sched.spec_mode == "off" and sched._spec is None
+    assert sched.health()["spec_decode"] == "off"
+    assert sched.metrics.spec_dispatches == 0
+
+
+def test_draft_written_watermark_under_full_acceptance(engine):
+    """Full acceptance is the dangerous case for the draft cache: the
+    draft scan never writes KV for its LAST proposed token, so the new
+    verified boundary passes the written watermark by one.  ``_written``
+    must never claim that hole — a silent claim leaves garbage KV the
+    draft model attends over forever (output stays exact; acceptance
+    quietly rots).  Drafting with the TARGET model forces acceptance."""
+    audited = []
+
+    class _Audit(DraftModelDrafter):
+        def on_verified(self, slot, req, n_emitted, n_accepted):
+            watermark = int(self.lengths[slot])   # positions written
+            super().on_verified(slot, req, n_emitted, n_accepted)
+            audited.append((int(self._written[slot]), watermark))
+
+    drafter = _Audit(engine, num_slots=CFG["num_slots"], num_pages=24,
+                     page_size=16, max_pages_per_slot=8, prefill_chunk=8)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (6, 8)]
+    max_new = [24, 20]
+    want = _oracle(engine, prompts, max_new)
+    sched, on = _serve(engine, prompts, max_new, spec_decode="draft",
+                       spec_drafter=drafter, spec_k=4)
+    assert on == want
+    assert sched.metrics.spec_acceptance_rate() > 0.9, \
+        "target-as-draft should accept (almost) everything"
+    assert audited and all(w <= mark for w, mark in audited), \
+        "on_verified claimed a draft-KV position the scan never wrote"
+    assert drafter.kv.pool.pages_in_use == 0
+
+
+def test_verify_compile_count_bounded_by_k_buckets(engine):
+    """Across every spec scheduler this module ran — churn, adaptive K,
+    rejections, eviction, faults — verify_multi compiled at most one
+    signature per spec-K bucket."""
+    if engine.serving_verify_compile_count() == 0:   # solo-run support
+        rng = np.random.default_rng(1)
+        _serve(engine, [rng.integers(0, 256, 6).astype(np.int32)], [8],
+               spec_decode="ngram", spec_k=8)
+    buckets = {1}
+    b = 1
+    while b < 8:
+        b *= 2
+        buckets.add(b)
+    assert 0 < engine.serving_verify_compile_count() <= len(buckets)

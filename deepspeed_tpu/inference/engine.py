@@ -29,6 +29,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.parallel import sharding as shd
 from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.serving.sharding import (ServingShardingConfig,
+                                            config_scope,
+                                            pool_bytes_per_device)
 from deepspeed_tpu.utils.logging import log_dist
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
@@ -84,6 +87,12 @@ class InferenceEngine:
         # internals see self.mesh via dist.mesh_scope around every trace
         if dist.get_mesh() is None:
             dist.set_mesh(mesh)
+        # logical serving axes -> mesh axes (kv_heads/slots/pages/vocab;
+        # serving/sharding.py); resolved lazily at first paged-serving
+        # use so forward/generate-only engines never pay or constrain it
+        self.serving_sharding = ServingShardingConfig()
+        self._serving_shd = None
+        self._validate_mesh_for_model()
 
         for field, val in (("dtype", config.dtype),
                            ("kv_cache_dtype", config.kv_cache_dtype)):
@@ -133,6 +142,105 @@ class InferenceEngine:
             self.set_params(params)
         if ckpt is not None:
             self.load_checkpoint(str(ckpt))
+
+    # ------------------------------------------------------------------- mesh
+    def _model_head_counts(self):
+        """(num_heads, num_kv_heads) from the module config, or (None,
+        None) when the module has no head-count contract (generic flax
+        modules still forward/generate; only validation and KV-pool
+        sharding need the counts)."""
+        cfg = getattr(self.module, "cfg", None)
+        heads = getattr(cfg, "num_heads", None)
+        kv = getattr(cfg, "num_kv_heads", heads)
+        return heads, kv
+
+    def _validate_mesh_for_model(self):
+        """Construction-time mesh-shape validation: a ``model``-axis
+        size that does not divide ``num_heads`` would shard attention
+        mid-head — the exact configuration the legacy (jax<0.5) SPMD
+        partitioner silently miscompiles into ~1e-2 output drift (the
+        seed-era tp=8-over-4-heads failure).  Fail loudly at
+        construction instead (the check lives in
+        ``ServingShardingConfig.validate_heads`` so a custom rule table
+        validates its own configured axis); the serving path
+        additionally validates ``num_kv_heads`` when the paged KV pools
+        are built (GQA pools shard their kv-head dim over ``model`` —
+        kv divisibility is deliberately NOT a construction error:
+        generate()-only GQA engines with tp > num_kv_heads are legal
+        and tested)."""
+        heads, _ = self._model_head_counts()
+        if heads:
+            self.serving_sharding.validate_heads(self.mesh, heads)
+
+    def _serving_shardings(self, num_slots=None):
+        """Resolved serving shardings (serving/sharding.py) for this
+        mesh + model: KV pools shard kv_heads over ``model``, per-slot
+        carries / token blocks / the page table shard slots over
+        ``data``, page ids stay global (replicated page dim).  Raises a
+        clear ValueError when ``model`` does not divide num_kv_heads.
+        Resolved at first paged-serving use; the serving wrappers pass
+        the live ``num_slots`` so a slot count the data axis cannot
+        divide evenly degrades that one family to replicated (jax
+        requires dim % shards == 0) instead of crashing — when that
+        decision flips vs the cached resolution, the jitted serving
+        fns are rebuilt (their pinned out_shardings carry it)."""
+        def _resolve(n):
+            _, kv_heads = self._model_head_counts()
+            cfg = getattr(self.module, "cfg", None)
+            return self.serving_sharding.resolve(
+                self.mesh, num_kv_heads=kv_heads or 1,
+                vocab_size=getattr(cfg, "vocab_size", None), num_slots=n)
+        if self._serving_shd is None:
+            self._serving_shd = _resolve(num_slots)
+            self._serving_shd_slots = num_slots
+        elif num_slots is not None and \
+                num_slots != getattr(self, "_serving_shd_slots", None):
+            fresh = _resolve(num_slots)
+            if fresh.slot_axis != self._serving_shd.slot_axis:
+                log_dist(
+                    f"serving slot sharding -> {fresh.slot_axis or 'replicated'}"
+                    f" for num_slots={num_slots}; rebuilding serving fns")
+                self._paged_prefill_fn = None
+                self._paged_decode_fn = None
+                self._paged_decode_multi_fn = None
+                self._paged_verify_fn = None
+            self._serving_shd = fresh
+            self._serving_shd_slots = num_slots
+        return self._serving_shd
+
+    def _serving_scope(self):
+        """Trace scope for the model-tracing serving primitives: the
+        mesh via ``dist.mesh_scope`` (module internals) plus the
+        engine's serving rule table via ``sharding.config_scope`` (the
+        in-graph KV-pool constraint must agree with the pinned
+        out_shardings even under a custom table)."""
+        import contextlib
+        stack = contextlib.ExitStack()
+        stack.enter_context(dist.mesh_scope(self.mesh))
+        stack.enter_context(config_scope(self.serving_sharding))
+        return stack
+
+    def serving_mesh_info(self, pools=None, num_slots=None):
+        """Mesh topology + serving-sharding snapshot for operators
+        (``bin/ds_serve`` startup log and ``health()``): per-axis mesh
+        sizes, the resolved logical->mesh axis map, and — given the live
+        pools — per-device KV-pool bytes (each device holds its kv-head
+        shard of every page).  Pass the scheduler's ``num_slots`` so the
+        snapshot reflects the slot-family resolution serving will
+        actually use (an uneven slot count degrades to replicated — the
+        report must say so, not echo the rule table)."""
+        info = {
+            "mesh_shape": {a: int(s) for a, s in self.mesh.shape.items()
+                           if int(s) > 1} or {"data": 1},
+            "mesh_devices": int(np.prod(list(self.mesh.shape.values()))),
+            "serving_axes":
+                self._serving_shardings(num_slots=num_slots).describe(),
+        }
+        if pools is not None:
+            info["kv_pool_bytes_per_device"] = pool_bytes_per_device(pools)
+            info["kv_pool_bytes_total"] = sum(
+                int(leaf.nbytes) for leaf in jax.tree.leaves(pools))
+        return info
 
     # ------------------------------------------------------------------ params
     def _param_shardings(self, params):
@@ -481,18 +589,21 @@ class InferenceEngine:
             f"got {type(self.module).__name__}")
 
     def init_paged_cache(self, num_pages, page_size):
-        """Device-resident per-layer K/V page pools. The page table,
-        lengths and active mask are host-owned (the scheduler passes
-        them per call as small traced inputs). Built INSIDE a jit so the
-        pools carry the same committed sharding as the pools the serving
-        primitives return — otherwise the first prefill/decode call
-        compiles a second signature just for the uncommitted zeros."""
+        """Device-resident per-layer K/V page pools, committed to the
+        serving pool sharding (kv_heads over ``model``, page ids
+        global). The page table, lengths and active mask are host-owned
+        (the scheduler passes them per call as small traced inputs).
+        Built INSIDE a jit so the pools carry the same committed
+        sharding as the pools the serving primitives return — otherwise
+        the first prefill/decode call compiles a second signature just
+        for the uncommitted zeros."""
         mod = self._paged_module()
         cfg, dt = self.module.cfg, self.kv_dtype
-        rep = NamedSharding(self.mesh, P())
+        pool_sh = self._serving_shardings().pool
         with dist.mesh_scope(self.mesh):
             return jax.jit(lambda: mod.init_paged_kv_cache(
-                cfg, num_pages, page_size, dtype=dt), out_shardings=rep)()
+                cfg, num_pages, page_size, dtype=dt),
+                out_shardings=pool_sh)()
 
     def _build_serving_fns(self):
         module = self.module
@@ -620,29 +731,36 @@ class InferenceEngine:
             return (out_toks, valid, tok_end, active_end, lengths_end,
                     emitted_end, accepted, {"layers": cache["layers"]})
 
-        # pools replicate over the mesh (pinned out_shardings so the
-        # donated round-trip keeps ONE jit signature: an inferred
-        # sharding that differed from init_paged_cache's would compile a
-        # second copy on the first feedback call)
-        rep = NamedSharding(self.mesh, P())
+        # every in/out array family gets its serving sharding
+        # (serving/sharding.py): pools shard kv_heads over `model`,
+        # slot carries / token blocks / the page table shard slots over
+        # `data`. out_shardings stay PINNED so the donated round-trip
+        # keeps ONE jit signature per bucket: an inferred sharding that
+        # differed from init_paged_cache's (or from the staged host
+        # inputs') would compile a second copy on the first feedback
+        # call — same invariant as the replicated PR-1 design, now per
+        # axis family
+        shd = self._serving_shardings()
+        slot, block, pool = shd.slot, shd.block, shd.pool
         self._paged_prefill_fn = jax.jit(prefill, donate_argnums=(6,),
-                                         out_shardings=(rep, rep))
+                                         out_shardings=(shd.logits, pool))
         self._paged_decode_fn = jax.jit(decode, donate_argnums=(5,),
                                         static_argnums=(7, 8, 9, 10),
-                                        out_shardings=(rep, rep))
+                                        out_shardings=(slot, pool))
         # one compiled signature per (horizon, sampling) combo — the
         # scheduler quantizes horizons to a small bucket set so the
         # compile count stays bounded across slot churn
         self._paged_decode_multi_fn = jax.jit(
             decode_multi, donate_argnums=(5,),
             static_argnums=(10, 11, 12, 13, 14),
-            out_shardings=tuple([rep] * 7))
+            out_shardings=(block, block, slot, slot, slot, slot, pool))
         # K is baked into the drafts shape, so the compile count is
         # bounded by the scheduler's spec-K bucket set (greedy-only: no
         # sampling statics)
         self._paged_verify_fn = jax.jit(
             verify_multi, donate_argnums=(7,),
-            out_shardings=tuple([rep] * 8))
+            out_shardings=(block, block, slot, slot, slot, slot, slot,
+                           pool))
 
     def copy_page(self, pools, src_page, dst_page):
         """Copy ONE KV page across every layer's pool (the prefix
@@ -652,7 +770,11 @@ class InferenceEngine:
         which pages get copied never adds a jit signature — ONE compile
         per serving config, like the other paged primitives."""
         if getattr(self, "_copy_page_fn", None) is None:
-            rep = NamedSharding(self.mesh, P())
+            # a page copy moves one index of the GLOBAL page dim; the
+            # kv-head shards copy in place on their own devices (no
+            # cross-device traffic), so the pool sharding is pinned
+            # through like every other primitive
+            pool_sh = self._serving_shardings().pool
 
             def copy(pools, src, dst):
                 return {"layers": [
@@ -661,7 +783,7 @@ class InferenceEngine:
                     for L in pools["layers"]]}
 
             self._copy_page_fn = jax.jit(copy, donate_argnums=(0,),
-                                         out_shardings=rep)
+                                         out_shardings=pool_sh)
         with dist.mesh_scope(self.mesh):
             return self._copy_page_fn(pools, jnp.int32(src_page),
                                       jnp.int32(dst_page))
@@ -686,14 +808,16 @@ class InferenceEngine:
         per-row start offsets are data (the lengths array), never
         shape."""
         assert self.params is not None, "set_params/init_params first"
+        shd = self._serving_shardings(num_slots=int(np.shape(lengths)[0]))
         if getattr(self, "_paged_prefill_fn", None) is None:
             self._build_serving_fns()
+        rep, slot_sh, blk = shd.replicated, shd.slot, shd.block
         ids_chunk, slot, n_valid, page_table, lengths = \
             self._stage_host_inputs([
-                (ids_chunk, np.int32), (slot, np.int32),
-                (n_valid, np.int32), (page_table, np.int32),
-                (lengths, np.int32)])
-        with dist.mesh_scope(self.mesh):
+                (ids_chunk, np.int32, rep), (slot, np.int32, rep),
+                (n_valid, np.int32, rep), (page_table, np.int32, blk),
+                (lengths, np.int32, slot_sh)])
+        with self._serving_scope():
             return self._paged_prefill_fn(
                 self.params, ids_chunk, slot, n_valid, page_table,
                 lengths, pools)
@@ -705,30 +829,36 @@ class InferenceEngine:
         the page table, and return (next tokens [slots] i32, new pools).
         Inactive slots pass through untouched (writes dropped)."""
         assert self.params is not None, "set_params/init_params first"
+        shd = self._serving_shardings(num_slots=int(np.shape(lengths)[0]))
         if getattr(self, "_paged_decode_fn", None) is None:
             self._build_serving_fns()
         self._rng, rng = jax.random.split(self._rng)
-        with dist.mesh_scope(self.mesh):
+        toks, active, page_table, lengths = self._stage_host_inputs([
+            (toks, np.int32, shd.slot), (active, bool, shd.slot),
+            (page_table, np.int32, shd.block),
+            (lengths, np.int32, shd.slot)])
+        with self._serving_scope():
             return self._paged_decode_fn(
-                self.params, jnp.asarray(toks, jnp.int32),
-                jnp.asarray(active, bool),
-                jnp.asarray(page_table, jnp.int32),
-                jnp.asarray(lengths, jnp.int32), pools, rng,
-                bool(do_sample), float(temperature), int(top_k),
+                self.params, toks, active, page_table, lengths, pools,
+                rng, bool(do_sample), float(temperature), int(top_k),
                 float(top_p))
 
-    def _stage_host_inputs(self, pairs):
-        """Move the per-dispatch host arrays to the shared replicated
-        sharding in ONE batched ``device_put`` (per-array puts cost
+    def _stage_host_inputs(self, triples):
+        """Move the per-dispatch host arrays to their committed serving
+        shardings in ONE batched ``device_put`` (per-array puts cost
         ~0.2 ms each of pure dispatch machinery on the CPU rig — at 7-9
         small arrays per decode/verify round that overhead was rivaling
-        the model compute itself).  Device-resident carries from a
-        previous dispatch pass through untouched: they are already
-        committed to this exact sharding by ``out_shardings``."""
-        rep = NamedSharding(self.mesh, P())
+        the model compute itself).  Each triple is ``(value, dtype,
+        sharding)``; slot-indexed arrays stage to the data-axis
+        sharding, the page table to the block sharding, scalars to
+        replicated.  Device-resident carries from a previous dispatch
+        pass through untouched: they are already committed to their
+        exact sharding by ``out_shardings``, so barrier and chained
+        dispatches share one compiled signature per bucket."""
         staged = [x if isinstance(x, jax.Array) and x.dtype == dt
-                  else np.asarray(x, dt) for x, dt in pairs]
-        return jax.device_put(tuple(staged), rep)
+                  else np.asarray(x, dt) for x, dt, _ in triples]
+        return jax.device_put(tuple(staged),
+                              tuple(sh for _, _, sh in triples))
 
     def decode_multi(self, toks, active, page_table, lengths, pools, *,
                      horizon, budgets, eos_ids, emitted=None,
@@ -746,20 +876,24 @@ class InferenceEngine:
         chain. ``toks``/``active``/``lengths`` accept host numpy or the
         previous call's device carries interchangeably."""
         assert self.params is not None, "set_params/init_params first"
+        # host inputs get the SAME committed shardings the *_end carries
+        # come back with (slot arrays over `data`, table over `data`),
+        # so barrier dispatches and chained dispatches share one
+        # compiled signature per horizon bucket
+        shd = self._serving_shardings(num_slots=int(np.shape(budgets)[0]))
         if getattr(self, "_paged_decode_multi_fn", None) is None:
             self._build_serving_fns()
         self._rng, rng = jax.random.split(self._rng)
         if emitted is None:
             emitted = np.zeros(np.shape(budgets), np.int32)
-        # host inputs get the SAME committed (replicated) sharding the
-        # *_end carries come back with, so barrier dispatches and chained
-        # dispatches share one compiled signature per horizon bucket
+        slot, blk = shd.slot, shd.block
         toks, active, page_table, lengths, emitted, budgets, eos_ids = \
             self._stage_host_inputs([
-                (toks, np.int32), (active, bool), (page_table, np.int32),
-                (lengths, np.int32), (emitted, np.int32),
-                (budgets, np.int32), (eos_ids, np.int32)])
-        with dist.mesh_scope(self.mesh):
+                (toks, np.int32, slot), (active, bool, slot),
+                (page_table, np.int32, blk), (lengths, np.int32, slot),
+                (emitted, np.int32, slot), (budgets, np.int32, slot),
+                (eos_ids, np.int32, slot)])
+        with self._serving_scope():
             return self._paged_decode_multi_fn(
                 self.params, toks, active, page_table, lengths,
                 pools, emitted, budgets, eos_ids, rng, int(horizon),
@@ -789,17 +923,20 @@ class InferenceEngine:
         the rollback with ``PagedKVManager.truncate_slot``.  One
         compiled signature per K (the scheduler's spec-K bucket set)."""
         assert self.params is not None, "set_params/init_params first"
+        shd = self._serving_shardings(num_slots=int(np.shape(budgets)[0]))
         if getattr(self, "_paged_verify_fn", None) is None:
             self._build_serving_fns()
         if emitted is None:
             emitted = np.zeros(np.shape(budgets), np.int32)
+        slot, blk = shd.slot, shd.block
         (toks, drafts, widths, active, page_table, lengths, emitted,
          budgets, eos_ids) = self._stage_host_inputs([
-             (toks, np.int32), (drafts, np.int32), (widths, np.int32),
-             (active, bool), (page_table, np.int32), (lengths, np.int32),
-             (emitted, np.int32), (budgets, np.int32),
-             (eos_ids, np.int32)])
-        with dist.mesh_scope(self.mesh):
+             (toks, np.int32, slot), (drafts, np.int32, blk),
+             (widths, np.int32, slot), (active, bool, slot),
+             (page_table, np.int32, blk), (lengths, np.int32, slot),
+             (emitted, np.int32, slot), (budgets, np.int32, slot),
+             (eos_ids, np.int32, slot)])
+        with self._serving_scope():
             return self._paged_verify_fn(
                 self.params, toks, drafts, widths, active, page_table,
                 lengths, pools, emitted, budgets, eos_ids)

@@ -226,6 +226,12 @@ class SelfAttention(nn.Module):
                     v[:, 0].astype(v_pages.dtype), mode="drop")
                 out = paged_decode_attention(q, k_pages, v_pages, pt, pos,
                                              bias=alibi)
+            # multi-chip serving: pin the pools' kv-head sharding on the
+            # updated arrays so GSPMD keeps the scatter/gather split over
+            # the `model` axis (no-op on a single-device mesh)
+            from deepspeed_tpu.serving.sharding import constrain_kv_pages
+            k_pages = constrain_kv_pages(k_pages)
+            v_pages = constrain_kv_pages(v_pages)
             new_cache = {"k_pages": k_pages, "v_pages": v_pages}
         elif cache is not None:
             # decode: append k/v at cache["index"], attend over the valid

@@ -161,6 +161,13 @@ class LlamaAttention(nn.Module):
                 v_pages = v_pages.at[page_ids, pos % ps].set(
                     v[:, 0].astype(v_pages.dtype), mode="drop")
                 out = paged_decode_attention(q, k_pages, v_pages, pt, pos)
+            # multi-chip serving: pin the pools' kv-head sharding on the
+            # updated arrays so GSPMD keeps the scatter/gather split
+            # over the `model` axis — GQA pools shard num_kv_heads, so
+            # the `model` size must divide it (engine-validated)
+            from deepspeed_tpu.serving.sharding import constrain_kv_pages
+            k_pages = constrain_kv_pages(k_pages)
+            v_pages = constrain_kv_pages(v_pages)
             new_cache = {"k_pages": k_pages, "v_pages": v_pages}
         elif cache is not None:
             # decode: append k/v at cache["index"], attend over valid prefix

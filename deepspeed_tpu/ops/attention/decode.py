@@ -130,6 +130,22 @@ def _repeat_kv(x, n_rep):
         .reshape(b, l, h * n_rep, d)
 
 
+def _multichip_mesh():
+    """True when the trace-time serving mesh spans more than one device
+    on the ``model``/``data`` axes.  GSPMD cannot partition a
+    ``pallas_call``, so the decode kernels must not see mesh-sharded
+    operands: the jnp fallback shards cleanly under GSPMD (slots over
+    `data`, kv heads over `model`) and is what multi-chip serving
+    routes through — a shard_mapped per-shard paged kernel is the
+    follow-up, not a silent wrong answer.  ``force_kernel`` still
+    overrides (single-device parity tests)."""
+    from deepspeed_tpu import comm as dist
+    mesh = dist.get_mesh()
+    if mesh is None:
+        return False
+    return any(int(mesh.shape.get(a, 1)) > 1 for a in ("model", "data"))
+
+
 def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                          m_scr, l_scr, acc_scr, *, scale, page_size, np_):
     """Paged variant of ``_decode_kernel``: one grid step is ALL heads of
@@ -284,7 +300,8 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
     use_kernel = (l == 1 and bias is None and pltpu is not None and
                   h % kv_h == 0 and
                   (force_kernel or (kv_h == h and page_size % 128 == 0 and
-                                    jax.default_backend() == "tpu")))
+                                    jax.default_backend() == "tpu" and
+                                    not _multichip_mesh())))
     if use_kernel:
         return _paged_decode_pallas(q, k_pages, v_pages,
                                     page_table.astype(jnp.int32), positions,
@@ -329,7 +346,7 @@ def decode_attention(q, k_cache, v_cache, *, bias, scale=None,
         interpret = jax.default_backend() != "tpu"
 
     if l == 1 and h % kv_h == 0 and max_len % (block_k or 128) == 0 and \
-            (force_kernel or not interpret):
+            (force_kernel or not (interpret or _multichip_mesh())):
         block_k = block_k or _pick_block(max_len)
         bias_full = jnp.broadcast_to(
             bias.astype(jnp.float32), (b, h, 1, max_len))

@@ -8,6 +8,10 @@ from deepspeed_tpu.serving.page_manager import (PagedKVManager,  # noqa: F401
                                                 PagePool,
                                                 PagePoolExhausted)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from deepspeed_tpu.serving.sharding import (SERVING_AXIS_RULES,  # noqa: F401
+                                            ServingShardingConfig,
+                                            ServingShardings,
+                                            pool_bytes_per_device)
 from deepspeed_tpu.serving.spec_decode import (Drafter,  # noqa: F401
                                                DraftModelDrafter,
                                                NgramDrafter)

@@ -53,9 +53,29 @@ class ServingMetrics:
         self.spec_slot_rounds = 0      # (slot, round) pairs that proposed
         self.spec_degraded = 0         # drafter/verify faults contained
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
+        self.mesh_info = {}            # serving topology (record_mesh)
         self._events = []
 
     # ---------------------------------------------------------- recording
+    def record_mesh(self, mesh_info):
+        """One-shot serving-topology gauges at scheduler construction:
+        per-axis mesh sizes and the per-device KV-pool footprint (each
+        device holds its kv-head shard of every page).  Scalar-only
+        sinks get one gauge per mesh axis; the full map rides
+        ``health()``."""
+        self.mesh_info = mesh_info
+        if self.monitor is not None:
+            # stamped step 1 (the first live step), keeping the
+            # monitor-stream invariant that serving events carry a
+            # step >= 1 even for construction-time gauges
+            events = [(f"serving/mesh/{ax}", size, 1)
+                      for ax, size in
+                      (mesh_info.get("mesh_shape") or {}).items()]
+            if mesh_info.get("kv_pool_bytes_per_device") is not None:
+                events.append(("serving/mesh/kv_pool_bytes_per_device",
+                               mesh_info["kv_pool_bytes_per_device"], 1))
+            self.monitor.write_events(events)
+
     def record_step(self, step, *, queue_depth, running, waiting,
                     page_utilization, device_wait_s=0.0, host_s=0.0,
                     cached_pages=None):

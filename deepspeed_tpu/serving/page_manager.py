@@ -13,6 +13,15 @@ lengths as small int32 inputs into the fixed-shape jitted primitives
 (``InferenceEngine.prefill_into_slots`` / ``decode_multi``), so request
 churn never changes a jit signature (fused decode compiles once per
 horizon bucket, never per churn).
+
+MESH-AGNOSTIC BY CONTRACT (sharded multi-chip serving,
+``serving/sharding.py``): a page id names the same page on every
+device — the pools shard their kv-head dim over the ``model`` mesh
+axis, so each device holds its *shard of every page*, and the page dim
+itself is never partitioned.  Nothing in this module may ever consult
+the mesh; allocation, refcounts, growth, release, rollback
+(``truncate_slot``) and donation (``take_slot_pages``) behave
+identically at every topology.
 """
 
 import numpy as np

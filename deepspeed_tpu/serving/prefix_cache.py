@@ -1,5 +1,13 @@
 """Radix prefix cache: refcounted, copy-on-write KV page sharing.
 
+Mesh-agnostic by contract: the trie stores GLOBAL page ids and token
+keys, never device placement — on a sharded serving mesh
+(``serving/sharding.py``) a cached page's KV lives as one kv-head
+shard per device, a shared page is shared on every device at once, and
+the COW copy (``InferenceEngine.copy_page``) moves one index of the
+global page dim with each shard copying in place.  No code here may
+consult the mesh.
+
 SGLang's RadixAttention (Zheng et al., 2024) on top of the paged KV
 pool: a page-granular radix/trie index maps token-ID sequences to
 chains of *full, immutable* KV pages left behind by finished requests.

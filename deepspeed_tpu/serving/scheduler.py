@@ -203,6 +203,14 @@ class ServingScheduler:
         self.prefix_cache = None if not prefix_cache else PrefixCache(
             self.kv.pool, max_pages=prefix_cache_pages)
         self.pools = engine.init_paged_cache(num_pages, page_size)
+        # mesh topology snapshot: the pools (and weights) are live on
+        # the engine's device mesh now — record the shape and per-device
+        # KV footprint once so health()/monitor sinks expose the actual
+        # serving topology (page bookkeeping below stays mesh-agnostic:
+        # page ids are global, only the KV arrays shard)
+        self.mesh_info = engine.serving_mesh_info(
+            self.pools, num_slots=num_slots) \
+            if hasattr(engine, "serving_mesh_info") else {}
         self.lengths = np.zeros(num_slots, np.int32)
         self.last_tok = np.zeros(num_slots, np.int32)
         self.slot_req = [None] * num_slots
@@ -213,6 +221,8 @@ class ServingScheduler:
         self.completed = deque(maxlen=int(completed_history))
         self._collect = None         # active run()'s result accumulator
         self.metrics = ServingMetrics(monitor)
+        if self.mesh_info:
+            self.metrics.record_mesh(self.mesh_info)
         self.step_idx = 0
         self._ema_step_s = None      # EWMA of step wall time (health)
         # admission feasibility uses the MEDIAN of a recent window, not
@@ -1313,6 +1323,13 @@ class ServingScheduler:
         pc = self.prefix_cache
         return {
             "step": self.step_idx,
+            "mesh": self.mesh_info.get("mesh_shape"),
+            "mesh_devices": self.mesh_info.get("mesh_devices"),
+            "serving_axes": self.mesh_info.get("serving_axes"),
+            "kv_pool_bytes_per_device":
+                self.mesh_info.get("kv_pool_bytes_per_device"),
+            "kv_pool_bytes_total":
+                self.mesh_info.get("kv_pool_bytes_total"),
             "prefix_cache": pc is not None,
             "prefix_hit_rate": None if pc is None
             else round(pc.hit_rate(), 4),

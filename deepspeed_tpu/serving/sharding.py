@@ -1,0 +1,239 @@
+"""Serving sharding layer: logical serving axes -> mesh axes.
+
+The serving stack names its array dimensions with *logical* axes — the
+same t5x-style indirection the training params use
+(parallel/sharding.py) — and maps them onto the device mesh through one
+rule table, so running the paged KV cache and every serving primitive
+over a multi-chip topology is a config change, not a rewrite:
+
+  ===========  ================  =============================================
+  logical      default mesh ax   carried by
+  ===========  ================  =============================================
+  kv_heads     model             KV page pools [pages, page_size, KV_H, dim]
+  slots        data              per-slot carries (tok/active/lengths/
+                                 emitted/budgets/eos), token blocks
+                                 [SLOTS, H|K+1], the page table [SLOTS, maxp]
+  pages        (replicated)      the page dim of the pools — page ids are
+                                 GLOBAL: the host-side free list / page
+                                 table / radix cache never know the mesh
+  vocab        model             boundary logits a prefill chunk returns
+  ===========  ================  =============================================
+
+Weights already shard over ``model`` through the engine's
+``_param_shardings``; this module covers the serving-only state.  The
+page dim stays replicated by design: every device holds the full page
+*index space* (its slice of every page along kv_heads), so
+``PagedKVManager`` / ``PrefixCache`` bookkeeping — allocation,
+refcounts, donation, COW, eviction — is mesh-agnostic host logic and a
+page id means the same thing on every chip.
+
+A multi-slice ICI x DCN topology later is the same config: build the
+mesh with ``mesh_utils.create_hybrid_device_mesh`` (ICI parallelism
+within a slice, DCN across slices — SNIPPETS [2]/[3]), keep ``model``
+on the ICI-innermost axis, map ``slots`` to the DCN-spanning data axis,
+and these rules need not change.
+"""
+
+import dataclasses
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Default logical serving axis -> mesh axis. None = replicated.
+SERVING_AXIS_RULES = (
+    ("kv_heads", "model"),
+    ("slots", "data"),
+    ("pages", None),
+    ("vocab", "model"),
+)
+
+
+def _mesh_axis_size(mesh, axis):
+    return int(mesh.shape[axis]) if axis is not None and axis in mesh.shape \
+        else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingShardingConfig:
+    """Logical-axis rules for the serving stack (immutable; the engine
+    resolves it against a concrete mesh + model once, at serving
+    setup)."""
+    rules: tuple = SERVING_AXIS_RULES
+
+    def axis(self, logical):
+        return dict(self.rules).get(logical)
+
+    def validate(self, mesh, num_kv_heads):
+        """Mesh-shape validation for sharded serving: the axis carrying
+        ``kv_heads`` must divide the model's KV head count — anything
+        else would shard mid-head, the exact regime the legacy SPMD
+        partitioner silently miscompiles (~1e-2 drift, PR-2 triage).
+        Raises a ValueError naming the axis and head count instead."""
+        ax = self.axis("kv_heads")
+        size = _mesh_axis_size(mesh, ax)
+        if size > 1 and num_kv_heads % size != 0:
+            raise ValueError(
+                f"mesh axis '{ax}' has size {size}, which does not divide "
+                f"num_kv_heads={num_kv_heads}: the paged KV pools shard "
+                f"their head dim over '{ax}', and an indivisible head "
+                "count would shard mid-head (silent numeric drift on "
+                f"legacy SPMD partitioners). Pick a mesh whose '{ax}' "
+                f"size divides {num_kv_heads}, or a model whose KV head "
+                f"count is a multiple of {size}.")
+
+    def validate_heads(self, mesh, num_heads):
+        """Construction-time attention-TP validation (the engine calls
+        this for every model with a head-count contract, serving or
+        not): the configured head axis must divide ``num_heads`` —
+        intra-head tensor parallelism silently drifts ~1e-2 on legacy
+        SPMD partitioners and has no serving sharding.  Fail loudly,
+        naming the axis and count."""
+        ax = self.axis("kv_heads")
+        size = _mesh_axis_size(mesh, ax)
+        if size > 1 and num_heads % size != 0:
+            raise ValueError(
+                f"mesh axis '{ax}' has size {size}, which does not "
+                f"divide num_heads={num_heads}: intra-head tensor "
+                "parallelism silently drifts on legacy SPMD partitioners"
+                f" and has no serving sharding. Pick a '{ax}' size that "
+                f"divides {num_heads} (tensor_parallel.tp_size / mesh"
+                "={'%s': ...})." % ax)
+
+    def resolve(self, mesh, *, num_kv_heads, vocab_size=None,
+                num_slots=None):
+        """Concrete :class:`ServingShardings` for one mesh + model.
+        Validates kv-head divisibility (hard error — see
+        :meth:`validate`); the vocab and slot axes degrade to
+        replicated when they do not divide (tiny fixture vocabularies;
+        a slot count smaller than / uneven over the data axis — jax
+        requires dim % shards == 0, and a toy server on a big mesh
+        should run replicated, not crash)."""
+        self.validate(mesh, num_kv_heads)
+        kv_ax = self.axis("kv_heads")
+        if _mesh_axis_size(mesh, kv_ax) == 1:
+            kv_ax = None
+        slot_ax = self.axis("slots")
+        if _mesh_axis_size(mesh, slot_ax) == 1 or (
+                num_slots is not None and
+                num_slots % _mesh_axis_size(mesh, slot_ax) != 0):
+            slot_ax = None
+        page_ax = self.axis("pages")
+        if _mesh_axis_size(mesh, page_ax) == 1:
+            page_ax = None
+        vocab_ax = self.axis("vocab")
+        if _mesh_axis_size(mesh, vocab_ax) == 1 or (
+                vocab_size is not None and
+                vocab_size % _mesh_axis_size(mesh, vocab_ax) != 0):
+            vocab_ax = None
+        return ServingShardings(mesh=mesh, config=self, kv_axis=kv_ax,
+                                slot_axis=slot_ax, page_axis=page_ax,
+                                vocab_axis=vocab_ax)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingShardings:
+    """Resolved NamedShardings for every serving array family.
+
+    ``slot`` covers the [num_slots] device carries, ``block`` the
+    [num_slots, H|K+1] token/valid blocks AND the [num_slots,
+    max_pages] page table (both shard dim 0 over the slots axis),
+    ``pool`` the per-layer [num_pages, page_size, kv_heads, head_dim]
+    KV pools, ``logits`` a prefill chunk's [vocab] boundary row."""
+    mesh: object
+    config: ServingShardingConfig
+    kv_axis: object
+    slot_axis: object
+    page_axis: object
+    vocab_axis: object
+
+    @property
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def pool(self):
+        return NamedSharding(
+            self.mesh, P(self.page_axis, None, self.kv_axis, None))
+
+    @property
+    def slot(self):
+        return NamedSharding(self.mesh, P(self.slot_axis))
+
+    @property
+    def block(self):
+        return NamedSharding(self.mesh, P(self.slot_axis, None))
+
+    @property
+    def logits(self):
+        return NamedSharding(self.mesh, P(self.vocab_axis))
+
+    def describe(self):
+        """Logical-axis -> resolved mesh axis map (health()/logs)."""
+        return {"kv_heads": self.kv_axis, "slots": self.slot_axis,
+                "pages": self.page_axis, "vocab": self.vocab_axis}
+
+
+def pool_bytes_per_device(pools):
+    """Per-device bytes of a (possibly sharded) KV pool pytree — each
+    device holds its shard of every page, so this is total bytes
+    divided by the kv-head sharding factor."""
+    total = 0
+    for leaf in jax.tree.leaves(pools):
+        shard = leaf.sharding.shard_shape(leaf.shape) \
+            if hasattr(leaf, "sharding") else leaf.shape
+        total += int(np.prod(shard)) * leaf.dtype.itemsize
+    return total
+
+
+_ACTIVE_CONFIG = None
+
+
+class config_scope:
+    """Trace-time channel from the engine to the in-graph KV-pool
+    constraint: the engine wraps every serving trace in
+    ``config_scope(engine.serving_sharding)`` (alongside
+    ``dist.mesh_scope``) so :func:`constrain_kv_pages` constrains with
+    the engine's CONFIGURED rule table — a custom table must constrain
+    consistently with the pinned out_shardings, or GSPMD would insert a
+    full-pool reshard inside every dispatch."""
+
+    def __init__(self, config):
+        self.config = config
+        self._saved = None
+
+    def __enter__(self):
+        global _ACTIVE_CONFIG
+        self._saved = _ACTIVE_CONFIG
+        _ACTIVE_CONFIG = self.config
+        return self.config
+
+    def __exit__(self, *exc):
+        global _ACTIVE_CONFIG
+        _ACTIVE_CONFIG = self._saved
+        return False
+
+
+def constrain_kv_pages(pages):
+    """Pin the serving KV pool's mesh sharding on a traced pool array
+    ([num_pages, page_size, kv_heads, head_dim]) inside the paged
+    attention code.  Reads the engine-installed mesh and rule table at
+    TRACE time (``dist.mesh_scope`` + :class:`config_scope` wrap every
+    serving trace), so GSPMD never has to guess whether the pool
+    scatter/gather should keep the kv-head split; a no-op without a
+    mesh, with a trivial model axis, or with an indivisible head count
+    (the engine validates the real serving path long before this
+    point)."""
+    from deepspeed_tpu import comm as dist
+    mesh = dist.get_mesh()
+    cfg = _ACTIVE_CONFIG
+    rules = dict(cfg.rules if cfg is not None else SERVING_AXIS_RULES)
+    ax = rules.get("kv_heads")
+    if mesh is None or ax is None or ax not in mesh.shape:
+        return pages
+    size = int(mesh.shape[ax])
+    if size <= 1 or pages.shape[2] % size != 0:
+        return pages
+    return jax.lax.with_sharding_constraint(
+        pages, NamedSharding(mesh, P(rules.get("pages"), None, ax, None)))

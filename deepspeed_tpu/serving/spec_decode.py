@@ -37,6 +37,15 @@ tokens than asked (or none — the slot then rides the verify dispatch as
 a plain one-token decode), and any exception it raises is contained by
 the scheduler (that request degrades to normal decode; the loop never
 dies — see ``serve.spec_verify`` in ``resilience/faults.py``).
+
+Mesh composition (``serving/sharding.py``): drafting is host-side
+token lists and verification is one sharded ``verify_multi`` dispatch,
+so spec decode runs unchanged on a multi-chip serving mesh (proven
+token-exact on-mesh in ``tests/unit/test_serving_mesh.py``).  A
+:class:`DraftModelDrafter`'s engine carries its own mesh — typically
+1-device (a tiny draft has nothing to shard), but a meshed draft
+engine composes the same way since the two engines only exchange host
+token lists.
 """
 
 import numpy as np

@@ -23,13 +23,12 @@ mp_collectives = pytest.mark.skipif(
     jax_compat.LEGACY_SHARD_MAP,
     reason="CPU multiprocess collectives need jax>=0.5")
 
+# Historical note: `legacy_spmd_oversubscribed_tp` used to live here —
 # jax<0.5's CPU SPMD partitioner miscompiles OVERSUBSCRIBED tensor
-# parallelism (tp > num_heads, so the head axis shards mid-head): tp=8
-# over a 4-head model drifts ~1e-2 from single-device while tp=2/4 stay
-# bitwise-clean on the same runtime (seed-era failure, triaged PR 2).
-# Gate only the oversubscribed case on modern jax.
-legacy_spmd_oversubscribed_tp = pytest.mark.skipif(
-    jax_compat.LEGACY_SHARD_MAP,
-    reason="jax<0.5 CPU SPMD partitioner miscompiles intra-head "
-           "(tp > num_heads) sharding; tp<=num_heads covers TP "
-           "equivalence on this runtime")
+# parallelism (tp > num_heads shards the head axis mid-head: tp=8 over
+# a 4-head model drifted ~1e-2 while tp=2/4 stayed bitwise-clean;
+# seed-era failure, triaged PR 2).  The mesh-validation work made that
+# configuration unconstructible on EVERY runtime (InferenceEngine
+# raises a ValueError naming the axis and head count), so the env-bound
+# skip became a deterministic error-path test:
+# tests/unit/test_inference.py::test_oversubscribed_tp_rejected_at_construction

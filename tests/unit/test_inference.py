@@ -7,8 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tests.unit.compat_markers import (legacy_spmd_oversubscribed_tp,
-                                       needs_pinned_host)
+from tests.unit.compat_markers import needs_pinned_host
 
 import deepspeed_tpu
 
@@ -77,16 +76,15 @@ def test_sampling_reproducible_and_topk(tiny_llama):
     assert (out[:, 4:] < model.cfg.vocab_size).all()
 
 
-@pytest.mark.parametrize("tp", [
-    4,
-    pytest.param(8, marks=legacy_spmd_oversubscribed_tp),
-])
+@pytest.mark.parametrize("tp", [4])
 def test_tensor_parallel_serving(tiny_llama, tp):
     """TP-sharded weights over the model axis, output identical to
     single-device (auto-TP equivalence, reference AutoTP). tp=4 equals
     num_heads (clean per-head sharding, exact on every runtime); tp=8
-    oversubscribes the 4-head axis — intra-head sharding the legacy
-    jax<0.5 CPU partitioner miscompiles, hence the env-bound skip."""
+    would oversubscribe the 4-head axis — formerly an env-bound skip
+    (the legacy jax<0.5 CPU partitioner silently miscompiles intra-head
+    sharding), now a construction-time ValueError on EVERY runtime
+    (test_oversubscribed_tp_rejected_at_construction below)."""
     model, params = tiny_llama
     e1 = deepspeed_tpu.init_inference(model=model, dtype="float32",
                                       params=params,
@@ -103,6 +101,22 @@ def test_tensor_parallel_serving(tiny_llama, tp):
     specs = jax.tree.leaves(jax.tree.map(
         lambda x: str(x.sharding.spec), etp.params))
     assert any("model" in s for s in specs), specs
+
+
+def test_oversubscribed_tp_rejected_at_construction(tiny_llama):
+    """tp=8 over a 4-head model shards attention MID-head — a shape
+    the legacy jax<0.5 CPU SPMD partitioner silently miscompiles into
+    ~1e-2 output drift (the seed-era red test, triaged PR 2 behind the
+    `legacy_spmd_oversubscribed_tp` skip).  Since the mesh-validation
+    work it is a loud construction-time ValueError naming the axis and
+    head count, on every runtime — deterministic coverage where the
+    skip used to hide an env-bound silent failure."""
+    model, params = tiny_llama
+    with pytest.raises(ValueError, match=r"model.*8.*num_heads=4"):
+        deepspeed_tpu.init_inference(model=model, dtype="float32",
+                                     params=params,
+                                     tensor_parallel={"tp_size": 8},
+                                     mesh={"data": 1, "model": 8})
 
 
 def test_inference_from_training_checkpoint(tmp_path, tiny_llama):

@@ -111,8 +111,8 @@ def make_spec_workload(vocab, n_requests, rate, seed, motif_len=8,
 
 def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
                    overlap=True, prefix_cache=False, spec_decode=None,
-                   spec_k=8):
-    from deepspeed_tpu.serving import ServingScheduler
+                   spec_k=8, retry_max=6, retry_backoff_s=0.05):
+    from deepspeed_tpu.serving import QueueFull, ServingScheduler
     sched = ServingScheduler(
         engine, num_slots=cfg["num_slots"], num_pages=cfg["num_pages"],
         page_size=cfg["page_size"],
@@ -123,22 +123,55 @@ def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
     t0 = time.time()
     pending = list(zip(prompts, max_new, arrivals))
     submitted = []
+    # bounded retry with jitter on QueueFull: a burst that trips
+    # backpressure re-offers each refused request after an exponential
+    # backoff (jittered so the retry burst cannot re-synchronize)
+    # instead of erroring out of the bench.  Retries are REPORTED, not
+    # folded into latency: t_submit starts at the accepted submission,
+    # so TTFT prices serving time, and the refusal cost shows up in the
+    # dedicated counters below.
+    retry_rng = np.random.default_rng(0xC1)
+    retry_q = []                 # (due_time, prompt, max_new, attempt)
+    retries = retry_dropped = 0
+
+    def offer(p, m, attempt):
+        nonlocal retries, retry_dropped
+        try:
+            submitted.append(sched.submit(p, max_new_tokens=m))
+        except QueueFull:
+            retries += 1
+            if attempt >= retry_max:
+                retry_dropped += 1
+                return
+            delay = retry_backoff_s * (2 ** attempt) * \
+                (1.0 + retry_rng.random())
+            retry_q.append((time.time() - t0 + delay, p, m, attempt + 1))
+            retry_q.sort(key=lambda x: x[0])
+
     while True:
         now = time.time() - t0
+        while retry_q and retry_q[0][0] <= now:
+            _, p, m, attempt = retry_q.pop(0)
+            offer(p, m, attempt)
         while pending and pending[0][2] <= now:
             p, m, _ = pending.pop(0)
-            submitted.append(sched.submit(p, max_new_tokens=m))
+            offer(p, m, 0)
         work = sched.step()
         if not work:
-            if not pending:
+            if not pending and not retry_q:
                 break
-            # idle until the next arrival
-            time.sleep(max(pending[0][2] - (time.time() - t0), 0.0))
+            # idle until the next arrival or retry
+            gates = [g for g in
+                     ([pending[0][2]] if pending else []) +
+                     ([retry_q[0][0]] if retry_q else [])]
+            time.sleep(max(min(gates) - (time.time() - t0), 0.0))
     wall = time.time() - t0
     toks = sum(len(r.out_tokens) for r in submitted)
     out = sched.metrics.summary(wall)
     out.update({"wall_s": round(wall, 3), "tokens": toks,
-                "tokens_per_sec": round(toks / wall, 2)})
+                "tokens_per_sec": round(toks / wall, 2),
+                "queue_full_retries": retries,
+                "retry_dropped": retry_dropped})
     if prefix_cache:
         h = sched.health()
         out.update({k: h[k] for k in
@@ -423,6 +456,164 @@ def run_spec_decode(engine, vocab, cfg, args, horizon, overlap):
     return section
 
 
+def make_family_workload(vocab, n_requests, rate, seed, n_families,
+                         shared_len, tail_len):
+    """The cluster-routing workload: ``n_families`` distinct shared
+    system prompts, each request = one family's prefix + a distinct
+    tail, families interleaved round-robin across arrivals.  With more
+    families than replicas, prefix-aware routing pins each family to
+    one replica's radix cache (every later member hits), while
+    round-robin sprays members across the fleet and pays a cold miss
+    per (family, replica) pair — exactly the spread the aggregate hit
+    rate measures."""
+    rng = np.random.default_rng(seed)
+    heads = [rng.integers(0, vocab, shared_len).astype("i4")
+             for _ in range(n_families)]
+    prompts = []
+    for i in range(n_requests):
+        tail = rng.integers(0, vocab, tail_len).astype("i4")
+        prompts.append(np.concatenate([heads[i % n_families], tail]))
+    max_new = [int(rng.integers(4, 16)) for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    return prompts, max_new, arrivals
+
+
+_CLUSTER_KEYS = ("tokens_per_sec", "wall_s", "tokens",
+                 "aggregate_prefix_hit_rate", "aggregate_tokens_reused",
+                 "finished", "failed", "shed", "replays", "failovers",
+                 "retries", "restarts", "drains")
+
+
+def run_cluster_once(engine, prompts, max_new, arrivals, cfg, args,
+                     horizon, overlap, routing, rolling_restart=False,
+                     kill_replica=None, kill_step=6):
+    from deepspeed_tpu.resilience import faults
+    from deepspeed_tpu.serving import ClusterRouter, make_local_fleet
+
+    replicas = make_local_fleet(
+        engine, args.cluster, num_slots=cfg["num_slots"],
+        num_pages=cfg["num_pages"], page_size=cfg["page_size"],
+        max_pages_per_slot=cfg["max_pages_per_slot"],
+        prefill_chunk=cfg["prefill_chunk"], decode_horizon_steps=horizon,
+        overlap=overlap, prefix_cache=True)
+    router = ClusterRouter(replicas, routing=routing)
+    inj = None
+    if kill_replica is not None:
+        inj = faults.FaultInjector(seed=args.seed)
+        inj.on("cluster.replica_kill", match={"replica": kill_replica},
+               step=kill_step, exc=RuntimeError("bench chaos: kill"))
+        faults.install(inj)
+    t0 = time.time()
+    pending = list(zip(prompts, max_new, arrivals))
+    entries = []
+    restarted = False
+    while True:
+        now = time.time() - t0
+        while pending and pending[0][2] <= now:
+            p, m, _ = pending.pop(0)
+            entries.append(router.submit(p, max_new_tokens=m))
+        if rolling_restart and not restarted and not pending and \
+                len(entries) >= len(prompts):
+            # every request is journaled; now restart the whole fleet
+            # one replica at a time while the rest keep serving
+            router.rolling_restart()
+            restarted = True
+        work = router.step()
+        if not work:
+            if not pending:
+                break
+            time.sleep(max(pending[0][2] - (time.time() - t0), 0.0))
+    if inj is not None:
+        faults.uninstall()
+    wall = time.time() - t0
+    toks = sum(len(e.emitted) for e in entries)
+    h = router.health()
+    out = {k: h[k] for k in
+           ("aggregate_prefix_hit_rate", "aggregate_tokens_reused",
+            "finished", "failed", "shed", "replays", "failovers",
+            "retries", "restarts", "drains")}
+    out.update({"wall_s": round(wall, 3), "tokens": toks,
+                "tokens_per_sec": round(toks / wall, 2),
+                "lost": sum(1 for e in entries
+                            if e.state not in ("finished",))})
+    return out, router
+
+
+def run_cluster(engine, vocab, cfg, args, horizon, overlap):
+    """Prefix-aware vs round-robin routing over a replica fleet on the
+    family-sharded shared-prefix workload, plus a rolling-restart pass
+    (drain + restart every replica in sequence) that must finish with
+    zero failed requests."""
+    section = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "overlap": overlap, "horizon": horizon,
+        "replicas": args.cluster, "families": args.cluster_families,
+        "shared_prefix_len": args.shared_prefix_len,
+        "tail_len": args.tail_len,
+    }
+    prompts, max_new, arrivals = make_family_workload(
+        vocab, args.requests, args.rate, args.seed, args.cluster_families,
+        args.shared_prefix_len, args.tail_len)
+    for label, routing in (("round_robin", "round_robin"),
+                           ("prefix", "prefix")):
+        run_cluster_once(engine, prompts, max_new, arrivals, cfg, args,
+                         horizon, overlap, routing)   # untimed warmup
+        r = None
+        for _ in range(max(1, args.repeats)):
+            cand, _ = run_cluster_once(engine, prompts, max_new, arrivals,
+                                       cfg, args, horizon, overlap,
+                                       routing)
+            if r is None or cand["tokens_per_sec"] > r["tokens_per_sec"]:
+                r = cand
+        section[label] = {k: r[k] for k in _CLUSTER_KEYS if k in r}
+    rr, _ = run_cluster_once(engine, prompts, max_new, arrivals, cfg,
+                             args, horizon, overlap, "prefix",
+                             rolling_restart=True)
+    section["rolling_restart"] = {k: rr[k] for k in _CLUSTER_KEYS
+                                  if k in rr}
+    # failover pass: kill replica0 mid-run under the fault harness —
+    # the gating CI job asserts zero lost requests and uploads the
+    # journal + fleet health as artifacts
+    fo, router = run_cluster_once(engine, prompts, max_new, arrivals,
+                                  cfg, args, horizon, overlap, "prefix",
+                                  kill_replica="replica0")
+    section["failover"] = {k: fo[k] for k in
+                           tuple(_CLUSTER_KEYS) + ("lost",) if k in fo}
+    if args.cluster_artifacts:
+        os.makedirs(args.cluster_artifacts, exist_ok=True)
+        router.journal.dump(os.path.join(args.cluster_artifacts,
+                                         "journal.json"))
+        with open(os.path.join(args.cluster_artifacts,
+                               "cluster_health.json"), "w") as f:
+            json.dump(router.health(), f, indent=2)
+            f.write("\n")
+    if fo["lost"] or fo["failed"]:
+        print(f"FAILOVER CHECK FAILED: lost={fo['lost']} "
+              f"failed={fo['failed']}", file=sys.stderr)
+        raise SystemExit(1)
+    if fo["failovers"] != 1:
+        print("FAILOVER CHECK: the kill never landed (workload too "
+              "short for the armed step?)", file=sys.stderr)
+        raise SystemExit(1)
+    section["hit_rate_gain"] = round(
+        section["prefix"]["aggregate_prefix_hit_rate"] -
+        section["round_robin"]["aggregate_prefix_hit_rate"], 4)
+    print(json.dumps({
+        "metric": "cluster_prefix_vs_round_robin_hit_rate",
+        "value": section["hit_rate_gain"], "unit": "delta",
+        "extra": {"prefix": section["prefix"],
+                  "round_robin": section["round_robin"],
+                  "rolling_restart": section["rolling_restart"]},
+    }))
+    if args.json_out:
+        _write_json_out(
+            args.json_out, "cluster", section,
+            {"model": args.model, "requests": args.requests,
+             "rate": args.rate, "serving_config": cfg,
+             "overlap": overlap, "cluster": section})
+    return section
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2-tiny",
@@ -478,6 +669,18 @@ def main():
                         "included). On CPU, force virtual devices with "
                         "XLA_FLAGS=--xla_force_host_platform_device_"
                         "count=8 first")
+    p.add_argument("--cluster", type=int, default=0,
+                   help="run the cluster-routing workload instead: a "
+                        "prefix-aware router over this many in-process "
+                        "engine replicas, prefix vs round-robin routing "
+                        "on the family-sharded shared-prefix workload, "
+                        "plus a rolling-restart pass that must finish "
+                        "with zero failed requests")
+    p.add_argument("--cluster-families", type=int, default=6,
+                   help="distinct shared-prefix families for --cluster")
+    p.add_argument("--cluster-artifacts", default=None,
+                   help="directory for the --cluster failover pass's "
+                        "journal + fleet-health dumps (CI uploads them)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json-out", default=None)
     args = p.parse_args()
@@ -507,6 +710,10 @@ def main():
 
     prompts, max_new, arrivals = make_workload(
         vocab, args.requests, args.rate, args.seed)
+
+    if args.cluster:
+        run_cluster(engine, vocab, cfg, args, max(horizons), overlap)
+        return
 
     if args.prefix_share:
         run_prefix_share(engine, vocab, cfg, args, max(horizons), overlap)

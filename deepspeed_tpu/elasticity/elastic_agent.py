@@ -119,29 +119,56 @@ class DSElasticAgent:
                 p.kill()
                 p.wait()
 
+    @staticmethod
+    def _classify(states, epoch_advanced):
+        """Deterministic monitor classification — a pure function of
+        the observed process states plus the epoch flag, so no
+        interleaving of a worker exit with the epoch watch can flip the
+        answer.
+
+        Priority:
+
+        1. all exited 0 -> ``ok`` (never touch the store after a clean
+           local finish — the node-0 agent may already be tearing it
+           down during a skewed shutdown);
+        2. any nonzero exit -> ``failed``: the local rc is ground
+           truth.  This includes deaths *caused by* a peer restart
+           (coordinator vanished): the old ordering preferred
+           ``peer_restart`` whenever the epoch had advanced, which
+           misclassified a genuine local failure as a peer event when
+           a peer's bump landed between the state poll and the epoch
+           read — losing the rc and the failure log line.  Reporting
+           ``failed`` is always safe: ``signal_restart(from_epoch)``
+           is a compare-and-swap, so a bump for a round a peer
+           already advanced is a no-op and the budget burns exactly
+           one round either way;
+        3. epoch advanced with locals still running (or exiting 0
+           under teardown skew) -> ``peer_restart``;
+        4. otherwise -> keep polling.
+
+        Returns ("ok"|"failed"|"peer_restart"|None, rc)."""
+        if all(rc == 0 for rc in states):
+            return "ok", 0
+        bad = [rc for rc in states if rc is not None and rc != 0]
+        if bad:
+            return "failed", bad[0]
+        if epoch_advanced:
+            return "peer_restart", 0
+        return None, 0
+
     def _monitor(self, watch_epoch=None):
         """Block until the group finishes, a worker dies, or (multi-node)
         the rendezvous epoch advances because ANOTHER node's worker
-        died. Returns ("ok", 0) | ("failed", rc) | ("peer_restart", 0)."""
+        died. Returns ("ok", 0) | ("failed", rc) | ("peer_restart", 0).
+        Classification is delegated to :meth:`_classify` — see its
+        docstring for the determinism contract."""
         while True:
             states = [p.poll() for p in self._procs]
-            # clean exit first: never touch the store once the local
-            # group finished (the node-0 agent may already be tearing
-            # the store down during a skewed shutdown)
-            if all(rc == 0 for rc in states):
-                return "ok", 0
-            # then the epoch: when a peer restarts the group our local
-            # workers also die (their coordinator vanished) — prefer
-            # classifying that as peer_restart. The residual race
-            # (local death observed before the peer's signal lands) is
-            # closed by signal_restart's compare-and-swap: a stale bump
-            # for an already-advanced round is a no-op.
-            if self._rdzv is not None and \
-                    self._rdzv.current_epoch() != watch_epoch:
-                return "peer_restart", 0
-            if any(rc is not None and rc != 0 for rc in states):
-                bad = next(rc for rc in states if rc is not None and rc != 0)
-                return "failed", bad
+            advanced = self._rdzv is not None and \
+                self._rdzv.current_epoch() != watch_epoch
+            state, rc = self._classify(states, advanced)
+            if state is not None:
+                return state, rc
             time.sleep(self.monitor_interval)
 
     # --------------------------------------------------------------- run

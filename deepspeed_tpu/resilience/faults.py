@@ -67,6 +67,24 @@ point                 site                                     ctx keys
                       token-exact and the loop survives;
                       contained degrades count in
                       ``health()['spec_degraded']``
+``cluster.replica_   entry of a cluster replica's step          ``step``,
+kill``                (``serving/cluster/replica.py``, both     ``replica``
+                      backings) — a raised exception IS a
+                      replica crash: the scheduler (or, for
+                      a :class:`ProcessReplica`, the worker
+                      process via SIGKILL) is dropped with
+                      every in-flight request, and the
+                      router must complete them all on
+                      survivors via journal replay
+                      (``step`` here is the ROUTER pump
+                      index, not a scheduler step)
+``cluster.handoff``   per packet in the router's prefill->      ``step``,
+                      decode KV dispatch                        ``rid``
+                      (``serving/cluster/router.py``) — a
+                      raised exception fails ONE handoff:
+                      its pages return to the pool and the
+                      request requeues for unified serving,
+                      token-exact either way
 ====================  =======================================  ==========
 
 Usage::

@@ -18,7 +18,17 @@ from deepspeed_tpu.serving.spec_decode import (Drafter,  # noqa: F401
 from deepspeed_tpu.serving.scheduler import (CANCELLED,  # noqa: F401
                                              FAILED,
                                              FINISHED,
+                                             HANDOFF,
                                              SHED,
                                              QueueFull,
                                              Request,
                                              ServingScheduler)
+from deepspeed_tpu.serving.cluster import (ClusterRouter,  # noqa: F401
+                                           DisaggGroup,
+                                           LocalReplica,
+                                           ProcessReplica,
+                                           ReplicaKilled,
+                                           RequestJournal,
+                                           make_disaggregated_group,
+                                           make_local_fleet)
+from deepspeed_tpu.serving.metrics import ClusterMetrics  # noqa: F401

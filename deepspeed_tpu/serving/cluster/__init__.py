@@ -1,0 +1,37 @@
+"""Disaggregated cluster serving tier: router + replica fleet.
+
+One engine process serves one chip's worth of traffic and loses every
+in-flight request when it dies.  This package is the horizontal tier
+above ``ServingScheduler``:
+
+* :class:`~deepspeed_tpu.serving.cluster.router.ClusterRouter` — the
+  front end: journals every accepted request (at-most-once admission
+  keyed by a client idempotency rid), routes prefix-aware across the
+  fleet, detects replica death through missed health heartbeats, and
+  replays a dead replica's unfinished requests token-exact onto
+  survivors (at-least-once replay; the journal's emitted-token record
+  makes client-visible output exactly-once).
+* :class:`~deepspeed_tpu.serving.cluster.replica.LocalReplica` /
+  :class:`~deepspeed_tpu.serving.cluster.replica.ProcessReplica` — an
+  engine replica in this process (crash-simulated through the
+  ``cluster.replica_kill`` fault point) or in a child process (killed
+  for real with SIGKILL, restarted under the elastic agent's
+  SIGTERM-then-SIGKILL ``term_grace_s`` contract).
+* Role separation — prefill workers hand finished-prompt KV page
+  chains to decode workers (``take_slot_pages`` ->
+  ``attach_handoff``), degrading gracefully to unified serving when no
+  prefill worker is healthy.
+
+See ``docs/resilience.md`` ("Cluster failure model") for the exact
+at-most-once/at-least-once split and the failover timings.
+"""
+
+from deepspeed_tpu.serving.cluster.journal import (JournalEntry,  # noqa: F401
+                                                   RequestJournal)
+from deepspeed_tpu.serving.cluster.replica import (LocalReplica,  # noqa: F401
+                                                   ProcessReplica,
+                                                   ReplicaKilled)
+from deepspeed_tpu.serving.cluster.router import (ClusterRouter,  # noqa: F401
+                                                  DisaggGroup,
+                                                  make_disaggregated_group,
+                                                  make_local_fleet)

@@ -1,0 +1,177 @@
+"""Router-side request journal: the cluster tier's source of truth.
+
+Every request the router accepts gets ONE journal entry, keyed by a
+client-supplied idempotency rid (auto-assigned when omitted).  The
+entry survives replica crashes — it records the original prompt, the
+tokens already delivered to the client, and which replica currently
+holds the work — so failover is pure bookkeeping:
+
+* **at-most-once admission** — resubmitting an rid the journal already
+  holds returns the existing entry instead of serving it twice;
+* **at-least-once replay** — a dead replica's unfinished entries are
+  resubmitted to survivors with the already-emitted tokens folded into
+  the prompt (the same recompute trick preemption uses), so the
+  continuation is token-exact under the greedy contract;
+* **exactly-once client output** — tokens reach the client only
+  through :meth:`RequestJournal.token`, which drops anything arriving
+  after the entry went terminal (a straggler event from a dying
+  replica can never duplicate output).
+
+The journal is bounded: terminal entries rotate out after
+``terminal_history`` (live entries are never evicted — they are the
+replay state).  ``dump()`` writes the whole thing as JSON for CI
+artifacts and post-mortems.
+"""
+
+import json
+import time
+from collections import OrderedDict
+
+QUEUED, ROUTED, HANDOFF = "queued", "routed", "handoff"
+FINISHED, FAILED, SHED, CANCELLED = "finished", "failed", "shed", \
+    "cancelled"
+TERMINAL = (FINISHED, FAILED, SHED, CANCELLED)
+
+
+class JournalEntry:
+    """One client request's cluster-level lifecycle."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "deadline_abs", "on_token", "emitted", "state", "error",
+                 "attempts", "replays", "replica", "replica_history",
+                 "handle", "next_try", "t_submit", "t_first", "t_last",
+                 "cancel_requested")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
+                 on_token=None, deadline_s=None):
+        self.rid = rid
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.t_submit = time.monotonic()
+        self.deadline_abs = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
+        self.emitted = []          # tokens DELIVERED to the client
+        self.state = QUEUED
+        self.error = None
+        self.attempts = 0          # admission tries (backpressure retries)
+        self.replays = 0           # failover resubmissions
+        self.replica = None        # current owner replica id
+        self.replica_history = []  # every replica that ever held it
+        self.handle = None         # replica-side request handle
+        self.next_try = 0.0        # monotonic gate for backoff retries
+        self.t_first = None        # first delivered token (cluster TTFT)
+        self.t_last = None
+        self.cancel_requested = False
+
+    @property
+    def remaining_new(self):
+        return self.max_new_tokens - len(self.emitted)
+
+    def serve_prompt(self):
+        """The prompt a (re)submission serves: original prompt with the
+        already-delivered tokens folded in, so a survivor recomputes
+        their KV but never re-emits them."""
+        return self.prompt + self.emitted
+
+    def finished_by_emitted(self):
+        """True when the emitted stream already satisfies the request
+        (budget reached, or the last delivered token was EOS) — a
+        replay in that state finalizes instead of resubmitting."""
+        if self.remaining_new <= 0:
+            return True
+        return bool(self.emitted) and self.eos_token_id is not None and \
+            self.emitted[-1] == self.eos_token_id
+
+    def snapshot(self):
+        return {
+            "rid": self.rid, "state": self.state, "error": self.error,
+            "prompt_tokens": len(self.prompt),
+            "emitted_tokens": len(self.emitted),
+            "max_new_tokens": self.max_new_tokens,
+            "attempts": self.attempts, "replays": self.replays,
+            "replica": self.replica,
+            "replica_history": list(self.replica_history),
+        }
+
+
+class RequestJournal:
+    """rid-keyed journal with idempotent admission and bounded terminal
+    retention."""
+
+    def __init__(self, terminal_history=4096):
+        self.entries = OrderedDict()      # rid -> entry (live + recent)
+        self.terminal_history = int(terminal_history)
+        self._terminal_count = 0
+        self._auto_rid = 0
+
+    def admit(self, prompt, max_new_tokens, eos_token_id=None,
+              on_token=None, deadline_s=None, rid=None):
+        """Returns ``(entry, created)``; a duplicate rid returns the
+        incumbent with ``created=False`` (at-most-once admission)."""
+        if rid is None:
+            rid = f"auto-{self._auto_rid}"
+            self._auto_rid += 1
+        if rid in self.entries:
+            return self.entries[rid], False
+        entry = JournalEntry(rid, prompt, max_new_tokens, eos_token_id,
+                             on_token, deadline_s)
+        self.entries[rid] = entry
+        return entry, True
+
+    def token(self, entry, tok):
+        """The ONLY path tokens take to the client.  Terminal entries
+        swallow stragglers (exactly-once output); live entries append
+        and forward."""
+        if entry.state in TERMINAL:
+            return
+        entry.emitted.append(int(tok))
+        entry.t_last = time.monotonic()
+        if entry.t_first is None:
+            entry.t_first = entry.t_last
+        if entry.on_token is not None:
+            entry.on_token(entry, int(tok))
+
+    def finalize(self, entry, state, error=None):
+        entry.state = state
+        if error is not None:
+            entry.error = error
+        entry.handle = None
+        entry.replica = None
+        self._terminal_count += 1
+        self._rotate()
+
+    def _rotate(self):
+        """Drop the oldest terminal entries past the retention bound.
+        Live entries are replay state and never rotate."""
+        excess = self._terminal_count - self.terminal_history
+        if excess <= 0:
+            return
+        for rid in [r for r, e in self.entries.items()
+                    if e.state in TERMINAL][:excess]:
+            del self.entries[rid]
+            self._terminal_count -= 1
+
+    def live(self):
+        return [e for e in self.entries.values()
+                if e.state not in TERMINAL]
+
+    def has_live(self):
+        return any(e.state not in TERMINAL for e in self.entries.values())
+
+    def counts(self):
+        out = {}
+        for e in self.entries.values():
+            out[e.state] = out.get(e.state, 0) + 1
+        return out
+
+    def dump(self, path):
+        """CI artifact / post-mortem: every entry's snapshot plus the
+        state histogram."""
+        with open(path, "w") as f:
+            json.dump({"counts": self.counts(),
+                       "entries": [e.snapshot()
+                                   for e in self.entries.values()]},
+                      f, indent=2)
+            f.write("\n")

@@ -1,0 +1,488 @@
+"""Engine replicas: the units the cluster router load-balances over.
+
+Two backings share one interface (submit/attach/step/heartbeat/drain/
+restart/prefix_match_len):
+
+* :class:`LocalReplica` — a ``ServingScheduler`` in this process,
+  stepped cooperatively by the router's pump.  Crashes are simulated
+  through the ``cluster.replica_kill`` fault point: an armed injection
+  raising at the replica's step entry drops the whole scheduler —
+  in-flight requests, queue, prefix cache — exactly like a process
+  death, and the shared page pool is made whole again (a real node
+  death takes its HBM with it; the in-process model must not leak the
+  pool it shares with survivors).
+* :class:`ProcessReplica` — a child process running
+  ``deepspeed_tpu.serving.cluster.worker`` over a JSONL stdin/stdout
+  protocol.  Death is real (SIGKILL), detection is missed heartbeats
+  or a reaped pid, and restart honors the elastic agent's
+  SIGTERM-then-SIGKILL ``term_grace_s`` contract
+  (``DS_PREEMPTION_GRACE_S`` rides the worker env so its drain sizes
+  itself against the real budget).
+
+A replica NEVER owns client-visible request state: the router's
+journal does.  Replica handles expose ``.state``/``.error``/
+``.cancel()`` and stream tokens through the router-supplied callback;
+everything else is private.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from deepspeed_tpu.resilience import faults
+
+UP, DRAINING, DEAD = "up", "draining", "dead"
+
+
+class ReplicaKilled(RuntimeError):
+    """A replica crashed, was killed, or stopped heartbeating."""
+
+
+class LocalReplica:
+    """An in-process ServingScheduler behind the replica interface."""
+
+    def __init__(self, replica_id, scheduler_factory, role="unified",
+                 group=None):
+        self.id = replica_id
+        self.role = role                 # unified | prefill | decode
+        self.group = group               # DisaggGroup for role workers
+        self._factory = scheduler_factory
+        self.sched = scheduler_factory()
+        self.state = UP
+        self.death_reason = None
+        self.missed_beats = 0
+        self.restarts = 0
+        self.last_health = None
+        self._handoff_sink = None
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens, eos_token_id=None,
+               deadline_s=None, on_token=None, handoff=False):
+        if self.state != UP:
+            raise ReplicaKilled(f"{self.id} is {self.state}")
+        return self.sched.submit(prompt, max_new_tokens,
+                                 eos_token_id=eos_token_id,
+                                 on_token=on_token, deadline_s=deadline_s,
+                                 handoff=handoff)
+
+    def attach(self, prompt, pages, length, first_tok, *, max_new_tokens,
+               eos_token_id=None, deadline_s=None, on_token=None):
+        if self.state != UP:
+            raise ReplicaKilled(f"{self.id} is {self.state}")
+        return self.sched.attach_handoff(
+            prompt, pages, length, first_tok,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            on_token=on_token, deadline_s=deadline_s)
+
+    def set_handoff_sink(self, cb):
+        """Router wiring for prefill workers: where finished-prompt KV
+        chains go.  Survives :meth:`restart` (the fresh scheduler is
+        rewired)."""
+        self._handoff_sink = cb
+        if self.sched is not None:
+            self.sched.on_handoff = cb
+
+    def prefix_match_len(self, tokens):
+        """Prefix-aware routing score: how many leading tokens of the
+        prompt this replica's radix cache could serve right now."""
+        if self.state != UP or self.sched is None or \
+                self.sched.prefix_cache is None or len(tokens) < 2:
+            return 0
+        return self.sched.prefix_cache.prefix_len(tokens,
+                                                  limit=len(tokens) - 1)
+
+    def prefix_stats(self):
+        pc = None if self.sched is None else self.sched.prefix_cache
+        if pc is None:
+            return (0, 0, 0)
+        return (pc.hits, pc.lookups, pc.tokens_reused)
+
+    def load(self):
+        """Routing tie-break: live work items on this replica."""
+        if self.sched is None:
+            return 0
+        s = self.sched
+        return (len(s.waiting) + len(s._pending_attach) +
+                sum(r is not None for r in s.slot_req))
+
+    # -------------------------------------------------------------- pump
+    def has_work(self):
+        if self.sched is None:
+            return False
+        s = self.sched
+        return bool(s.waiting) or bool(s._inflight) or \
+            bool(s._pending_attach) or \
+            any(r is not None for r in s.slot_req)
+
+    def step(self, step_idx):
+        """One scheduler iteration.  The ``cluster.replica_kill`` fault
+        point fires first — an armed raise here IS the crash: the
+        scheduler is dropped wholesale and :class:`ReplicaKilled`
+        surfaces to the router, which replays this replica's journal
+        entries onto survivors.  An uncontained scheduler exception
+        (shared-dispatch failure, per PR-2's containment policy the
+        only kind that can escape) is treated identically: one replica
+        dies, never the tier."""
+        if self.state == DEAD:
+            return False
+        try:
+            faults.fire("cluster.replica_kill", step=step_idx,
+                        replica=self.id)
+        except Exception as e:
+            self.die(f"injected kill: {type(e).__name__}: {e}")
+            raise ReplicaKilled(self.death_reason) from e
+        if not self.has_work():
+            return False
+        try:
+            return self.sched.step()
+        except Exception as e:
+            self.die(f"uncontained scheduler error: "
+                     f"{type(e).__name__}: {e}")
+            raise ReplicaKilled(self.death_reason) from e
+
+    def heartbeat(self):
+        """Health snapshot, or :class:`ReplicaKilled` — the router's
+        death-detection signal."""
+        if self.state == DEAD:
+            raise ReplicaKilled(f"{self.id} dead: {self.death_reason}")
+        self.last_health = self.sched.health()
+        return self.last_health
+
+    # ----------------------------------------------------- lifecycle
+    @staticmethod
+    def _reclaim(sched):
+        """Return every pool page a discarded scheduler holds — live
+        slots, parked handoff chains, AND its refcounted prefix
+        cache.  Mandatory when the pool is shared (a disaggregated
+        group's pool outlives its workers in-process, unlike the
+        per-node HBM it models): pages an abandoned scheduler still
+        references would never recycle and the group would march to
+        exhaustion one restart at a time."""
+        if sched is None:
+            return
+        try:
+            sched._inflight.clear()
+            for slot in range(sched.num_slots):
+                if sched.kv.slot_page_count(slot):
+                    sched.kv.release_slot(slot)
+            while sched._pending_attach:
+                req = sched._pending_attach.popleft()
+                sched.kv.pool.free(req._attach[0])
+            if sched.prefix_cache is not None:
+                sched.prefix_cache.evict(sched.kv.pool.num_pages)
+        except Exception:
+            pass   # reclaim is best-effort; the router replays anyway
+
+    def die(self, reason):
+        """Crash semantics: all scheduler state is lost; its pool
+        pages are reclaimed (see :meth:`_reclaim`)."""
+        if self.state == DEAD:
+            return
+        self.state = DEAD
+        self.death_reason = reason
+        sched, self.sched = self.sched, None
+        self._reclaim(sched)
+
+    def begin_drain(self):
+        """Rolling-restart entry: refuse new work, keep serving what is
+        already accepted (the router stops routing here too)."""
+        if self.state == UP:
+            self.state = DRAINING
+            self.sched.begin_drain(shed_waiting=False)
+
+    def drained(self):
+        return not self.has_work()
+
+    def restart(self, term_grace_s=None):
+        """Fresh scheduler from the factory (post-drain rolling restart
+        or post-death recovery).  ``term_grace_s`` is a no-op here —
+        in-process there is nothing to SIGTERM — and honored by
+        :class:`ProcessReplica`.  The outgoing scheduler's pages
+        (notably its prefix cache — a drained replica holds nothing
+        else) are reclaimed first, or a shared pool would leak them on
+        every rolling restart."""
+        self._reclaim(self.sched)
+        self.sched = self._factory()
+        if self._handoff_sink is not None:
+            self.sched.on_handoff = self._handoff_sink
+        self.state = UP
+        self.death_reason = None
+        self.missed_beats = 0
+        self.restarts += 1
+
+
+class _RemoteHandle:
+    """Router-visible handle for a request living in a worker process:
+    mirrors the scheduler Request surface the router consumes
+    (``state`` / ``error`` / ``cancel()``)."""
+
+    __slots__ = ("rid", "state", "error", "on_token", "_replica")
+
+    def __init__(self, rid, on_token, replica):
+        self.rid = rid
+        self.state = "running"
+        self.error = None
+        self.on_token = on_token
+        self._replica = replica
+
+    def cancel(self):
+        # a broken pipe means the worker is dying: swallow it — cancel
+        # must stay idempotent/no-raise for callers (router.cancel),
+        # and the heartbeat pass will declare the death and replay
+        try:
+            self._replica._send({"op": "cancel", "rid": self.rid})
+        except Exception:
+            pass
+
+
+class ProcessReplica:
+    """A worker process behind the replica interface (JSONL protocol —
+    see ``cluster/worker.py``).  Unified role only: cross-process KV
+    page handoff would need a device-to-device transport this CPU
+    harness cannot model honestly."""
+
+    role = "unified"
+    group = None
+
+    def __init__(self, replica_id, *, model="gpt2-tiny", num_slots=3,
+                 num_pages=32, page_size=16, max_pages_per_slot=8,
+                 prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
+                 hb_timeout_s=60.0, env=None):
+        self.id = replica_id
+        self.state = UP
+        self.death_reason = None
+        self.missed_beats = 0
+        self.restarts = 0
+        self.last_health = None
+        self.term_grace_s = float(term_grace_s)
+        self.hb_timeout_s = float(hb_timeout_s)
+        self._cfg = dict(model=model, num_slots=num_slots,
+                         num_pages=num_pages, page_size=page_size,
+                         max_pages_per_slot=max_pages_per_slot,
+                         prefill_chunk=prefill_chunk,
+                         prefix_cache=prefix_cache)
+        self._env = dict(env or {})
+        self._handles = {}
+        self._next_rid = 0
+        self._spawn()
+
+    # --------------------------------------------------------- process
+    def _spawn(self):
+        cfg = self._cfg
+        cmd = [sys.executable, "-m", "deepspeed_tpu.serving.cluster.worker",
+               "--model", cfg["model"],
+               "--num-slots", str(cfg["num_slots"]),
+               "--num-pages", str(cfg["num_pages"]),
+               "--page-size", str(cfg["page_size"]),
+               "--max-pages-per-slot", str(cfg["max_pages_per_slot"]),
+               "--prefill-chunk", str(cfg["prefill_chunk"])]
+        if cfg["prefix_cache"]:
+            cmd.append("--prefix-cache")
+        try:
+            # forward PRNG semantics: seeded init only yields the SAME
+            # params in the child when threefry partitioning matches
+            import jax
+            if jax.config.jax_threefry_partitionable:
+                cmd.append("--threefry-partitionable")
+        except Exception:
+            pass
+        env = os.environ.copy()
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the elastic-agent grace contract: the worker's SIGTERM drain
+        # sizes itself against the budget the supervisor will enforce
+        env["DS_PREEMPTION_GRACE_S"] = str(self.term_grace_s)
+        env.update(self._env)
+        self._proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env)
+        self._events = deque()
+        self._events_lock = threading.Lock()
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+        self._last_hb = time.monotonic()
+        self._ready = False
+
+    def _read_loop(self):
+        proc = self._proc
+        try:
+            for line in proc.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                with self._events_lock:
+                    self._events.append(ev)
+        except Exception:
+            pass
+
+    def _send(self, op):
+        try:
+            self._proc.stdin.write(json.dumps(op) + "\n")
+            self._proc.stdin.flush()
+        except Exception as e:
+            raise ReplicaKilled(f"{self.id} pipe broken: {e}") from e
+
+    def wait_ready(self, timeout_s=300.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self._pump_events()
+            if self._ready:
+                return True
+            if self._proc.poll() is not None:
+                raise ReplicaKilled(
+                    f"{self.id} exited rc={self._proc.returncode} "
+                    "before ready")
+            time.sleep(0.05)
+        raise TimeoutError(f"{self.id} not ready in {timeout_s}s")
+
+    def _pump_events(self):
+        while True:
+            with self._events_lock:
+                if not self._events:
+                    return
+                ev = self._events.popleft()
+            kind = ev.get("ev")
+            if kind == "ready":
+                self._ready = True
+                self._last_hb = time.monotonic()
+            elif kind == "hb":
+                self._last_hb = time.monotonic()
+                self.last_health = ev.get("health")
+            elif kind == "tok":
+                h = self._handles.get(ev.get("rid"))
+                if h is not None and h.on_token is not None:
+                    h.on_token(h, int(ev["t"]))
+            elif kind == "done":
+                h = self._handles.pop(ev.get("rid"), None)
+                if h is not None:
+                    h.state = ev.get("status", "finished")
+                    h.error = ev.get("error")
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens, eos_token_id=None,
+               deadline_s=None, on_token=None, handoff=False):
+        if handoff:
+            raise ValueError("process replicas serve unified only")
+        if self.state != UP:
+            raise ReplicaKilled(f"{self.id} is {self.state}")
+        rid = f"w{self._next_rid}"
+        self._next_rid += 1
+        handle = _RemoteHandle(rid, on_token, self)
+        self._handles[rid] = handle
+        self._send({"op": "submit", "rid": rid,
+                    "prompt": [int(t) for t in prompt],
+                    "max_new_tokens": int(max_new_tokens),
+                    "eos_token_id": eos_token_id,
+                    "deadline_s": deadline_s})
+        return handle
+
+    def prefix_match_len(self, tokens):
+        # no fingerprint protocol op yet: process replicas route by load
+        return 0
+
+    def prefix_stats(self):
+        return (0, 0, 0)
+
+    def load(self):
+        return len(self._handles)
+
+    # -------------------------------------------------------------- pump
+    def has_work(self):
+        """Always False: the actual work runs in the child process, so
+        the router's pump has nothing to drive here and may idle-sleep
+        between event polls instead of busy-spinning CPU away from the
+        worker."""
+        return False
+
+    def step(self, step_idx):
+        if self.state == DEAD:
+            return False
+        try:
+            faults.fire("cluster.replica_kill", step=step_idx,
+                        replica=self.id)
+        except Exception as e:
+            self.kill()
+            self.die(f"injected kill: {type(e).__name__}: {e}")
+            raise ReplicaKilled(self.death_reason) from e
+        self._pump_events()
+        return bool(self._handles)
+
+    def heartbeat(self):
+        if self.state == DEAD:
+            raise ReplicaKilled(f"{self.id} dead: {self.death_reason}")
+        self._pump_events()
+        if self._proc.poll() is not None:
+            raise ReplicaKilled(
+                f"{self.id} exited rc={self._proc.returncode}")
+        if time.monotonic() - self._last_hb > self.hb_timeout_s:
+            raise ReplicaKilled(
+                f"{self.id} silent for > {self.hb_timeout_s}s")
+        return self.last_health
+
+    # ----------------------------------------------------- lifecycle
+    def kill(self):
+        """The real thing: SIGKILL, no goodbye."""
+        try:
+            if self._proc.poll() is None:
+                os.kill(self._proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    def die(self, reason):
+        if self.state == DEAD:
+            return
+        self.state = DEAD
+        self.death_reason = reason
+        self.kill()
+        self._handles.clear()
+
+    def begin_drain(self):
+        if self.state != UP:
+            return
+        self.state = DRAINING
+        try:
+            self._send({"op": "drain"})
+        except Exception:
+            # dead pipe: the drain is moot — heartbeats will declare
+            # the death; drain_all/rolling_restart must keep going for
+            # the surviving replicas instead of aborting mid-shutdown
+            pass
+
+    def drained(self):
+        self._pump_events()
+        return not self._handles
+
+    def restart(self, term_grace_s=None):
+        """Elastic-agent restart contract: SIGTERM first (the worker
+        drains within ``DS_PREEMPTION_GRACE_S``), SIGKILL only after
+        the grace budget, then respawn."""
+        grace = self.term_grace_s if term_grace_s is None \
+            else float(term_grace_s)
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            deadline = time.monotonic() + grace
+            while self._proc.poll() is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            if self._proc.poll() is None:
+                self._proc.kill()
+        try:
+            self._proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            pass
+        self._handles.clear()
+        self._spawn()
+        self.wait_ready()
+        self.state = UP
+        self.death_reason = None
+        self.missed_beats = 0
+        self.restarts += 1

@@ -1,0 +1,592 @@
+"""Front-end router: prefix-aware load balancing with zero-lost-request
+failover over a replica fleet.
+
+The router is pure host logic pumped cooperatively (``step()`` /
+``run()``), exactly like the scheduler under it.  One pump iteration:
+
+1. **heartbeats** — poll every replica's ``heartbeat()``; a raise (or
+   ``heartbeat_misses`` consecutive misses for process replicas) marks
+   the replica dead and *replays* its unfinished journal entries:
+   emitted tokens fold into the prompt (the preemption-recompute
+   trick), so a survivor continues the stream token-exact without
+   re-emitting a single token.
+2. **handoff dispatch** — finished-prompt KV chains from prefill
+   workers attach to decode workers in the same group; a failed or
+   faulted handoff (``cluster.handoff``) frees the pages and requeues
+   the request for unified serving — contained, never lost.
+3. **routing** — queued entries pick a replica: prefill workers first
+   when the tier is disaggregated and one is healthy (else unified,
+   counted as a degraded route); among candidates the *prefix-aware*
+   policy scores each replica by how many prompt tokens its radix
+   cache already holds (``PrefixCache.prefix_len``) and ties break by
+   load then round-robin. ``QueueFull``/backpressure costs a bounded
+   retry with exponential backoff + jitter; the retry budget exhausted
+   sheds the request distinctly.
+4. **pump replicas** — step each live replica once; a raise is a
+   replica death (see 1), never a router death.
+5. **collect** — replica-side terminal states propagate to the
+   journal: finished/cancelled/failed/deadline-shed finalize; a
+   capacity shed requeues under the same bounded retry budget.
+
+Admission is **at-most-once** (client idempotency rids dedupe in the
+journal), replay is **at-least-once** (a request may run partially on
+several replicas), and client output is **exactly-once** (the journal
+is the only token path and drops post-terminal stragglers).
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving.cluster import journal as jn
+from deepspeed_tpu.serving.cluster.journal import RequestJournal
+from deepspeed_tpu.serving.cluster.replica import (DEAD, DRAINING, UP,
+                                                   LocalReplica,
+                                                   ReplicaKilled)
+from deepspeed_tpu.serving.metrics import ClusterMetrics
+from deepspeed_tpu.serving.page_manager import PagePool
+from deepspeed_tpu.serving.scheduler import ServingScheduler, _PoolsRef
+
+
+class DisaggGroup:
+    """Prefill and decode workers sharing one physical page pool and
+    one device-pools ref — the handoff transport."""
+
+    def __init__(self, name, pool, pools_ref):
+        self.name = name
+        self.pool = pool
+        self.pools_ref = pools_ref
+
+
+class _Packet:
+    """A finished-prompt KV chain in flight between workers.
+
+    ``prompt`` is the EXACT token sequence whose KV the pages hold (the
+    prompt the prefill worker served) — the decode-side request must be
+    keyed on it, not on the journal's current folded prompt, because
+    the boundary token was already journal-emitted by the time the
+    packet dispatches and folding it again would double-count it."""
+
+    __slots__ = ("entry", "group", "prompt", "pages", "length",
+                 "first_tok", "pool")
+
+    def __init__(self, entry, group, prompt, pages, length, first_tok,
+                 pool):
+        self.entry = entry
+        self.group = group
+        self.prompt = prompt
+        self.pages = pages
+        self.length = length
+        self.first_tok = first_tok
+        self.pool = pool
+
+
+class ClusterRouter:
+    """Load-balance requests across engine replicas; lose none."""
+
+    def __init__(self, replicas, *, routing="prefix", retry_max=3,
+                 retry_backoff_s=0.02, heartbeat_misses=3, monitor=None,
+                 seed=0, term_grace_s=10.0):
+        if routing not in ("prefix", "round_robin"):
+            raise ValueError(f"unknown routing policy {routing!r}")
+        self.replicas = list(replicas)
+        self.routing = routing
+        self.retry_max = int(retry_max)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.heartbeat_misses = int(heartbeat_misses)
+        self.term_grace_s = float(term_grace_s)
+        self.journal = RequestJournal()
+        self.metrics = ClusterMetrics(monitor)
+        self.step_idx = 0
+        self._rr = 0
+        self._rng = np.random.default_rng(seed)
+        self._by_handle = {}     # id(replica handle) -> journal entry
+        self._packets = deque()
+        self._has_prefill = any(r.role == "prefill" for r in self.replicas)
+        for rep in self.replicas:
+            if rep.role == "prefill" and hasattr(rep, "set_handoff_sink"):
+                rep.set_handoff_sink(self._make_handoff_sink(rep))
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               on_token=None, deadline_s=None, rid=None):
+        """Journal a request (idempotent on ``rid``) for routing at the
+        next pump.  Returns the journal entry — its ``state`` /
+        ``emitted`` are the client-visible truth across any number of
+        replica deaths."""
+        entry, created = self.journal.admit(
+            prompt, max_new_tokens, eos_token_id=eos_token_id,
+            on_token=on_token, deadline_s=deadline_s, rid=rid)
+        if created:
+            self.metrics.submitted += 1
+        else:
+            self.metrics.duplicate_rids += 1
+        return entry
+
+    def cancel(self, rid):
+        """Cancel a journaled request.  Idempotent: cancelling a
+        terminal (or unknown) rid is a no-op returning False."""
+        entry = self.journal.entries.get(rid)
+        if entry is None or entry.state in jn.TERMINAL:
+            return False
+        entry.cancel_requested = True
+        if entry.state == jn.QUEUED:
+            self._finalize(entry, jn.CANCELLED, "cancelled in queue")
+        elif entry.state == jn.ROUTED and entry.handle is not None:
+            entry.handle.cancel()
+        # HANDOFF packets are cancelled at dispatch (pages freed there)
+        return True
+
+    # ------------------------------------------------------------- pump
+    def step(self):
+        """One router pump; returns True while any journaled work is
+        live."""
+        self.step_idx += 1
+        now = time.monotonic()
+        self._check_replicas()
+        self._dispatch_handoffs(now)
+        self._route(now)
+        for rep in self.replicas:
+            if rep.state == DEAD:
+                continue
+            try:
+                rep.step(self.step_idx)
+            except ReplicaKilled:
+                self._on_death(rep)
+            except Exception:   # an uncontained replica error is a death
+                self._on_death(rep)
+        self._collect(now)
+        return self.journal.has_live() or bool(self._packets)
+
+    def run(self, max_steps=100000):
+        """Pump until every journaled request is terminal; returns
+        ``{rid: emitted tokens}`` for the FINISHED ones."""
+        for _ in range(max_steps):
+            if not self.step():
+                break
+            if not any(rep.state != DEAD and rep.has_work()
+                       for rep in self.replicas) and not self._packets:
+                # nothing on any device: backoff gates are the only
+                # clock left — don't spin the host
+                time.sleep(0.002)
+        return {e.rid: list(e.emitted)
+                for e in self.journal.entries.values()
+                if e.state == jn.FINISHED}
+
+    # ------------------------------------------------------- heartbeats
+    def _check_replicas(self):
+        for rep in self.replicas:
+            if rep.state == DEAD:
+                if not getattr(rep, "_death_handled", False):
+                    self._on_death(rep)
+                continue
+            try:
+                rep.heartbeat()
+                rep.missed_beats = 0
+            except Exception:
+                rep.missed_beats += 1
+                self.metrics.heartbeat_misses += 1
+                self.metrics.event(self.step_idx, "heartbeat_miss")
+                if rep.state == DEAD or \
+                        rep.missed_beats >= self.heartbeat_misses:
+                    self._on_death(rep)
+
+    def _on_death(self, rep):
+        if getattr(rep, "_death_handled", False):
+            return
+        rep._death_handled = True
+        rep.die(getattr(rep, "death_reason", None) or
+                "missed heartbeats")
+        self.metrics.failovers += 1
+        self.metrics.event(self.step_idx, "failover")
+        for entry in self.journal.live():
+            if entry.state == jn.ROUTED and entry.replica == rep.id:
+                self._replay(entry)
+
+    def _replay(self, entry):
+        """Zero-lost failover: requeue a dead replica's entry with its
+        delivered tokens folded into the prompt.  If the emitted stream
+        already satisfies the request, finalize instead (a death racing
+        completion must not re-serve a finished stream)."""
+        if entry.handle is not None:
+            self._by_handle.pop(id(entry.handle), None)
+            entry.handle = None
+        entry.replica = None
+        if entry.finished_by_emitted():
+            self._finalize(entry, jn.FINISHED)
+            return
+        entry.state = jn.QUEUED
+        entry.replays += 1
+        entry.next_try = 0.0
+        self.metrics.replays += 1
+        self.metrics.replayed_tokens += len(entry.emitted)
+        self.metrics.event(self.step_idx, "replay")
+
+    # ---------------------------------------------------------- routing
+    def _up(self, role=None):
+        out = [r for r in self.replicas if r.state == UP]
+        if role is not None:
+            out = [r for r in out if r.role == role]
+        return out
+
+    def _candidates(self):
+        """(candidate replicas, handoff?) under the degrade policy:
+        prefill workers take fresh admissions only while a decode
+        worker in the same group is up; otherwise everything routes
+        unified (decode/unified replicas — or, last resort, a prefill
+        worker serving unified)."""
+        decode_up = {id(r.group) for r in self._up("decode")}
+        prefill = [r for r in self._up("prefill")
+                   if id(r.group) in decode_up]
+        if prefill:
+            return prefill, True
+        unified = [r for r in self._up() if r.role != "prefill"]
+        if unified:
+            return unified, False
+        return self._up(), False    # prefill workers serving unified
+
+    def _pick(self, candidates, prompt):
+        if self.routing == "prefix":
+            scores = [r.prefix_match_len(prompt) for r in candidates]
+            best = max(scores)
+            pool = [r for r, s in zip(candidates, scores) if s == best]
+        else:
+            pool = candidates
+        min_load = min(r.load() for r in pool)
+        pool = [r for r in pool if r.load() == min_load]
+        rep = pool[self._rr % len(pool)]
+        self._rr += 1
+        return rep
+
+    def _backoff(self, entry, now, reason):
+        entry.attempts += 1
+        self.metrics.retries += 1
+        self.metrics.event(self.step_idx, "retry")
+        if entry.attempts > self.retry_max:
+            self._finalize(entry, jn.SHED,
+                           f"cluster capacity: {self.retry_max} "
+                           f"admission retries exhausted ({reason})")
+            return
+        # exponential backoff with jitter: synchronized retry bursts
+        # are how one full replica becomes every replica's problem
+        delay = self.retry_backoff_s * (2 ** (entry.attempts - 1))
+        entry.next_try = now + delay * (1.0 + self._rng.random())
+
+    def _route(self, now):
+        for entry in self.journal.live():
+            if entry.state != jn.QUEUED or entry.next_try > now:
+                continue
+            if entry.cancel_requested:
+                self._finalize(entry, jn.CANCELLED, "cancelled in queue")
+                continue
+            if entry.deadline_abs is not None and now > entry.deadline_abs:
+                self._finalize(entry, jn.SHED, "deadline expired in "
+                               "router queue")
+                continue
+            if entry.finished_by_emitted():
+                self._finalize(entry, jn.FINISHED)
+                continue
+            candidates, handoff = self._candidates()
+            if not candidates:
+                continue   # whole fleet down/draining: wait for restart
+            if self._has_prefill and not handoff:
+                self.metrics.degraded_routes += 1
+            prompt = entry.serve_prompt()
+            rep = self._pick(candidates, prompt)
+            deadline_s = None if entry.deadline_abs is None \
+                else max(0.001, entry.deadline_abs - now)
+            try:
+                handle = rep.submit(
+                    prompt, entry.remaining_new,
+                    eos_token_id=entry.eos_token_id,
+                    deadline_s=deadline_s,
+                    on_token=self._make_token_sink(entry),
+                    handoff=handoff)
+            except ReplicaKilled:
+                continue    # heartbeat pass will handle the body
+            except ValueError as e:
+                # validation error (oversize prompt, config mismatch):
+                # permanent — retrying elsewhere burns the backoff
+                # budget to convert a client error into a misleading
+                # "cluster capacity" shed. Fail it with the message.
+                self._finalize(entry, jn.FAILED,
+                               f"{type(e).__name__}: {e}")
+                continue
+            except Exception as e:   # QueueFull et al: backpressure
+                self._backoff(entry, now, f"{type(e).__name__}")
+                continue
+            entry.state = jn.ROUTED
+            entry.replica = rep.id
+            entry.replica_history.append(rep.id)
+            entry.handle = handle
+            self._by_handle[id(handle)] = entry
+            self.metrics.routed += 1
+
+    def _make_token_sink(self, entry):
+        journal = self.journal
+
+        def sink(_req, tok):
+            journal.token(entry, tok)
+        return sink
+
+    # ---------------------------------------------------------- handoff
+    def _make_handoff_sink(self, rep):
+        def sink(req, pages, length, first_tok):
+            entry = self._by_handle.pop(id(req), None)
+            if entry is None:   # not a routed request (defensive)
+                rep.sched.kv.pool.free(pages)
+                return
+            entry.state = jn.HANDOFF
+            entry.replica = None
+            entry.handle = None
+            self._packets.append(
+                _Packet(entry, rep.group, list(req.orig_prompt), pages,
+                        length, first_tok, rep.sched.kv.pool))
+        return sink
+
+    def _dispatch_handoffs(self, now):
+        """Attach pending KV packets to decode workers.  Every failure
+        mode — injected ``cluster.handoff`` fault, no live decode
+        worker, attach refusal — frees the pages and requeues the
+        request for unified serving: a handoff can be retried or
+        degraded, never lost."""
+        for _ in range(len(self._packets)):
+            pkt = self._packets.popleft()
+            entry = pkt.entry
+            if entry.cancel_requested:
+                pkt.pool.free(pkt.pages)
+                self._finalize(entry, jn.CANCELLED,
+                               "cancelled during handoff")
+                continue
+            try:
+                faults.fire("cluster.handoff", step=self.step_idx,
+                            rid=entry.rid)
+            except Exception as e:
+                pkt.pool.free(pkt.pages)
+                self._requeue_unified(entry,
+                                      f"handoff fault: {type(e).__name__}")
+                continue
+            targets = [r for r in self._up("decode")
+                       if r.group is pkt.group]
+            # soft admission gate: never park more chains at a worker
+            # than it has slots — parked chains hold pool pages
+            targets = [r for r in targets
+                       if len(r.sched._pending_attach) < r.sched.num_slots]
+            if not targets:
+                if self._up("decode"):
+                    self._packets.append(pkt)   # backpressure: retry
+                    continue
+                pkt.pool.free(pkt.pages)
+                self._requeue_unified(entry, "no live decode worker")
+                continue
+            rep = min(targets, key=lambda r: r.load())
+            try:
+                handle = rep.attach(
+                    pkt.prompt, pkt.pages, pkt.length,
+                    pkt.first_tok, max_new_tokens=entry.remaining_new + 1,
+                    eos_token_id=entry.eos_token_id,
+                    deadline_s=None if entry.deadline_abs is None
+                    else max(0.001, entry.deadline_abs - now),
+                    on_token=self._make_token_sink(entry))
+            except Exception:
+                pkt.pool.free(pkt.pages)
+                self._requeue_unified(entry, "attach failed")
+                continue
+            entry.state = jn.ROUTED
+            entry.replica = rep.id
+            entry.replica_history.append(rep.id)
+            entry.handle = handle
+            self._by_handle[id(handle)] = entry
+            self.metrics.handoffs += 1
+            self.metrics.event(self.step_idx, "handoff")
+
+    def _requeue_unified(self, entry, reason):
+        if entry.finished_by_emitted():
+            self._finalize(entry, jn.FINISHED)
+            return
+        entry.state = jn.QUEUED
+        entry.next_try = 0.0
+        entry.error = reason   # transient note; cleared on finish
+        self.metrics.event(self.step_idx, "handoff_degrade")
+
+    # ---------------------------------------------------------- collect
+    def _collect(self, now):
+        for entry in list(self.journal.live()):
+            if entry.state != jn.ROUTED or entry.handle is None:
+                continue
+            st = entry.handle.state
+            if st in ("waiting", "prefill", "running"):
+                continue
+            if st == "handoff":
+                continue   # the sink already owns this transition
+            err = entry.handle.error
+            self._by_handle.pop(id(entry.handle), None)
+            entry.handle = None
+            entry.replica = None
+            if st == "finished":
+                self._finalize(entry, jn.FINISHED)
+            elif st == "cancelled":
+                self._finalize(entry, jn.CANCELLED, err)
+            elif st == "failed":
+                self._finalize(entry, jn.FAILED, err)
+            elif st == "shed":
+                if err is not None and "deadline" in err:
+                    self._finalize(entry, jn.SHED, err)
+                else:
+                    # capacity shed (pool dead-end, drain): another
+                    # replica may well serve it — bounded retry
+                    if entry.finished_by_emitted():
+                        self._finalize(entry, jn.FINISHED)
+                    else:
+                        entry.state = jn.QUEUED
+                        self._backoff(entry, now, f"replica shed: {err}")
+
+    def _finalize(self, entry, state, error=None):
+        if entry.handle is not None:
+            self._by_handle.pop(id(entry.handle), None)
+        if state == jn.FINISHED:
+            entry.error = None   # transient retry notes don't survive
+        self.journal.finalize(entry, state, error)
+        self.metrics.record_terminal(self.step_idx, state)
+
+    # ------------------------------------------------- drain + restart
+    def drain_replica(self, rep, max_steps=100000):
+        """Rolling-restart phase 1: stop routing to ``rep`` (drain
+        mode), pump the whole tier until its in-flight work finishes.
+        The fleet keeps serving throughout."""
+        rep.begin_drain()
+        for _ in range(max_steps):
+            if rep.state == DEAD or rep.drained():
+                break
+            self.step()
+        self.metrics.drains += 1
+        self.metrics.event(self.step_idx, "drain")
+
+    def rolling_restart(self, term_grace_s=None):
+        """Restart every live replica in sequence: drain, restart
+        (process replicas get SIGTERM with the grace budget, then
+        SIGKILL), resume routing.  Zero requests fail by construction —
+        drained replicas finish their work before going down."""
+        grace = self.term_grace_s if term_grace_s is None \
+            else float(term_grace_s)
+        for rep in list(self.replicas):
+            if rep.state == DEAD:
+                continue
+            self.drain_replica(rep)
+            if rep.state == DEAD:
+                continue   # died mid-drain: failover already replayed
+            rep.restart(term_grace_s=grace)
+            rep._death_handled = False
+            self.metrics.restarts += 1
+            self.metrics.event(self.step_idx, "restart")
+
+    def restart_replica(self, rep, term_grace_s=None):
+        """Post-death recovery: bring a dead replica back with a fresh
+        scheduler/process and rejoin it to the routing pool."""
+        rep.restart(term_grace_s=self.term_grace_s if term_grace_s is None
+                    else term_grace_s)
+        rep._death_handled = False
+        self.metrics.restarts += 1
+
+    def drain_all(self, grace_s=None, shed_queued=True):
+        """Shutdown drain (the ds_serve SIGTERM path, cluster flavor):
+        shed what is still queued at the router, drain every replica
+        within the grace budget, shed the remainder distinctly."""
+        deadline = None if grace_s is None \
+            else time.monotonic() + float(grace_s)
+        if shed_queued:
+            for entry in self.journal.live():
+                if entry.state == jn.QUEUED:
+                    self._finalize(entry, jn.SHED,
+                                   "shutdown drain: still queued")
+        for rep in self.replicas:
+            if rep.state != DEAD:
+                rep.begin_drain()
+        while self.journal.has_live() or self._packets:
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            if not self.step():
+                break
+        for pkt in list(self._packets):
+            pkt.pool.free(pkt.pages)
+            self._finalize(pkt.entry, jn.SHED,
+                           "shutdown drain: grace budget exhausted")
+        self._packets.clear()
+        for entry in list(self.journal.live()):
+            self._finalize(entry, jn.SHED,
+                           "shutdown drain: grace budget exhausted")
+
+    # ------------------------------------------------------------ health
+    def health(self):
+        """Fleet snapshot: per-replica state + aggregate counters the
+        CI failover job asserts on (and uploads)."""
+        hits = lookups = reused = 0
+        for rep in self.replicas:
+            h, lo, tr = rep.prefix_stats()
+            hits += h
+            lookups += lo
+            reused += tr
+        return {
+            "step": self.step_idx,
+            "routing": self.routing,
+            "replicas": {
+                rep.id: {
+                    "state": rep.state, "role": rep.role,
+                    "group": None if rep.group is None else rep.group.name,
+                    "restarts": rep.restarts,
+                    "missed_beats": rep.missed_beats,
+                    "death_reason": getattr(rep, "death_reason", None),
+                    "load": rep.load() if rep.state != DEAD else None,
+                } for rep in self.replicas},
+            "prefill_workers_up": len(self._up("prefill")),
+            "decode_workers_up": len(self._up("decode")),
+            "unified_up": len([r for r in self._up()
+                               if r.role == "unified"]),
+            "disaggregated": self._has_prefill,
+            "degraded": self._has_prefill and
+            not self._candidates()[1],
+            "queued": sum(1 for e in self.journal.live()
+                          if e.state == jn.QUEUED),
+            "live_requests": len(self.journal.live()),
+            "packets_pending": len(self._packets),
+            "aggregate_prefix_hit_rate":
+                round(hits / lookups, 4) if lookups else 0.0,
+            "aggregate_tokens_reused": reused,
+            **self.metrics.summary(),
+        }
+
+
+# ----------------------------------------------------------- builders
+
+def make_local_fleet(engine, n, *, id_prefix="replica", **sched_kw):
+    """N unified in-process replicas over one engine (separate pools
+    and schedulers, shared compiled primitives)."""
+    def factory():
+        return ServingScheduler(engine, **sched_kw)
+    return [LocalReplica(f"{id_prefix}{i}", factory) for i in range(n)]
+
+
+def make_disaggregated_group(engine, *, name="g0", num_prefill=1,
+                             num_decode=1, num_pages=64, page_size=16,
+                             **sched_kw):
+    """A prefill/decode worker group: separate schedulers (separate
+    slot tables) over ONE shared page pool and ONE device-pools ref, so
+    a finished prompt's KV chain transfers by page id — zero copies."""
+    pool = PagePool(num_pages, page_size)
+    pools_ref = _PoolsRef(engine.init_paged_cache(num_pages, page_size))
+    group = DisaggGroup(name, pool, pools_ref)
+
+    def factory():
+        return ServingScheduler(engine, num_pages=num_pages,
+                                page_size=page_size, shared_pool=pool,
+                                pools_ref=pools_ref, **sched_kw)
+    reps = []
+    for i in range(num_prefill):
+        reps.append(LocalReplica(f"{name}-prefill{i}", factory,
+                                 role="prefill", group=group))
+    for i in range(num_decode):
+        reps.append(LocalReplica(f"{name}-decode{i}", factory,
+                                 role="decode", group=group))
+    return reps

@@ -1,0 +1,192 @@
+"""Process-backed engine replica: one ServingScheduler in its own
+process, driven over a JSONL stdin/stdout protocol.
+
+stdin ops (one JSON object per line):
+  {"op": "submit", "rid": ..., "prompt": [...], "max_new_tokens": N,
+   "eos_token_id": E?, "deadline_s": D?}
+  {"op": "cancel", "rid": ...}
+  {"op": "drain"}            # stop admitting, finish in-flight
+
+stdout events (one JSON object per line, flushed immediately — a token
+the router never read is a token the router will replay, so buffering
+here would manufacture duplicate work on a crash):
+  {"ev": "ready"}                          # engine built, serving
+  {"ev": "hb", "health": {...}}            # periodic health heartbeat
+  {"ev": "tok", "rid": ..., "t": ...}      # one generated token
+  {"ev": "done", "rid": ..., "status": ..., "tokens": [...],
+   "error": ...?}
+
+SIGTERM is the elastic-agent preemption notice: the worker drains
+in-flight requests within ``DS_PREEMPTION_GRACE_S`` (shedding the
+still-queued remainder distinctly) and exits 0.  SIGKILL — the failure
+the cluster tier exists to survive — is exactly what it looks like.
+"""
+
+import argparse
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+
+
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _build_engine(model_name, dtype="float32"):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2, gpt2_small, gpt2_tiny
+    from deepspeed_tpu.models.llama import Llama, llama_tiny
+
+    models = {
+        "gpt2-tiny": lambda: GPT2(gpt2_tiny()),
+        "gpt2-small": lambda: GPT2(gpt2_small()),
+        "llama-tiny": lambda: Llama(llama_tiny()),
+    }
+    engine = deepspeed_tpu.init_inference(
+        models[model_name](), dtype=dtype, kv_cache_dtype=dtype,
+        mesh={"data": 1, "model": 1})
+    # seeded init: every worker of the same model config holds the SAME
+    # params, so a failover replay onto a different worker continues
+    # the greedy stream token-exact
+    engine.init_params(seed=0)
+    return engine
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="gpt2-tiny")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--num-slots", type=int, default=3)
+    p.add_argument("--num-pages", type=int, default=32)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-slot", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=8)
+    p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--hb-interval-s", type=float, default=0.2)
+    p.add_argument("--threefry-partitionable", action="store_true",
+                   help="mirror the parent's jax_threefry_partitionable "
+                        "setting: PRNG semantics feed init_params, and "
+                        "a failover replay is only token-exact across "
+                        "processes when every worker holds bitwise-"
+                        "identical params")
+    args = p.parse_args(argv)
+
+    if args.threefry_partitionable:
+        import jax
+        jax.config.update("jax_threefry_partitionable", True)
+
+    from deepspeed_tpu.serving.scheduler import (TERMINAL,
+                                                 ServingScheduler)
+
+    engine = _build_engine(args.model, args.dtype)
+    sched = ServingScheduler(
+        engine, num_slots=args.num_slots, num_pages=args.num_pages,
+        page_size=args.page_size,
+        max_pages_per_slot=args.max_pages_per_slot,
+        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache)
+
+    term = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *a: term.update(flag=True))
+
+    live = {}          # wire rid -> scheduler Request
+    eof = False
+    last_hb = 0.0
+    _emit({"ev": "ready"})
+
+    def on_token(req, tok):
+        _emit({"ev": "tok", "rid": req._wire_rid, "t": int(tok)})
+
+    def report(req):
+        row = {"ev": "done", "rid": req._wire_rid, "status": req.state,
+               "tokens": [int(t) for t in req.out_tokens]}
+        if req.error is not None:
+            row["error"] = req.error
+        _emit(row)
+
+    # stdin rides a reader thread: select()-then-readline() on a
+    # BUFFERED stream drops the tail of a multi-line burst (readline
+    # pulls the whole kernel buffer into Python's, so select sees an
+    # empty fd while ops sit unread) — a blocking reader thread has no
+    # such window
+    ops = queue.Queue()
+
+    def _stdin_reader():
+        for line in sys.stdin:
+            ops.put(line)
+        ops.put(None)           # EOF sentinel
+
+    threading.Thread(target=_stdin_reader, daemon=True).start()
+
+    def pump_stdin():
+        nonlocal eof
+        while not eof:
+            try:
+                line = ops.get_nowait()
+            except queue.Empty:
+                return
+            if line is None:    # router hung up: drain and leave
+                eof = True
+                term["flag"] = True
+                return
+            line = line.strip()
+            if not line:
+                continue
+            op = json.loads(line)
+            kind = op.get("op")
+            if kind == "submit":
+                try:
+                    req = sched.submit(
+                        op["prompt"], op.get("max_new_tokens", 32),
+                        eos_token_id=op.get("eos_token_id"),
+                        deadline_s=op.get("deadline_s"),
+                        on_token=on_token)
+                except Exception as e:
+                    _emit({"ev": "done", "rid": op["rid"],
+                           "status": "shed", "tokens": [],
+                           "error": f"{type(e).__name__}: {e}"})
+                    continue
+                req._wire_rid = op["rid"]
+                if req.state in TERMINAL:   # max_new_tokens=0 parity
+                    report(req)
+                else:
+                    live[op["rid"]] = req
+            elif kind == "cancel":
+                req = live.get(op.get("rid"))
+                if req is not None:
+                    req.cancel()
+            elif kind == "drain":
+                sched.begin_drain(shed_waiting=False)
+
+    while True:
+        pump_stdin()
+        if term["flag"]:
+            break
+        work = sched.step() if (sched.requests or sched._inflight or
+                                sched._pending_attach) else False
+        for rid in [r for r, req in live.items()
+                    if req.state in TERMINAL]:
+            report(live.pop(rid))
+        now = time.monotonic()
+        if now - last_hb >= args.hb_interval_s:
+            _emit({"ev": "hb", "health": sched.health()})
+            last_hb = now
+        if not work:
+            time.sleep(0.01)
+
+    # SIGTERM drain: finish in-flight within the supervisor's grace
+    # budget, shed the rest distinctly, report every outcome
+    grace = float(os.environ.get("DS_PREEMPTION_GRACE_S", 10.0))
+    sched.drain(grace_s=grace, shed_waiting=True)
+    for rid in list(live):
+        report(live.pop(rid))
+    _emit({"ev": "hb", "health": sched.health()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

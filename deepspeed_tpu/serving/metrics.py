@@ -53,6 +53,8 @@ class ServingMetrics:
         self.spec_slot_rounds = 0      # (slot, round) pairs that proposed
         self.spec_degraded = 0         # drafter/verify faults contained
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
+        self.handoffs = 0              # prefill->decode KV chains handed
+        self.handoff_tokens = 0        # prefilled positions transferred
         self.mesh_info = {}            # serving topology (record_mesh)
         self._events = []
 
@@ -200,6 +202,16 @@ class ServingMetrics:
         return self.spec_accepted / self.spec_slot_rounds \
             if self.spec_slot_rounds else 0.0
 
+    def record_handoff(self, step, tokens):
+        """One prefill->decode KV handoff: ``tokens`` prefilled
+        positions changed owners without a byte of KV copied."""
+        self.handoffs += 1
+        self.handoff_tokens += tokens
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serving/handoff", 1, step),
+                ("serving/handoff_tokens", tokens, step)])
+
     def record_first_token(self, step, ttft_s):
         self.ttft_s.append(ttft_s)
         self.tokens_emitted += 1
@@ -275,7 +287,73 @@ class ServingMetrics:
             "spec_rollbacks": self.spec_rollbacks,
             "spec_rollback_tokens": self.spec_rollback_tokens,
             "spec_degraded": self.spec_degraded,
+            "handoffs": self.handoffs,
+            "handoff_tokens": self.handoff_tokens,
         }
         if wall_s:
             out["tokens_per_sec"] = round(self.tokens_emitted / wall_s, 2)
         return out
+
+
+class ClusterMetrics:
+    """Router-tier counters: what the fleet did with requests, kept
+    separate from each replica's own :class:`ServingMetrics` (an
+    operator must see "one replica died and its work replayed" even
+    when every per-replica summary looks clean).  Events ride the same
+    ``write_events`` monitor contract under ``cluster/``."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.submitted = 0            # journal admissions (deduped rids)
+        self.duplicate_rids = 0       # idempotent re-submissions absorbed
+        self.routed = 0               # request->replica assignments
+        self.finished = 0
+        self.failed = 0
+        self.shed = 0
+        self.cancelled = 0
+        self.replays = 0              # requests replayed off a dead replica
+        self.replayed_tokens = 0      # emitted tokens folded into replays
+        self.failovers = 0            # replica deaths detected
+        self.retries = 0              # backpressure resubmission attempts
+        self.heartbeat_misses = 0
+        self.drains = 0               # replica drains completed
+        self.restarts = 0
+        self.handoffs = 0             # prefill->decode packets delivered
+        self.degraded_routes = 0      # routed unified for lack of a
+                                      # healthy prefill worker
+
+    def event(self, step, tag, value=1):
+        if self.monitor is not None:
+            self.monitor.write_events([(f"cluster/{tag}", value,
+                                        max(1, step))])
+
+    def record_terminal(self, step, state):
+        if state == "finished":
+            self.finished += 1
+        elif state == "failed":
+            self.failed += 1
+        elif state == "shed":
+            self.shed += 1
+        elif state == "cancelled":
+            self.cancelled += 1
+        self.event(step, state)
+
+    def summary(self):
+        return {
+            "submitted": self.submitted,
+            "duplicate_rids": self.duplicate_rids,
+            "routed": self.routed,
+            "finished": self.finished,
+            "failed": self.failed,
+            "shed": self.shed,
+            "cancelled": self.cancelled,
+            "replays": self.replays,
+            "replayed_tokens": self.replayed_tokens,
+            "failovers": self.failovers,
+            "retries": self.retries,
+            "heartbeat_misses": self.heartbeat_misses,
+            "drains": self.drains,
+            "restarts": self.restarts,
+            "handoffs": self.handoffs,
+            "degraded_routes": self.degraded_routes,
+        }

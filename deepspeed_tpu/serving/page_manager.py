@@ -138,8 +138,21 @@ class PagedKVManager:
     bounds; the attention mask (driven by lengths) hides them.
     """
 
-    def __init__(self, num_pages, page_size, num_slots, max_pages_per_slot):
-        self.pool = PagePool(num_pages, page_size)
+    def __init__(self, num_pages, page_size, num_slots, max_pages_per_slot,
+                 pool=None):
+        # ``pool=`` shares one PagePool between several managers: the
+        # disaggregated serving tier runs a prefill worker and a decode
+        # worker as separate schedulers (separate slot tables) over ONE
+        # physical page pool, so a prefill slot's chain can transfer to
+        # a decode slot without copying a byte of KV
+        if pool is None:
+            pool = PagePool(num_pages, page_size)
+        elif pool.num_pages != int(num_pages) or \
+                pool.page_size != int(page_size):
+            raise ValueError(
+                f"shared pool is {pool.num_pages}x{pool.page_size}, "
+                f"manager wants {num_pages}x{page_size}")
+        self.pool = pool
         self.num_slots = int(num_slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
         self.table = np.zeros((num_slots, max_pages_per_slot), np.int32)
@@ -202,6 +215,25 @@ class PagedKVManager:
                 f"prefix of {len(pages)} pages > max_pages_per_slot="
                 f"{self.max_pages_per_slot}")
         self.pool.share(pages)
+        for i, p in enumerate(pages):
+            self.table[slot, i] = p
+        self._slot_pages[slot] = list(pages)
+
+    def adopt_chain(self, slot, pages):
+        """Seed an EMPTY slot with an already-owned page chain (the
+        prefill->decode KV handoff: a prefill worker's
+        ``take_slot_pages`` detached the chain with its pool references
+        intact, and adoption transfers that ownership to this slot —
+        unlike :meth:`attach_prefix`, NO new holder is added, because
+        the chain changes hands rather than gaining a reader)."""
+        if self._slot_pages[slot]:
+            raise ValueError(
+                f"slot {slot} already holds pages; a handoff chain must "
+                "seed an empty slot")
+        if len(pages) > self.max_pages_per_slot:
+            raise ValueError(
+                f"handoff chain of {len(pages)} pages > "
+                f"max_pages_per_slot={self.max_pages_per_slot}")
         for i, p in enumerate(pages):
             self.table[slot, i] = p
         self._slot_pages[slot] = list(pages)

@@ -268,5 +268,15 @@ class PrefixCache:
             results[id(node)] = (count, ok)
         return results[id(self._root)][0]
 
+    def prefix_len(self, tokens, limit=None):
+        """Fingerprint export for the cluster router: how many leading
+        tokens of ``tokens`` this cache could serve RIGHT NOW (whole
+        matched pages plus the best copy-on-write partial).  Pure
+        lookup — no refcounts move, no LRU touch, no stats — so the
+        prefix-aware router can score every replica per admission
+        without perturbing any cache."""
+        full, _, plen = self.match(tokens, limit=limit)
+        return len(full) * self.page_size + plen
+
     def hit_rate(self):
         return self.hits / self.lookups if self.lookups else 0.0

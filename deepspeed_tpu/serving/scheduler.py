@@ -123,7 +123,25 @@ from deepspeed_tpu.serving.prefix_cache import PrefixCache
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
 CANCELLED, FAILED, SHED = "cancelled", "failed", "shed"
-TERMINAL = (FINISHED, CANCELLED, FAILED, SHED)
+# HANDOFF: a prefill-worker request whose finished prompt KV (page
+# chain + first token) was handed to a decode worker — terminal for
+# THIS scheduler, live for the cluster request it belongs to
+HANDOFF = "handoff"
+TERMINAL = (FINISHED, CANCELLED, FAILED, SHED, HANDOFF)
+
+
+class _PoolsRef:
+    """Mutable holder for the device-resident KV pools.  The jitted
+    primitives are functional — every dispatch consumes the pools and
+    returns replacements — so two schedulers sharing one physical pool
+    (a disaggregated prefill/decode pair) must also share ONE mutable
+    reference to the current arrays, or one side would keep dispatching
+    against donated-away buffers."""
+
+    __slots__ = ("pools",)
+
+    def __init__(self, pools):
+        self.pools = pools
 
 
 class QueueFull(RuntimeError):
@@ -151,6 +169,7 @@ class Request:
         self.prefill_pos = 0
         self.cached_prefix_tokens = 0   # prefix-cache reuse at last admit
         self.error = None            # reason string for failed/shed
+        self.handoff = False         # prefill-worker mode (see submit)
         self.cancelled = False
         self.t_submit = time.monotonic()
         self.deadline = None if deadline_s is None \
@@ -185,7 +204,8 @@ class ServingScheduler:
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
-                 spec_decode=None, spec_k=8, spec_drafter=None):
+                 spec_decode=None, spec_k=8, spec_drafter=None,
+                 shared_pool=None, pools_ref=None, on_handoff=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
@@ -195,14 +215,27 @@ class ServingScheduler:
         if max_pages_per_slot is None:
             max_pages_per_slot = -(-num_pages // 2) or 1
         self.kv = PagedKVManager(num_pages, page_size, num_slots,
-                                 max_pages_per_slot)
+                                 max_pages_per_slot, pool=shared_pool)
         # radix prefix cache: finished requests donate their full pages
         # to a token-keyed index; admissions longest-prefix match and
         # share the chain read-only. Cached pages are reclaimable
         # capacity (LRU-drained under pool pressure), never a leak.
         self.prefix_cache = None if not prefix_cache else PrefixCache(
             self.kv.pool, max_pages=prefix_cache_pages)
-        self.pools = engine.init_paged_cache(num_pages, page_size)
+        # the device pools live behind a mutable ref so a disaggregated
+        # prefill/decode pair (two schedulers, one physical pool) sees
+        # each other's functional updates; standalone schedulers own a
+        # private ref and behave exactly as before
+        if pools_ref is None:
+            pools_ref = _PoolsRef(engine.init_paged_cache(num_pages,
+                                                          page_size))
+        self._pools_ref = pools_ref
+        # prefill-worker hook: a request submitted with handoff=True
+        # finishes its prompt, emits the boundary token, and hands its
+        # page chain to this callback instead of decoding on
+        self.on_handoff = on_handoff
+        self._pending_attach = deque()  # handoff chains awaiting a slot
+        self.draining = False
         # mesh topology snapshot: the pools (and weights) are live on
         # the engine's device mesh now — record the shape and per-device
         # KV footprint once so health()/monitor sinks expose the actual
@@ -290,13 +323,27 @@ class ServingScheduler:
             self._spec = None
             self.spec_mode = "off (sampled mode)"
 
+    @property
+    def pools(self):
+        return self._pools_ref.pools
+
+    @pools.setter
+    def pools(self, value):
+        self._pools_ref.pools = value
+
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
-               on_token=None, deadline_s=None):
+               on_token=None, deadline_s=None, handoff=False):
         """Queue a request; raises :class:`QueueFull` at max_queue (the
         backpressure signal callers turn into 429/retry). ``deadline_s``
         is a relative budget: a request that cannot finish inside it is
-        shed instead of served late."""
+        shed instead of served late.  ``handoff=True`` marks a
+        prefill-worker request: it stops after the boundary token and
+        hands its KV page chain to ``on_handoff`` (disaggregated
+        serving)."""
+        if self.draining:
+            raise QueueFull("scheduler is draining (shutdown/restart in "
+                            "progress); resubmit elsewhere")
         if len(self.waiting) >= self.max_queue:
             raise QueueFull(
                 f"waiting queue at max_queue={self.max_queue}")
@@ -309,6 +356,7 @@ class ServingScheduler:
                 "(min(max_pages_per_slot, num_pages) * page_size)")
         req = Request(prompt, max_new_tokens, eos_token_id, on_token,
                       deadline_s=deadline_s)
+        req.handoff = bool(handoff)
         if req.max_new_tokens <= 0:
             # parity with generate(max_new_tokens=0): nothing to emit —
             # but it still counts as completed, so health()/summary
@@ -580,7 +628,9 @@ class ServingScheduler:
             # 1. cancellations + deadlines leave at the boundary
             self._sweep(now)
             # 2. admit waiting requests into free slots (retirement
-            # happens at harvest, so slots are already recycled)
+            # happens at harvest, so slots are already recycled);
+            # handoff chains go first — their pages are already held
+            self._admit_attached(now)
             self._admit(now)
             # 3. one prompt chunk per prefilling slot (chunked prefill)
             self._prefill()
@@ -606,7 +656,8 @@ class ServingScheduler:
             device_wait_s=t_wait, host_s=max(0.0, dt - t_wait),
             cached_pages=None if self.prefix_cache is None
             else self.prefix_cache.cached_pages)
-        return bool(self.waiting) or n_running > 0 or bool(self._inflight)
+        return bool(self.waiting) or n_running > 0 or \
+            bool(self._inflight) or bool(self._pending_attach)
 
     # ------------------------------------------------- boundary phases
     def _admit(self, now):
@@ -760,9 +811,162 @@ class ServingScheduler:
                 continue
             if req._finished_by(tok):
                 self._retire(slot)
+            elif req.handoff and self.on_handoff is not None:
+                self._do_handoff(slot, req, tok)
             else:
                 self.last_tok[slot] = tok
                 req.state = RUNNING
+
+    # ------------------------------------------------ disaggregated KV
+    def _do_handoff(self, slot, req, tok):
+        """Prefill-worker epilogue: the prompt's KV is complete and the
+        boundary token is emitted — detach the slot's page chain (pool
+        references travel with it) and hand (pages, prefilled length,
+        boundary token) to ``on_handoff`` for a decode worker to adopt.
+        The callback is cluster code and therefore contained: if it
+        raises, the pages go back to the pool and THIS request fails —
+        never the prefill loop."""
+        pages = self.kv.take_slot_pages(slot)
+        plen = int(self.lengths[slot])
+        self.slot_req[slot] = None
+        self.lengths[slot] = 0
+        try:
+            self.on_handoff(req, pages, plen, tok)
+        except Exception as e:
+            self.kv.pool.free(pages)
+            self._finalize(req, FAILED, f"handoff: {type(e).__name__}: {e}")
+            self.metrics.record_terminal(self.step_idx, FAILED, req.rid,
+                                         req.error)
+            return
+        self._finalize(req, HANDOFF)
+        self.metrics.record_handoff(self.step_idx, plen)
+
+    def attach_handoff(self, prompt, pages, length, first_tok, *,
+                       max_new_tokens, eos_token_id=None, on_token=None,
+                       deadline_s=None):
+        """Decode-worker intake for a prefill worker's donated chain:
+        the request joins with its prompt KV already written (``pages``
+        cover ``length`` prefilled positions in the SHARED pool) and its
+        first token already emitted by the prefill worker.  It slots in
+        as a RUNNING decoder — no prefill dispatch ever runs here — at
+        the next admission boundary.  Until a slot frees up the chain
+        waits in ``_pending_attach`` still holding its pages (bounded:
+        the cluster router only hands off what the decode side's queue
+        can absorb)."""
+        if self.draining:
+            raise QueueFull("scheduler is draining; handoff refused")
+        req = Request(prompt, max_new_tokens, eos_token_id, on_token,
+                      deadline_s=deadline_s)
+        now = time.monotonic()
+        # the boundary token was emitted (and TTFT recorded) by the
+        # prefill worker; seeding t_first keeps _emit on the inter-token
+        # branch so this scheduler never double-counts a first token
+        req.out_tokens = [int(first_tok)]
+        req.t_first = req.t_last = now
+        req.prefill_pos = len(req.prompt)
+        req._attach = (list(pages), int(length), int(first_tok))
+        if req.remaining_new <= 0:
+            self.kv.pool.free(req._attach[0])
+            req.state = FINISHED
+            self.completed.append(req)
+            self.metrics.record_completion(self.step_idx)
+            return req
+        self.requests[req.rid] = req
+        self._pending_attach.append(req)
+        return req
+
+    def _admit_attached(self, now):
+        """Seed pending handoff chains into free slots ahead of the
+        waiting queue (their pages are already allocated — parking them
+        longer than necessary only starves the pool)."""
+        for slot in range(self.num_slots):
+            if not self._pending_attach:
+                return
+            if self.slot_req[slot] is not None or slot in self._zombies:
+                continue
+            req = self._pending_attach.popleft()
+            pages, length, tok = req._attach
+            if req.cancelled or req.past_deadline(now):
+                self.kv.pool.free(pages)
+                state = CANCELLED if req.cancelled else SHED
+                reason = "cancelled" if req.cancelled \
+                    else "deadline expired before attach"
+                self._finalize(req, state, reason)
+                self.metrics.record_terminal(self.step_idx, state,
+                                             req.rid, reason)
+                continue
+            try:
+                self.kv.adopt_chain(slot, pages)
+            except Exception as e:   # containment: a chain this slot
+                # table cannot hold fails ONE request, not the loop
+                self.kv.pool.free(pages)
+                self._finalize(req, FAILED, f"{type(e).__name__}: {e}")
+                self.metrics.record_terminal(self.step_idx, FAILED,
+                                             req.rid, req.error)
+                continue
+            self.slot_req[slot] = req
+            self.lengths[slot] = length
+            self.last_tok[slot] = tok
+            self._eos_ids[slot] = -1 if req.eos_token_id is None \
+                else int(req.eos_token_id)
+            req.t_admit = now
+            req.state = RUNNING
+
+    # ----------------------------------------------------------- drain
+    def begin_drain(self, shed_waiting=False):
+        """Enter drain mode: ``submit``/``attach_handoff`` refuse new
+        work (QueueFull — the router's signal to route elsewhere) while
+        everything already accepted keeps being served.  With
+        ``shed_waiting`` the not-yet-admitted queue is shed NOW with a
+        distinct reason instead of silently vanishing at process exit —
+        the ds_serve SIGTERM contract."""
+        self.draining = True
+        if shed_waiting:
+            while self.waiting:
+                self._drop_waiting(self.waiting.popleft(), SHED,
+                                   "shutdown drain: still queued")
+            while self._pending_attach:
+                req = self._pending_attach.popleft()
+                self.kv.pool.free(req._attach[0])
+                self._finalize(req, SHED, "shutdown drain: still queued")
+                self.metrics.record_terminal(self.step_idx, SHED, req.rid,
+                                             req.error)
+
+    def drain(self, grace_s=None, shed_waiting=True):
+        """Drain for shutdown/restart: stop admitting new work, finish
+        what is in flight within ``grace_s`` (None = no deadline), then
+        shed — distinctly, with reasons — whatever the grace budget
+        could not cover.  Returns ``{"finished": n, "shed": n}`` for the
+        requests that were live when the drain began."""
+        before = self.metrics.completed
+        shed_before = self.metrics.shed
+        self.begin_drain(shed_waiting=shed_waiting)
+        deadline = None if grace_s is None \
+            else time.monotonic() + float(grace_s)
+        while deadline is None or time.monotonic() < deadline:
+            if not self.step():
+                break
+        # grace exhausted with work still live: harvest every in-flight
+        # horizon first (the device may still be writing those pages),
+        # then shed the survivors instead of losing them silently
+        while self._inflight:
+            self._harvest()
+        for slot in range(self.num_slots):
+            if self.slot_req[slot] is not None:
+                self._close_slot(slot, SHED, "shutdown drain: grace "
+                                 "budget exhausted mid-flight")
+        while self.waiting:
+            self._drop_waiting(self.waiting.popleft(), SHED,
+                               "shutdown drain: grace budget exhausted")
+        while self._pending_attach:
+            req = self._pending_attach.popleft()
+            self.kv.pool.free(req._attach[0])
+            self._finalize(req, SHED, "shutdown drain: grace budget "
+                           "exhausted")
+            self.metrics.record_terminal(self.step_idx, SHED, req.rid,
+                                         req.error)
+        return {"finished": self.metrics.completed - before,
+                "shed": self.metrics.shed - shed_before}
 
     # -------------------------------------------------- horizon decode
     def _bucket_floor(self, h):
@@ -1357,6 +1561,9 @@ class ServingScheduler:
             "spec_rollbacks": m.spec_rollbacks,
             "spec_degraded": m.spec_degraded,
             "inflight_horizons": len(self._inflight),
+            "draining": self.draining,
+            "handoffs": m.handoffs,
+            "pending_handoffs": len(self._pending_attach),
             "completed": m.completed,
             "failed": m.failed,
             "shed": m.shed,

@@ -406,3 +406,55 @@ def test_serving_slow_step_injection_feeds_ema(gpt2_engine):
     with faults.injected(inj):
         got = sched.run()
     assert got[r.rid] and sched._ema_step_s > 0.005
+
+
+# ------------------------------------------- elastic agent classification
+
+
+def test_elastic_monitor_classification_is_deterministic():
+    """The _monitor race fix: classification is a pure function of the
+    observed process states + epoch flag.  A genuine local failure is
+    `failed` even when a peer's epoch bump lands concurrently (the old
+    ordering returned peer_restart there, losing the rc and the failure
+    log — signal_restart's CAS makes the `failed` path safe either
+    way); peer_restart is reserved for locals that are alive or exited
+    clean under teardown skew."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    classify = DSElasticAgent._classify
+
+    # clean finish, regardless of the epoch (never touch the store)
+    assert classify([0, 0], False) == ("ok", 0)
+    assert classify([0, 0], True) == ("ok", 0)
+    # the regression: worker already dead rc=1 AND the epoch bump just
+    # landed — the old epoch-first ordering said peer_restart; the rc
+    # is local ground truth and must be reported
+    assert classify([1, None], True) == ("failed", 1)
+    assert classify([1, None], False) == ("failed", 1)
+    assert classify([None, 137], True) == ("failed", 137)
+    # peer restart: locals alive (or cleanly down) while the round moved
+    assert classify([None, None], True) == ("peer_restart", 0)
+    assert classify([0, None], True) == ("peer_restart", 0)
+    # nothing to report yet: keep polling
+    assert classify([None, None], False) == (None, 0)
+    assert classify([0, None], False) == (None, 0)
+
+
+def test_elastic_monitor_returns_failed_under_concurrent_epoch_bump():
+    """Integration shape of the same race: a dead worker is observed in
+    the same poll window as a peer's epoch bump — _monitor must return
+    ("failed", rc), not peer_restart."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    class _DeadProc:
+        def poll(self):
+            return 1
+
+    class _BumpedRdzv:
+        def current_epoch(self):
+            return 5          # watch_epoch is 4: the bump has landed
+
+    agent = DSElasticAgent.__new__(DSElasticAgent)
+    agent._procs = [_DeadProc()]
+    agent._rdzv = _BumpedRdzv()
+    agent.monitor_interval = 0.01
+    assert agent._monitor(watch_epoch=4) == ("failed", 1)

@@ -1,0 +1,125 @@
+"""Perf-floor check: fresh serving-bench JSON vs the committed results.
+
+The stepping stone to ROADMAP item 5's gating perf-regression check:
+compare a fresh ``serving_bench.py`` results file against the committed
+``benchmarks/serving_results_cpu.json`` with EXPLICIT noise bands and
+print a pass/warn table.  Non-gating by default (CI runners and the
+committed rig are different machines, so absolute tokens/s are
+reported informationally only); ``--gate`` flips warnings into a
+nonzero exit for the day the bands are trusted.
+
+What is compared (only sections present in BOTH files):
+
+* **ratio metrics** — speedups and hit rates are self-normalizing
+  (both sides of each ratio ran on the same machine in the same
+  process), so they transfer across rigs and carry a tight band:
+  ``speedup_best_h_vs_h1``, continuous-vs-static ``speedup``,
+  prefix-share and spec-decode speedups, cluster hit-rate gain.
+* **tracing overhead** — ``tracing.overhead_frac`` must stay under an
+  absolute ceiling (the "tracing is near-free" contract).
+* **absolute tokens/s** — printed for trend visibility, never warned
+  on across rigs.
+
+Usage:
+  python benchmarks/perf_floor.py \
+      --committed benchmarks/serving_results_cpu.json \
+      --fresh serving_results_ci.json [--band 0.30] [--gate]
+"""
+
+import argparse
+import json
+import sys
+
+
+def _get(d, path):
+    for k in path.split("."):
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d if isinstance(d, (int, float)) else None
+
+
+# (label, json path, kind) — kind "ratio": fresh >= committed*(1-band);
+# "ceiling": fresh <= limit (committed value ignored for the bound);
+# "info": printed only
+CHECKS = [
+    ("horizon speedup (best H vs H=1)", "speedup_best_h_vs_h1", "ratio"),
+    ("continuous vs static speedup", "speedup", "ratio"),
+    ("prefix-cache speedup (shared)",
+     "prefix_share.shared.speedup_tokens_per_sec", "ratio"),
+    ("prefix-cache control (no share)",
+     "prefix_share.control.speedup_tokens_per_sec", "info"),
+    ("spec-decode speedup", "spec_decode.speedup_tokens_per_sec",
+     "ratio"),
+    ("cluster prefix hit rate",
+     "cluster.prefix.aggregate_prefix_hit_rate", "ratio"),
+    ("cluster hit-rate gain vs round-robin", "cluster.hit_rate_gain",
+     "info"),
+    ("tracing overhead frac", "tracing.overhead_frac", "ceiling"),
+    ("continuous tokens/s (best H)", "continuous.tokens_per_sec",
+     "info"),
+    ("tracing tokens/s (on)", "tracing.trace_on.tokens_per_sec",
+     "info"),
+]
+
+TRACING_OVERHEAD_CEILING = 0.05   # the committed <5% contract
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--committed",
+                   default="benchmarks/serving_results_cpu.json")
+    p.add_argument("--fresh", required=True)
+    p.add_argument("--band", type=float, default=0.30,
+                   help="allowed fractional regression on ratio metrics "
+                        "before a WARN (default 0.30 — CI-runner noise "
+                        "on 2-core machines is real)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 on any WARN (default: report only)")
+    args = p.parse_args()
+
+    with open(args.committed) as f:
+        committed = json.load(f)
+    with open(args.fresh) as f:
+        fresh = json.load(f)
+
+    rows = []
+    warns = 0
+    for label, path, kind in CHECKS:
+        c, fv = _get(committed, path), _get(fresh, path)
+        if kind == "ceiling":
+            if fv is None:
+                rows.append((label, c, fv, "SKIP"))
+                continue
+            ok = fv <= TRACING_OVERHEAD_CEILING + args.band * \
+                TRACING_OVERHEAD_CEILING
+            rows.append((label, TRACING_OVERHEAD_CEILING, fv,
+                         "PASS" if ok else "WARN"))
+            warns += not ok
+            continue
+        if c is None or fv is None:
+            rows.append((label, c, fv, "SKIP"))
+            continue
+        if kind == "info":
+            rows.append((label, c, fv, "INFO"))
+            continue
+        floor = c * (1.0 - args.band)
+        ok = fv >= floor
+        rows.append((label, c, fv, "PASS" if ok else "WARN"))
+        warns += not ok
+
+    w = max(len(r[0]) for r in rows)
+    print(f"perf floor vs {args.committed} "
+          f"(noise band {args.band:.0%}):")
+    print(f"{'metric':{w}s} {'committed':>12s} {'fresh':>12s} {'':>6s}")
+    for label, c, fv, verdict in rows:
+        cs = "-" if c is None else f"{c:.4g}"
+        fs = "-" if fv is None else f"{fv:.4g}"
+        print(f"{label:{w}s} {cs:>12s} {fs:>12s} {verdict:>6s}")
+    print(f"{warns} warning(s)")
+    if args.gate and warns:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,7 +111,8 @@ def make_spec_workload(vocab, n_requests, rate, seed, motif_len=8,
 
 def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
                    overlap=True, prefix_cache=False, spec_decode=None,
-                   spec_k=8, retry_max=6, retry_backoff_s=0.05):
+                   spec_k=8, retry_max=6, retry_backoff_s=0.05,
+                   tracer=None):
     from deepspeed_tpu.serving import QueueFull, ServingScheduler
     sched = ServingScheduler(
         engine, num_slots=cfg["num_slots"], num_pages=cfg["num_pages"],
@@ -119,7 +120,8 @@ def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
         max_pages_per_slot=cfg["max_pages_per_slot"],
         prefill_chunk=cfg["prefill_chunk"],
         decode_horizon_steps=horizon, overlap=overlap,
-        prefix_cache=prefix_cache, spec_decode=spec_decode, spec_k=spec_k)
+        prefix_cache=prefix_cache, spec_decode=spec_decode, spec_k=spec_k,
+        tracer=tracer)
     t0 = time.time()
     pending = list(zip(prompts, max_new, arrivals))
     submitted = []
@@ -456,6 +458,74 @@ def run_spec_decode(engine, vocab, cfg, args, horizon, overlap):
     return section
 
 
+_TRACE_KEYS = ("tokens_per_sec", "wall_s", "tokens", "ttft_ms_p50",
+               "ttft_ms_p99", "tbt_ms_p50", "tpot_ms_p50",
+               "device_wait_frac", "horizon_mean")
+
+
+def run_trace_overhead(engine, vocab, cfg, args, horizon, overlap):
+    """``--trace``: the standard mixed workload served with span
+    tracing OFF vs ON at identical settings — the committed results
+    carry an honest tracing-overhead number (tokens/s ratio), and one
+    traced repeat's per-request span JSON lands in ``--trace-out`` so
+    the artifact a CI reviewer opens in Perfetto is the same workload
+    the number describes.  Like the other deterministic comparisons
+    the best of ``--repeats`` replays is the least-perturbed
+    measurement of the same computation."""
+    from deepspeed_tpu.serving.trace import SpanTracer
+    section = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "overlap": overlap, "horizon": horizon,
+    }
+    prompts, max_new, arrivals = make_workload(
+        vocab, args.requests, args.rate, args.seed)
+    # warmup compiles every signature untimed (tracing cannot add any:
+    # it is host-only — the pinned test in test_trace.py proves it)
+    run_continuous(engine, prompts, max_new, arrivals, cfg,
+                   horizon=horizon, overlap=overlap)
+    # INTERLEAVED repeats (off, on, off, on, ...): rig-level drift
+    # (thermal/frequency ramps, cache warmth) otherwise lands entirely
+    # on whichever label ran second and masquerades as tracing
+    # overhead/speedup
+    results = {}
+    tracer = None
+    for _ in range(max(1, args.repeats)):
+        for label in ("trace_off", "trace_on"):
+            t = SpanTracer(process="bench") if label == "trace_on" \
+                else None
+            cand = run_continuous(engine, prompts, max_new, arrivals,
+                                  cfg, horizon=horizon, overlap=overlap,
+                                  tracer=t)
+            best = results.get(label)
+            if best is None or cand["tokens_per_sec"] > \
+                    best["tokens_per_sec"]:
+                results[label] = cand
+                if t is not None:
+                    tracer = t
+    for label, best in results.items():
+        section[label] = {k: best[k] for k in _TRACE_KEYS if k in best}
+    off = results["trace_off"]["tokens_per_sec"]
+    on = results["trace_on"]["tokens_per_sec"]
+    section["overhead_frac"] = round(1.0 - on / off, 4) if off else None
+    section["spans_recorded"] = len(tracer.events) + tracer.dropped
+    if args.trace_out:
+        tracer.dump(args.trace_out)
+        section["trace_file"] = args.trace_out
+    print(json.dumps({
+        "metric": "serving_tracing_overhead_frac",
+        "value": section["overhead_frac"], "unit": "frac",
+        "extra": {"tokens_per_sec_off": off, "tokens_per_sec_on": on,
+                  "spans": section["spans_recorded"]},
+    }))
+    if args.json_out:
+        _write_json_out(
+            args.json_out, "tracing", section,
+            {"model": args.model, "requests": args.requests,
+             "rate": args.rate, "serving_config": cfg,
+             "overlap": overlap, "tracing": section})
+    return section
+
+
 def make_family_workload(vocab, n_requests, rate, seed, n_families,
                          shared_len, tail_len):
     """The cluster-routing workload: ``n_families`` distinct shared
@@ -486,7 +556,7 @@ _CLUSTER_KEYS = ("tokens_per_sec", "wall_s", "tokens",
 
 def run_cluster_once(engine, prompts, max_new, arrivals, cfg, args,
                      horizon, overlap, routing, rolling_restart=False,
-                     kill_replica=None, kill_step=6):
+                     kill_replica=None, kill_step=6, trace=False):
     from deepspeed_tpu.resilience import faults
     from deepspeed_tpu.serving import ClusterRouter, make_local_fleet
 
@@ -496,7 +566,15 @@ def run_cluster_once(engine, prompts, max_new, arrivals, cfg, args,
         max_pages_per_slot=cfg["max_pages_per_slot"],
         prefill_chunk=cfg["prefill_chunk"], decode_horizon_steps=horizon,
         overlap=overlap, prefix_cache=True)
-    router = ClusterRouter(replicas, routing=routing)
+    tracer = flight = None
+    if trace and args.cluster_artifacts:
+        # the failover pass ships reviewable artifacts: the merged
+        # fleet trace plus the flight record the replica death triggers
+        from deepspeed_tpu.serving.trace import FlightRecorder, SpanTracer
+        tracer = SpanTracer(process="router")
+        flight = FlightRecorder(args.cluster_artifacts)
+    router = ClusterRouter(replicas, routing=routing, tracer=tracer,
+                           flight_recorder=flight)
     inj = None
     if kill_replica is not None:
         inj = faults.FaultInjector(seed=args.seed)
@@ -576,7 +654,7 @@ def run_cluster(engine, vocab, cfg, args, horizon, overlap):
     # journal + fleet health as artifacts
     fo, router = run_cluster_once(engine, prompts, max_new, arrivals,
                                   cfg, args, horizon, overlap, "prefix",
-                                  kill_replica="replica0")
+                                  kill_replica="replica0", trace=True)
     section["failover"] = {k: fo[k] for k in
                            tuple(_CLUSTER_KEYS) + ("lost",) if k in fo}
     if args.cluster_artifacts:
@@ -587,6 +665,11 @@ def run_cluster(engine, vocab, cfg, args, horizon, overlap):
                                "cluster_health.json"), "w") as f:
             json.dump(router.health(), f, indent=2)
             f.write("\n")
+        # the traced failover pass's fleet timeline (one process per
+        # replica, the killed replica's spans flow-linked to the
+        # survivor's replay) rides along with the journal
+        router.dump_trace(os.path.join(args.cluster_artifacts,
+                                       "fleet_trace.json"))
     if fo["lost"] or fo["failed"]:
         print(f"FAILOVER CHECK FAILED: lost={fo['lost']} "
               f"failed={fo['failed']}", file=sys.stderr)
@@ -681,6 +764,14 @@ def main():
     p.add_argument("--cluster-artifacts", default=None,
                    help="directory for the --cluster failover pass's "
                         "journal + fleet-health dumps (CI uploads them)")
+    p.add_argument("--trace", action="store_true",
+                   help="run the tracing-overhead workload instead: the "
+                        "standard mixed workload with span tracing OFF "
+                        "vs ON at identical settings (tokens/s overhead "
+                        "reported), dumping one traced repeat's "
+                        "per-request span JSON to --trace-out")
+    p.add_argument("--trace-out", default="serving_trace.json",
+                   help="Chrome-trace JSON destination for --trace")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json-out", default=None)
     args = p.parse_args()
@@ -721,6 +812,11 @@ def main():
 
     if args.spec_decode:
         run_spec_decode(engine, vocab, cfg, args, max(horizons), overlap)
+        return
+
+    if args.trace:
+        run_trace_overhead(engine, vocab, cfg, args, max(horizons),
+                           overlap)
         return
 
     # warmup: compile every signature both systems will hit (the serving

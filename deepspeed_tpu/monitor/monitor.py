@@ -19,6 +19,35 @@ class Monitor(ABC):
         pass
 
 
+_step_clamp_warned = set()   # tags already warned about (once per proc)
+
+
+def clamp_min_step(event_list, warn=True):
+    """Enforce the monitor-stream invariant ``step >= 1`` centrally.
+
+    Every sink indexes events by a positive step (TensorBoard's global
+    step, the CSV step column, wandb's step) — a 0/negative step either
+    errors or silently lands before the run's first point.  Rather than
+    each emitter hand-stamping (the old ``record_mesh`` workaround),
+    events pass through here: offending steps are clamped to 1 and, with
+    ``warn``, logged once per tag so the emitter can be fixed.  Emitters
+    with *documented* pre-step-1 events (serving construction-time
+    gauges) clamp with ``warn=False``."""
+    if all(e[2] >= 1 for e in event_list):
+        return event_list
+    out = []
+    for tag, value, step in event_list:
+        if step < 1:
+            if warn and tag not in _step_clamp_warned:
+                _step_clamp_warned.add(tag)
+                logger.warning(
+                    f"monitor event {tag!r} stamped with step {step} < 1;"
+                    " clamped to 1 (sinks index by positive step)")
+            step = 1
+        out.append((tag, value, step))
+    return out
+
+
 class TensorBoardMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
@@ -117,6 +146,8 @@ class MonitorMaster(Monitor):
         self.enabled = getattr(monitor_config, "enabled", False)
 
     def write_events(self, event_list):
+        # central invariant enforcement: no sink ever sees step < 1
+        event_list = clamp_min_step(event_list)
         if self.tb_monitor.enabled:
             self.tb_monitor.write_events(event_list)
         if self.wandb_monitor.enabled:

@@ -111,6 +111,10 @@ import numpy as np
 _active = None          # the installed injector (module-global, like a
 _lock = threading.Lock()  # logging root); serving/train loops are host
                           # threads, so arming is lock-protected
+_observers = []         # callbacks notified when an armed plan FIRES
+                        # (observability hooks: the serving flight
+                        # recorder dumps its recent-span window at the
+                        # exact moment injected chaos lands)
 
 
 class Injection:
@@ -193,6 +197,11 @@ class FaultInjector:
     def _record(self, plan, ctx):
         plan.fired += 1
         self.log.append((plan.point, ctx.get("step"), dict(ctx)))
+        for cb in list(_observers):
+            try:
+                cb(plan.point, dict(ctx))
+            except Exception:
+                pass    # an observer must never alter fault semantics
 
     def fire(self, point, **ctx):
         """Called from an instrumented site; raises or side-effects when
@@ -258,6 +267,23 @@ def uninstall():
 
 def get_injector():
     return _active
+
+
+def observe(callback):
+    """Register ``callback(point, ctx)`` to run whenever an armed plan's
+    action fires (AFTER the action is recorded, BEFORE any exception
+    propagates).  Observer errors are swallowed: observability must
+    never change fault semantics.  Returns the callback for
+    :func:`unobserve`."""
+    _observers.append(callback)
+    return callback
+
+
+def unobserve(callback):
+    try:
+        _observers.remove(callback)
+    except ValueError:
+        pass
 
 
 @contextlib.contextmanager

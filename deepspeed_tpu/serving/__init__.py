@@ -15,6 +15,12 @@ from deepspeed_tpu.serving.sharding import (SERVING_AXIS_RULES,  # noqa: F401
 from deepspeed_tpu.serving.spec_decode import (Drafter,  # noqa: F401
                                                DraftModelDrafter,
                                                NgramDrafter)
+from deepspeed_tpu.serving.trace import (EVENT_TAXONOMY,  # noqa: F401
+                                         NULL_TRACER,
+                                         FlightRecorder,
+                                         SpanTracer,
+                                         merge_chrome,
+                                         prometheus_text)
 from deepspeed_tpu.serving.scheduler import (CANCELLED,  # noqa: F401
                                              FAILED,
                                              FINISHED,

@@ -40,7 +40,7 @@ class JournalEntry:
                  "deadline_abs", "on_token", "emitted", "state", "error",
                  "attempts", "replays", "replica", "replica_history",
                  "handle", "next_try", "t_submit", "t_first", "t_last",
-                 "cancel_requested")
+                 "cancel_requested", "trace_flow")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  on_token=None, deadline_s=None):
@@ -64,6 +64,12 @@ class JournalEntry:
         self.t_first = None        # first delivered token (cluster TTFT)
         self.t_last = None
         self.cancel_requested = False
+        self.trace_flow = None     # open failover-replay flow-link id:
+                                   # set when a death replays this entry,
+                                   # closed (and cleared) when a survivor
+                                   # picks it up — the explicit
+                                   # dead-replica -> replay span link in
+                                   # the merged fleet trace
 
     @property
     def remaining_new(self):

@@ -59,25 +59,40 @@ class LocalReplica:
         self.restarts = 0
         self.last_health = None
         self._handoff_sink = None
+        # per-replica span tracer (serving/trace.py), owned by the
+        # REPLICA not the scheduler: a crash drops the scheduler but the
+        # dead replica's spans must survive into the merged fleet trace
+        # and the flight-recorder dump
+        self.tracer = None
+
+    def enable_trace(self, tracer):
+        """Router wiring: attach this replica's tracer (survives die/
+        restart — fresh schedulers are re-pointed at it)."""
+        self.tracer = tracer
+        if self.sched is not None:
+            self.sched.tracer = tracer
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               deadline_s=None, on_token=None, handoff=False):
+               deadline_s=None, on_token=None, handoff=False,
+               trace_ctx=None):
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
         return self.sched.submit(prompt, max_new_tokens,
                                  eos_token_id=eos_token_id,
                                  on_token=on_token, deadline_s=deadline_s,
-                                 handoff=handoff)
+                                 handoff=handoff, trace_ctx=trace_ctx)
 
     def attach(self, prompt, pages, length, first_tok, *, max_new_tokens,
-               eos_token_id=None, deadline_s=None, on_token=None):
+               eos_token_id=None, deadline_s=None, on_token=None,
+               trace_ctx=None):
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
         return self.sched.attach_handoff(
             prompt, pages, length, first_tok,
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
-            on_token=on_token, deadline_s=deadline_s)
+            on_token=on_token, deadline_s=deadline_s,
+            trace_ctx=trace_ctx)
 
     def set_handoff_sink(self, cb):
         """Router wiring for prefill workers: where finished-prompt KV
@@ -180,11 +195,16 @@ class LocalReplica:
 
     def die(self, reason):
         """Crash semantics: all scheduler state is lost; its pool
-        pages are reclaimed (see :meth:`_reclaim`)."""
+        pages are reclaimed (see :meth:`_reclaim`).  The tracer is NOT
+        scheduler state — the spans recorded up to the crash are
+        exactly what the flight recorder exists to keep."""
         if self.state == DEAD:
             return
         self.state = DEAD
         self.death_reason = reason
+        if self.tracer is not None:
+            self.tracer.instant("replica_death", cat="failover",
+                                args={"reason": str(reason)})
         sched, self.sched = self.sched, None
         self._reclaim(sched)
 
@@ -210,6 +230,8 @@ class LocalReplica:
         self.sched = self._factory()
         if self._handoff_sink is not None:
             self.sched.on_handoff = self._handoff_sink
+        if self.tracer is not None:
+            self.sched.tracer = self.tracer
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
@@ -252,7 +274,7 @@ class ProcessReplica:
     def __init__(self, replica_id, *, model="gpt2-tiny", num_slots=3,
                  num_pages=32, page_size=16, max_pages_per_slot=8,
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
-                 hb_timeout_s=60.0, env=None):
+                 hb_timeout_s=60.0, env=None, trace=False):
         self.id = replica_id
         self.state = UP
         self.death_reason = None
@@ -265,11 +287,31 @@ class ProcessReplica:
                          num_pages=num_pages, page_size=page_size,
                          max_pages_per_slot=max_pages_per_slot,
                          prefill_chunk=prefill_chunk,
-                         prefix_cache=prefix_cache)
+                         prefix_cache=prefix_cache, trace=bool(trace))
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
+        # worker-side spans, flushed over the JSONL protocol with each
+        # heartbeat (already epoch-µs-serialized by the worker).  Kept
+        # on the REPLICA so a SIGKILLed worker's last flushed window
+        # survives into the merged fleet trace / flight record — spans
+        # between the last flush and the kill die with the process,
+        # exactly like the requests the journal replays.
+        self.trace_events = deque(maxlen=8192)
         self._spawn()
+
+    def enable_trace(self, tracer=None):
+        """Turn on worker-side span tracing (now, and across restarts).
+        The optional ``tracer`` argument is accepted for interface
+        parity with LocalReplica and ignored — a process replica's
+        spans are recorded in the worker and shipped back serialized."""
+        if self._cfg["trace"]:
+            return
+        self._cfg["trace"] = True
+        try:
+            self._send({"op": "trace", "label": str(self.id)})
+        except Exception:
+            pass   # dying worker: the restart respawns with --trace
 
     # --------------------------------------------------------- process
     def _spawn(self):
@@ -283,6 +325,8 @@ class ProcessReplica:
                "--prefill-chunk", str(cfg["prefill_chunk"])]
         if cfg["prefix_cache"]:
             cmd.append("--prefix-cache")
+        if cfg["trace"]:
+            cmd += ["--trace", "--trace-label", str(self.id)]
         try:
             # forward PRNG semantics: seeded init only yields the SAME
             # params in the child when threefry partitioning matches
@@ -366,10 +410,13 @@ class ProcessReplica:
                 if h is not None:
                     h.state = ev.get("status", "finished")
                     h.error = ev.get("error")
+            elif kind == "spans":
+                self.trace_events.extend(ev.get("spans") or [])
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               deadline_s=None, on_token=None, handoff=False):
+               deadline_s=None, on_token=None, handoff=False,
+               trace_ctx=None):
         if handoff:
             raise ValueError("process replicas serve unified only")
         if self.state != UP:
@@ -378,11 +425,16 @@ class ProcessReplica:
         self._next_rid += 1
         handle = _RemoteHandle(rid, on_token, self)
         self._handles[rid] = handle
-        self._send({"op": "submit", "rid": rid,
-                    "prompt": [int(t) for t in prompt],
-                    "max_new_tokens": int(max_new_tokens),
-                    "eos_token_id": eos_token_id,
-                    "deadline_s": deadline_s})
+        op = {"op": "submit", "rid": rid,
+              "prompt": [int(t) for t in prompt],
+              "max_new_tokens": int(max_new_tokens),
+              "eos_token_id": eos_token_id,
+              "deadline_s": deadline_s}
+        if trace_ctx is not None:
+            # the trace id crosses the process boundary with the
+            # request, so worker-side spans carry the journal rid
+            op["trace"] = trace_ctx
+        self._send(op)
         return handle
 
     def prefix_match_len(self, tokens):

@@ -34,6 +34,7 @@ several replicas), and client output is **exactly-once** (the journal
 is the only token path and drops post-terminal stragglers).
 """
 
+import json
 import time
 from collections import deque
 
@@ -88,7 +89,8 @@ class ClusterRouter:
 
     def __init__(self, replicas, *, routing="prefix", retry_max=3,
                  retry_backoff_s=0.02, heartbeat_misses=3, monitor=None,
-                 seed=0, term_grace_s=10.0):
+                 seed=0, term_grace_s=10.0, tracer=None,
+                 flight_recorder=None):
         if routing not in ("prefix", "round_robin"):
             raise ValueError(f"unknown routing policy {routing!r}")
         self.replicas = list(replicas)
@@ -105,6 +107,32 @@ class ClusterRouter:
         self._by_handle = {}     # id(replica handle) -> journal entry
         self._packets = deque()
         self._has_prefill = any(r.role == "prefill" for r in self.replicas)
+        # fleet tracing: the router records routing/failover/handoff
+        # spans under its own process label and hands every replica a
+        # tracer of its own (the replica keeps it across die/restart);
+        # dump_trace() merges the lot into ONE Chrome-trace JSON — one
+        # process per replica, the rid linking a request's spans across
+        # them.  flight_recorder (serving/trace.FlightRecorder) dumps
+        # every source's recent-span window on replica death, correlated
+        # with the journal entries that were in flight.
+        self.tracer = tracer
+        self.flight = flight_recorder
+        if tracer is not None:
+            from deepspeed_tpu.serving.trace import SpanTracer
+            for rep in self.replicas:
+                if hasattr(rep, "enable_trace") and \
+                        getattr(rep, "tracer", None) is None:
+                    rep.enable_trace(SpanTracer(process=str(rep.id)))
+        if self.flight is not None:
+            if tracer is not None:
+                self.flight.register("router", tracer)
+            for rep in self.replicas:
+                if getattr(rep, "tracer", None) is not None:
+                    self.flight.register(str(rep.id), rep.tracer)
+                elif hasattr(rep, "trace_events"):
+                    self.flight.register(
+                        str(rep.id),
+                        (lambda r: (lambda: list(r.trace_events)))(rep))
         for rep in self.replicas:
             if rep.role == "prefill" and hasattr(rep, "set_handoff_sink"):
                 rep.set_handoff_sink(self._make_handoff_sink(rep))
@@ -201,11 +229,27 @@ class ClusterRouter:
                 "missed heartbeats")
         self.metrics.failovers += 1
         self.metrics.event(self.step_idx, "failover")
-        for entry in self.journal.live():
-            if entry.state == jn.ROUTED and entry.replica == rep.id:
-                self._replay(entry)
+        stranded = [e for e in self.journal.live()
+                    if e.state == jn.ROUTED and e.replica == rep.id]
+        if self.tracer is not None:
+            self.tracer.instant(
+                "replica_death", cat="failover", process=str(rep.id),
+                args={"reason": getattr(rep, "death_reason", None),
+                      "stranded": len(stranded)})
+        if self.flight is not None:
+            # the post-mortem bundle: the recent-span window from every
+            # source, correlated with the journal entries that were in
+            # flight on the dead replica (their snapshots carry the
+            # replica chain the replay will extend)
+            self.flight.dump(
+                f"replica_death:{rep.id}",
+                journal_entry=[e.snapshot() for e in stranded],
+                extra={"death_reason": getattr(rep, "death_reason",
+                                               None)})
+        for entry in stranded:
+            self._replay(entry, dead_replica=rep.id)
 
-    def _replay(self, entry):
+    def _replay(self, entry, dead_replica=None):
         """Zero-lost failover: requeue a dead replica's entry with its
         delivered tokens folded into the prompt.  If the emitted stream
         already satisfies the request, finalize instead (a death racing
@@ -223,6 +267,17 @@ class ClusterRouter:
         self.metrics.replays += 1
         self.metrics.replayed_tokens += len(entry.emitted)
         self.metrics.event(self.step_idx, "replay")
+        if self.tracer is not None:
+            # open the explicit dead-replica -> survivor flow link; the
+            # matching "f" event lands when _route places the replay
+            entry.trace_flow = f"replay:{entry.rid}:{entry.replays}"
+            self.tracer.flow(
+                "s", entry.trace_flow, "failover_replay",
+                rid=entry.rid,
+                process=None if dead_replica is None
+                else str(dead_replica),
+                args={"replays": entry.replays,
+                      "tokens_folded": len(entry.emitted)})
 
     # ---------------------------------------------------------- routing
     def _up(self, role=None):
@@ -303,7 +358,9 @@ class ClusterRouter:
                     eos_token_id=entry.eos_token_id,
                     deadline_s=deadline_s,
                     on_token=self._make_token_sink(entry),
-                    handoff=handoff)
+                    handoff=handoff,
+                    trace_ctx=None if self.tracer is None else
+                    {"trace_id": entry.rid, "attempt": entry.replays})
             except ReplicaKilled:
                 continue    # heartbeat pass will handle the body
             except ValueError as e:
@@ -323,6 +380,20 @@ class ClusterRouter:
             entry.handle = handle
             self._by_handle[id(handle)] = entry
             self.metrics.routed += 1
+            if self.tracer is not None:
+                if entry.trace_flow is not None:
+                    # close the failover link on the survivor's track
+                    self.tracer.flow("f", entry.trace_flow,
+                                     "failover_replay", rid=entry.rid,
+                                     process=str(rep.id))
+                    entry.trace_flow = None
+                self.tracer.instant(
+                    "route", cat="routing", rid=entry.rid,
+                    process=str(rep.id),
+                    args={"replica": str(rep.id),
+                          "attempt": entry.attempts,
+                          "replays": entry.replays,
+                          "handoff": handoff})
 
     def _make_token_sink(self, entry):
         journal = self.journal
@@ -389,7 +460,9 @@ class ClusterRouter:
                     eos_token_id=entry.eos_token_id,
                     deadline_s=None if entry.deadline_abs is None
                     else max(0.001, entry.deadline_abs - now),
-                    on_token=self._make_token_sink(entry))
+                    on_token=self._make_token_sink(entry),
+                    trace_ctx=None if self.tracer is None else
+                    {"trace_id": entry.rid, "attempt": entry.replays})
             except Exception:
                 pkt.pool.free(pkt.pages)
                 self._requeue_unified(entry, "attach failed")
@@ -450,6 +523,16 @@ class ClusterRouter:
             entry.error = None   # transient retry notes don't survive
         self.journal.finalize(entry, state, error)
         self.metrics.record_terminal(self.step_idx, state)
+        if self.tracer is not None:
+            # the cluster-level per-request span: submit -> terminal,
+            # spanning every replica that ever held the work
+            self.tracer.complete(
+                "cluster_request", entry.t_submit, time.monotonic(),
+                cat="request", rid=entry.rid,
+                args={"state": state, "replays": entry.replays,
+                      "replicas": [str(r) for r in
+                                   entry.replica_history],
+                      "tokens": len(entry.emitted)})
 
     # ------------------------------------------------- drain + restart
     def drain_replica(self, rep, max_steps=100000):
@@ -463,6 +546,9 @@ class ClusterRouter:
             self.step()
         self.metrics.drains += 1
         self.metrics.event(self.step_idx, "drain")
+        if self.tracer is not None:
+            self.tracer.instant("drain_complete", cat="lifecycle",
+                                process=str(rep.id))
 
     def rolling_restart(self, term_grace_s=None):
         """Restart every live replica in sequence: drain, restart
@@ -481,6 +567,9 @@ class ClusterRouter:
             rep._death_handled = False
             self.metrics.restarts += 1
             self.metrics.event(self.step_idx, "restart")
+            if self.tracer is not None:
+                self.tracer.instant("restart", cat="lifecycle",
+                                    process=str(rep.id))
 
     def restart_replica(self, rep, term_grace_s=None):
         """Post-death recovery: bring a dead replica back with a fresh
@@ -517,6 +606,35 @@ class ClusterRouter:
         for entry in list(self.journal.live()):
             self._finalize(entry, jn.SHED,
                            "shutdown drain: grace budget exhausted")
+
+    # ------------------------------------------------------------ trace
+    def fleet_trace(self):
+        """The merged fleet Chrome-trace JSON object: the router's own
+        routing/failover spans plus every replica's — live schedulers,
+        DEAD replicas (their tracer outlives the dropped scheduler), and
+        worker processes (spans flushed over the JSONL protocol; what a
+        SIGKILLed worker flushed before dying survives here)."""
+        from deepspeed_tpu.serving.trace import merge_chrome
+        lists = []
+        if self.tracer is not None:
+            lists.append(self.tracer.serialized())
+        for rep in self.replicas:
+            if getattr(rep, "tracer", None) is not None:
+                lists.append(rep.tracer.serialized())
+            if getattr(rep, "trace_events", None):
+                lists.append(list(rep.trace_events))
+        return merge_chrome(lists)
+
+    def dump_trace(self, path):
+        """Write :meth:`fleet_trace` as a Chrome-trace/Perfetto JSON
+        file (open at https://ui.perfetto.dev).  Returns the path."""
+        import os as _os
+        d = _os.path.dirname(_os.path.abspath(path))
+        _os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.fleet_trace(), f)
+            f.write("\n")
+        return path
 
     # ------------------------------------------------------------ health
     def health(self):

@@ -3,9 +3,11 @@ process, driven over a JSONL stdin/stdout protocol.
 
 stdin ops (one JSON object per line):
   {"op": "submit", "rid": ..., "prompt": [...], "max_new_tokens": N,
-   "eos_token_id": E?, "deadline_s": D?}
+   "eos_token_id": E?, "deadline_s": D?,
+   "trace": {"trace_id": ...}?}   # cluster trace ctx rides the wire
   {"op": "cancel", "rid": ...}
   {"op": "drain"}            # stop admitting, finish in-flight
+  {"op": "trace"}            # enable span tracing at runtime
 
 stdout events (one JSON object per line, flushed immediately — a token
 the router never read is a token the router will replay, so buffering
@@ -15,6 +17,11 @@ here would manufacture duplicate work on a crash):
   {"ev": "tok", "rid": ..., "t": ...}      # one generated token
   {"ev": "done", "rid": ..., "status": ..., "tokens": [...],
    "error": ...?}
+  {"ev": "spans", "spans": [...]}          # --trace: serialized span
+                                           # batch, flushed with each
+                                           # heartbeat (epoch-µs ts, so
+                                           # the router merges them onto
+                                           # the fleet timeline)
 
 SIGTERM is the elastic-agent preemption notice: the worker drains
 in-flight requests within ``DS_PREEMPTION_GRACE_S`` (shedding the
@@ -67,6 +74,12 @@ def main(argv=None):
     p.add_argument("--max-pages-per-slot", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=8)
     p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--trace", action="store_true",
+                   help="record serving spans and flush them over the "
+                        "protocol with each heartbeat")
+    p.add_argument("--trace-label", default=None,
+                   help="process label for this worker's spans in the "
+                        "merged fleet trace (the replica id)")
     p.add_argument("--hb-interval-s", type=float, default=0.2)
     p.add_argument("--threefry-partitionable", action="store_true",
                    help="mirror the parent's jax_threefry_partitionable "
@@ -89,6 +102,24 @@ def main(argv=None):
         page_size=args.page_size,
         max_pages_per_slot=args.max_pages_per_slot,
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache)
+
+    tracer = {"t": None}
+
+    def enable_trace(label=None):
+        if tracer["t"] is None:
+            from deepspeed_tpu.serving.trace import SpanTracer
+            tracer["t"] = SpanTracer(
+                process=label or args.trace_label or
+                f"worker-{os.getpid()}")
+            sched.tracer = tracer["t"]
+
+    if args.trace:
+        enable_trace()
+
+    def flush_spans():
+        t = tracer["t"]
+        if t is not None and t.events:
+            _emit({"ev": "spans", "spans": t.serialized(drain=True)})
 
     term = {"flag": False}
     signal.signal(signal.SIGTERM, lambda *a: term.update(flag=True))
@@ -144,7 +175,7 @@ def main(argv=None):
                         op["prompt"], op.get("max_new_tokens", 32),
                         eos_token_id=op.get("eos_token_id"),
                         deadline_s=op.get("deadline_s"),
-                        on_token=on_token)
+                        on_token=on_token, trace_ctx=op.get("trace"))
                 except Exception as e:
                     _emit({"ev": "done", "rid": op["rid"],
                            "status": "shed", "tokens": [],
@@ -161,6 +192,8 @@ def main(argv=None):
                     req.cancel()
             elif kind == "drain":
                 sched.begin_drain(shed_waiting=False)
+            elif kind == "trace":
+                enable_trace(op.get("label"))
 
     while True:
         pump_stdin()
@@ -173,6 +206,7 @@ def main(argv=None):
             report(live.pop(rid))
         now = time.monotonic()
         if now - last_hb >= args.hb_interval_s:
+            flush_spans()
             _emit({"ev": "hb", "health": sched.health()})
             last_hb = now
         if not work:
@@ -184,6 +218,7 @@ def main(argv=None):
     sched.drain(grace_s=grace, shed_waiting=True)
     for rid in list(live):
         report(live.pop(rid))
+    flush_spans()
     _emit({"ev": "hb", "health": sched.health()})
     return 0
 

@@ -13,6 +13,8 @@ from collections import deque
 
 import numpy as np
 
+from deepspeed_tpu.monitor.monitor import clamp_min_step
+
 
 def _percentile(values, q):
     return float(np.percentile(np.asarray(values, np.float64), q)) \
@@ -59,24 +61,32 @@ class ServingMetrics:
         self._events = []
 
     # ---------------------------------------------------------- recording
-    def record_mesh(self, mesh_info):
+    def _write(self, events):
+        """The ONE funnel serving events take to the monitor sink.  The
+        ``step >= 1`` invariant is enforced centrally here
+        (``monitor.clamp_min_step`` — construction-time gauges
+        legitimately predate step 1 and stamp to it silently;
+        MonitorMaster additionally clamps-with-warning for emitters
+        outside this funnel), replacing the old per-callsite
+        hand-stamping."""
+        if self.monitor is not None:
+            self.monitor.write_events(clamp_min_step(events, warn=False))
+
+    def record_mesh(self, mesh_info, step=0):
         """One-shot serving-topology gauges at scheduler construction:
         per-axis mesh sizes and the per-device KV-pool footprint (each
         device holds its kv-head shard of every page).  Scalar-only
         sinks get one gauge per mesh axis; the full map rides
-        ``health()``."""
+        ``health()``.  Fires before the first live step — the central
+        clamp in ``_write`` lands it at step 1."""
         self.mesh_info = mesh_info
-        if self.monitor is not None:
-            # stamped step 1 (the first live step), keeping the
-            # monitor-stream invariant that serving events carry a
-            # step >= 1 even for construction-time gauges
-            events = [(f"serving/mesh/{ax}", size, 1)
-                      for ax, size in
-                      (mesh_info.get("mesh_shape") or {}).items()]
-            if mesh_info.get("kv_pool_bytes_per_device") is not None:
-                events.append(("serving/mesh/kv_pool_bytes_per_device",
-                               mesh_info["kv_pool_bytes_per_device"], 1))
-            self.monitor.write_events(events)
+        events = [(f"serving/mesh/{ax}", size, step)
+                  for ax, size in
+                  (mesh_info.get("mesh_shape") or {}).items()]
+        if mesh_info.get("kv_pool_bytes_per_device") is not None:
+            events.append(("serving/mesh/kv_pool_bytes_per_device",
+                           mesh_info["kv_pool_bytes_per_device"], step))
+        self._write(events)
 
     def record_step(self, step, *, queue_depth, running, waiting,
                     page_utilization, device_wait_s=0.0, host_s=0.0,
@@ -96,8 +106,7 @@ class ServingMetrics:
         if cached_pages is not None:
             self._events.append(
                 ("serving/prefix_cache/cached_pages", cached_pages, step))
-        if self.monitor is not None:
-            self.monitor.write_events(self._events)
+        self._write(self._events)
 
     def record_prefix(self, step, cached_tokens, prompt_tokens):
         """One admission-time prefix-cache lookup: ``cached_tokens`` of
@@ -108,8 +117,7 @@ class ServingMetrics:
         if cached_tokens > 0:
             self.prefix_hits += 1
             self.prefill_tokens_saved += cached_tokens
-        if self.monitor is not None:
-            self.monitor.write_events([
+        self._write([
                 ("serving/prefix_cache/cached_prefix_tokens",
                  cached_tokens, step),
                 ("serving/prefix_cache/hit_rate",
@@ -122,8 +130,7 @@ class ServingMetrics:
         """Cached pages drained back to the free list under pool
         pressure (reclaim, not failure)."""
         self.cache_evictions += pages
-        if self.monitor is not None:
-            self.monitor.write_events(
+        self._write(
                 [("serving/prefix_cache/evicted_pages", pages, step)])
 
     def record_tbt(self, step, gap_s):
@@ -133,8 +140,7 @@ class ServingMetrics:
         this — not the intra-burst tpot gap — is the client-visible
         latency cadence."""
         self.tbt_s.append(gap_s)
-        if self.monitor is not None:
-            self.monitor.write_events(
+        self._write(
                 [("serving/tbt_ms", gap_s * 1e3, step)])
 
     def record_horizon(self, step, horizon, tokens, device_wait_s):
@@ -142,8 +148,7 @@ class ServingMetrics:
         tokens it delivered, and how long the host blocked waiting for
         the device (0 when the overlapped copy had already landed)."""
         self.horizons.append(horizon)
-        if self.monitor is not None:
-            self.monitor.write_events([
+        self._write([
                 ("serving/horizon", horizon, step),
                 ("serving/horizon_tokens", tokens, step),
                 ("serving/horizon_wait_ms", device_wait_s * 1e3, step),
@@ -164,8 +169,7 @@ class ServingMetrics:
         self.spec_rollbacks += rollbacks
         self.spec_rollback_tokens += rollback_tokens
         self.spec_slot_rounds += slot_rounds
-        if self.monitor is not None:
-            self.monitor.write_events([
+        self._write([
                 ("serving/spec/k", k, step),
                 ("serving/spec/proposed", proposed, step),
                 ("serving/spec/accepted", accepted, step),
@@ -182,13 +186,11 @@ class ServingMetrics:
         ``spec_degrade_log`` (bounded) for operator inspection."""
         self.spec_degraded += 1
         self.spec_degrade_log.append((step, rid, reason))
-        if self.monitor is not None:
-            self.monitor.write_events([("serving/spec/degraded", 1, step)])
+        self._write([("serving/spec/degraded", 1, step)])
 
     def record_spec_wait(self, step, device_wait_s):
         """Host time blocked pulling a verify round's results."""
-        if self.monitor is not None:
-            self.monitor.write_events(
+        self._write(
                 [("serving/spec/wait_ms", device_wait_s * 1e3, step)])
 
     def spec_acceptance_rate(self):
@@ -207,23 +209,20 @@ class ServingMetrics:
         positions changed owners without a byte of KV copied."""
         self.handoffs += 1
         self.handoff_tokens += tokens
-        if self.monitor is not None:
-            self.monitor.write_events([
+        self._write([
                 ("serving/handoff", 1, step),
                 ("serving/handoff_tokens", tokens, step)])
 
     def record_first_token(self, step, ttft_s):
         self.ttft_s.append(ttft_s)
         self.tokens_emitted += 1
-        if self.monitor is not None:
-            self.monitor.write_events(
+        self._write(
                 [("serving/ttft_ms", ttft_s * 1e3, step)])
 
     def record_token(self, step, gap_s):
         self.tpot_s.append(gap_s)
         self.tokens_emitted += 1
-        if self.monitor is not None:
-            self.monitor.write_events(
+        self._write(
                 [("serving/token_latency_ms", gap_s * 1e3, step)])
 
     def record_completion(self, step):
@@ -239,8 +238,7 @@ class ServingMetrics:
             self.shed += 1
         elif state == "cancelled":
             self.cancelled += 1
-        if self.monitor is not None:
-            self.monitor.write_events([(f"serving/{state}", 1, step)])
+        self._write([(f"serving/{state}", 1, step)])
 
     def record_preemption(self, step):
         self.preemptions += 1
@@ -324,8 +322,10 @@ class ClusterMetrics:
 
     def event(self, step, tag, value=1):
         if self.monitor is not None:
-            self.monitor.write_events([(f"cluster/{tag}", value,
-                                        max(1, step))])
+            # same central step>=1 enforcement as ServingMetrics._write
+            # (replacing the old inline max(1, step) workaround)
+            self.monitor.write_events(clamp_min_step(
+                [(f"cluster/{tag}", value, step)], warn=False))
 
     def record_terminal(self, step, state):
         if state == "finished":

@@ -119,6 +119,7 @@ from deepspeed_tpu.serving.page_manager import (PagedKVManager,
                                                 PagePoolExhausted,
                                                 default_page_size)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
+from deepspeed_tpu.serving.trace import NULL_TRACER
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -159,6 +160,11 @@ class Request:
             rid = Request._next_id
             Request._next_id += 1
         self.rid = rid
+        # span identity: the id every trace span of this request
+        # carries.  Locally it is the rid; the cluster router overrides
+        # it (via submit's trace_ctx) with the journal rid so one client
+        # request's spans share one id across replicas and processes
+        self.trace_rid = rid
         self.orig_prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         self.prompt = list(self.orig_prompt)   # grows on preemption
         self.max_new_tokens = int(max_new_tokens)
@@ -205,10 +211,19 @@ class ServingScheduler:
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
                  spec_decode=None, spec_k=8, spec_drafter=None,
-                 shared_pool=None, pools_ref=None, on_handoff=None):
+                 shared_pool=None, pools_ref=None, on_handoff=None,
+                 tracer=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
+        # per-request span tracing (serving/trace.py).  The default is
+        # the shared no-op tracer: with tracing off every call site
+        # costs one attribute load and a falsy check — tokens, compile
+        # signatures and the hot loop are byte-identical (pinned by
+        # tests/unit/test_trace.py).  Tracing is pure host bookkeeping:
+        # no device op, no new jit signature, ever.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._t_start = time.monotonic()
         self.num_slots = int(num_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.max_queue = int(max_queue)
@@ -333,14 +348,17 @@ class ServingScheduler:
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
-               on_token=None, deadline_s=None, handoff=False):
+               on_token=None, deadline_s=None, handoff=False,
+               trace_ctx=None):
         """Queue a request; raises :class:`QueueFull` at max_queue (the
         backpressure signal callers turn into 429/retry). ``deadline_s``
         is a relative budget: a request that cannot finish inside it is
         shed instead of served late.  ``handoff=True`` marks a
         prefill-worker request: it stops after the boundary token and
         hands its KV page chain to ``on_handoff`` (disaggregated
-        serving)."""
+        serving).  ``trace_ctx`` (``{"trace_id": ..., "attempt": n}``)
+        propagates a cluster-level trace id so this scheduler's spans
+        for the request share the journal rid across replicas."""
         if self.draining:
             raise QueueFull("scheduler is draining (shutdown/restart in "
                             "progress); resubmit elsewhere")
@@ -357,6 +375,8 @@ class ServingScheduler:
         req = Request(prompt, max_new_tokens, eos_token_id, on_token,
                       deadline_s=deadline_s)
         req.handoff = bool(handoff)
+        if trace_ctx is not None and trace_ctx.get("trace_id") is not None:
+            req.trace_rid = trace_ctx["trace_id"]
         if req.max_new_tokens <= 0:
             # parity with generate(max_new_tokens=0): nothing to emit —
             # but it still counts as completed, so health()/summary
@@ -395,6 +415,15 @@ class ServingScheduler:
             req.error = reason
         self.requests.pop(req.rid, None)
         self.completed.append(req)
+        if self.tracer.enabled:
+            # one span per request covering its whole scheduler life —
+            # the top-level row a per-request trace view groups under
+            args = {"state": state, "tokens": len(req.out_tokens)}
+            if reason is not None:
+                args["reason"] = reason
+            self.tracer.complete("request", req.t_submit, time.monotonic(),
+                                 cat="request", rid=req.trace_rid,
+                                 args=args)
 
     def _donate_pages(self, slot, req):
         """Retirement hands the slot's FULL pages to the prefix cache
@@ -711,6 +740,11 @@ class ServingScheduler:
             # one timestamp per phase: admission decisions within a step
             # price time identically (no per-slot clock reads)
             req.t_admit = now
+            if self.tracer.enabled:
+                # the queue-wait phase closes at admission: submit->admit
+                self.tracer.complete("queued", req.t_submit, now,
+                                     cat="lifecycle", rid=req.trace_rid,
+                                     args={"slot": slot})
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
             self.lengths[slot] = 0
@@ -744,8 +778,13 @@ class ServingScheduler:
             # containment close releases the page with the slot instead
             # of leaking it
             self.kv.adopt_page(slot, page)
-            self.pools = self.engine.copy_page(self.pools, pnode.page,
-                                               page)
+            with self.tracer.span("cow_copy", track=slot,
+                                  rid=req.trace_rid,
+                                  args={"src_page": pnode.page,
+                                        "dst_page": page}
+                                  if self.tracer.enabled else None):
+                self.pools = self.engine.copy_page(self.pools, pnode.page,
+                                                   page)
             self.prefix_cache.touch(pnode)
             self.prefix_cache.cow_copies += 1
             cached += plen
@@ -762,6 +801,12 @@ class ServingScheduler:
         self.prefix_cache.lookups += 1
         if cached:
             self.prefix_cache.hits += 1
+            if self.tracer.enabled:
+                self.tracer.instant("prefix_hit", track=slot,
+                                    rid=req.trace_rid,
+                                    args={"cached_tokens": cached,
+                                          "prompt_tokens":
+                                          len(req.prompt)})
         self.metrics.record_prefix(self.step_idx, cached, len(req.prompt))
 
     def _prefill(self):
@@ -783,9 +828,13 @@ class ServingScheduler:
                     continue      # self-preempted: back in the queue
                 ids = np.zeros((1, self.prefill_chunk), np.int32)
                 ids[0, :n_valid] = chunk
-                logits, self.pools = self.engine.prefill_into_slots(
-                    ids, slot, n_valid, self.kv.table, self.lengths,
-                    self.pools)
+                with self.tracer.span(
+                        "prefill_chunk", track=slot, rid=req.trace_rid,
+                        args={"tokens": n_valid, "pos": req.prefill_pos}
+                        if self.tracer.enabled else None):
+                    logits, self.pools = self.engine.prefill_into_slots(
+                        ids, slot, n_valid, self.kv.table, self.lengths,
+                        self.pools)
                 self.lengths[slot] += n_valid
                 req.prefill_pos += n_valid
                 if req.prefill_pos == len(req.prompt):
@@ -840,10 +889,15 @@ class ServingScheduler:
             return
         self._finalize(req, HANDOFF)
         self.metrics.record_handoff(self.step_idx, plen)
+        if self.tracer.enabled:
+            self.tracer.instant("handoff_out", cat="handoff", track=slot,
+                                rid=req.trace_rid,
+                                args={"tokens": plen,
+                                      "pages": len(pages)})
 
     def attach_handoff(self, prompt, pages, length, first_tok, *,
                        max_new_tokens, eos_token_id=None, on_token=None,
-                       deadline_s=None):
+                       deadline_s=None, trace_ctx=None):
         """Decode-worker intake for a prefill worker's donated chain:
         the request joins with its prompt KV already written (``pages``
         cover ``length`` prefilled positions in the SHARED pool) and its
@@ -857,6 +911,8 @@ class ServingScheduler:
             raise QueueFull("scheduler is draining; handoff refused")
         req = Request(prompt, max_new_tokens, eos_token_id, on_token,
                       deadline_s=deadline_s)
+        if trace_ctx is not None and trace_ctx.get("trace_id") is not None:
+            req.trace_rid = trace_ctx["trace_id"]
         now = time.monotonic()
         # the boundary token was emitted (and TTFT recorded) by the
         # prefill worker; seeding t_first keeps _emit on the inter-token
@@ -911,6 +967,10 @@ class ServingScheduler:
                 else int(req.eos_token_id)
             req.t_admit = now
             req.state = RUNNING
+            if self.tracer.enabled:
+                self.tracer.instant("handoff_in", cat="handoff",
+                                    track=slot, rid=req.trace_rid,
+                                    args={"prefilled": length})
 
     # ----------------------------------------------------------- drain
     def begin_drain(self, shed_waiting=False):
@@ -940,6 +1000,7 @@ class ServingScheduler:
         requests that were live when the drain began."""
         before = self.metrics.completed
         shed_before = self.metrics.shed
+        t_drain = time.monotonic()
         self.begin_drain(shed_waiting=shed_waiting)
         deadline = None if grace_s is None \
             else time.monotonic() + float(grace_s)
@@ -965,8 +1026,12 @@ class ServingScheduler:
                            "exhausted")
             self.metrics.record_terminal(self.step_idx, SHED, req.rid,
                                          req.error)
-        return {"finished": self.metrics.completed - before,
-                "shed": self.metrics.shed - shed_before}
+        counts = {"finished": self.metrics.completed - before,
+                  "shed": self.metrics.shed - shed_before}
+        if self.tracer.enabled:
+            self.tracer.complete("drain", t_drain, time.monotonic(),
+                                 cat="lifecycle", args=dict(counts))
+        return counts
 
     # -------------------------------------------------- horizon decode
     def _bucket_floor(self, h):
@@ -1132,7 +1197,13 @@ class ServingScheduler:
         step by closing slots); False falls back to the normal fused
         horizon — the cold-start/no-proposal path, where the plain
         loop (including overlap) is strictly better."""
+        t_prop = time.monotonic()
         drafts = self._collect_drafts(running)
+        if self.tracer.enabled:
+            self.tracer.complete("spec_propose", t_prop, time.monotonic(),
+                                 cat="spec",
+                                 args={"proposing": sum(
+                                     1 for d in drafts.values() if d)})
         proposing = [s for s in running if drafts.get(s)]
         if not proposing:
             return False
@@ -1203,6 +1274,7 @@ class ServingScheduler:
             active[s] = True
             budgets[s] = self.slot_req[s].remaining_new
         self._chain_budgets = budgets
+        t_disp = time.monotonic()
         out = self.engine.verify_multi(
             self.last_tok, draft_arr, active, self.kv.table, self.lengths,
             self.pools, widths=widths, budgets=budgets,
@@ -1223,7 +1295,12 @@ class ServingScheduler:
             "toks": toks, "valid": valid, "tok_end": tok_end,
             "active_end": active_end, "lengths_end": lengths_end,
             "emitted_end": emitted_end, "release_after": set(),
+            "t_dispatch": time.monotonic(),
         })
+        if self.tracer.enabled:
+            self.tracer.complete("spec_verify_dispatch", t_disp,
+                                 time.monotonic(), cat="spec",
+                                 args={"k": k, "slots": len(running)})
         return True
 
     def _dispatch(self):
@@ -1241,8 +1318,9 @@ class ServingScheduler:
                    self.slot_req[s].state == RUNNING]
         if not running:
             return
+        t_disp = time.monotonic()
         horizon, running = self._reserve(
-            running, self._pick_horizon(running, time.monotonic()))
+            running, self._pick_horizon(running, t_disp))
         if not running:
             return
         active = np.zeros(self.num_slots, bool)
@@ -1259,6 +1337,14 @@ class ServingScheduler:
             **self.sampling)
         self._commit_dispatch(out, running, horizon,
                               {s: self.slot_req[s] for s in running})
+        if self.tracer.enabled:
+            # host side of the dispatch: page reservation + argument
+            # staging + launching the fused scan (the device's share of
+            # the horizon shows up as device_wait at harvest)
+            self.tracer.complete("horizon_dispatch", t_disp,
+                                 time.monotonic(), cat="dispatch",
+                                 args={"horizon": horizon,
+                                       "slots": len(running)})
 
     def _commit_dispatch(self, out, running, horizon, reqs):
         toks, valid, tok_end, active_end, lengths_end, emitted_end, pools \
@@ -1279,6 +1365,7 @@ class ServingScheduler:
             "toks": toks, "valid": valid, "tok_end": tok_end,
             "active_end": active_end, "lengths_end": lengths_end,
             "emitted_end": emitted_end, "release_after": set(),
+            "t_dispatch": time.monotonic(),
         })
 
     def _try_chain(self):
@@ -1366,6 +1453,10 @@ class ServingScheduler:
             **self.sampling)
         self._commit_dispatch(out, cont, horizon,
                               {s: prev["reqs"][s] for s in cont})
+        if self.tracer.enabled:
+            self.tracer.instant("horizon_chained", cat="dispatch",
+                                args={"horizon": horizon,
+                                      "slots": len(cont)})
         return True
 
     def _harvest(self):
@@ -1380,6 +1471,15 @@ class ServingScheduler:
         valid = np.asarray(rec["valid"])  # async host copy) catch up
         wait = time.monotonic() - t0
         now = time.monotonic()
+        if self.tracer.enabled:
+            # the host/device split the device_wait instrumentation
+            # already measures: time blocked pulling the token block is
+            # the device's (+ copy's) share of this horizon; 0 means the
+            # overlapped copy had already landed
+            self.tracer.complete("device_wait", t0, t0 + wait,
+                                 cat="device", track="device",
+                                 args={"horizon": rec["horizon"],
+                                       "spec": bool(rec.get("spec"))})
         pulled = 0
         for slot in rec["slots"]:
             req = rec["reqs"][slot]
@@ -1417,6 +1517,16 @@ class ServingScheduler:
                     # immediate release is safe
                     self._retire(slot)
                     break
+            if n and self.tracer.enabled:
+                # one span per (slot, horizon) burst on the slot's own
+                # track: dispatch -> harvest, n tokens delivered.  This
+                # is the per-request timeline row (rid-keyed), emitted
+                # even when the request just retired/closed above.
+                self.tracer.complete(
+                    "decode_burst" if not rec.get("spec")
+                    else "spec_round", rec["t_dispatch"], now,
+                    cat="decode", track=slot, rid=req.trace_rid,
+                    args={"tokens": n, "horizon": rec["horizon"]})
             if self.slot_req[slot] is req and req.state == RUNNING:
                 self.lengths[slot] += n
                 if n:
@@ -1432,6 +1542,14 @@ class ServingScheduler:
         else:
             self.metrics.record_horizon(self.step_idx, rec["horizon"],
                                         pulled, wait)
+        if self.tracer.enabled:
+            # host bookkeeping share of the harvest (emit callbacks,
+            # retire, rollback) — the counterpart of device_wait above
+            self.tracer.complete("harvest", now, time.monotonic(),
+                                 cat="dispatch",
+                                 args={"tokens": pulled,
+                                       "horizon": rec["horizon"],
+                                       "spec": bool(rec.get("spec"))})
         return wait, pulled
 
     def _harvest_spec(self, rec, valid):
@@ -1525,8 +1643,12 @@ class ServingScheduler:
         and terminal counts by kind."""
         m = self.metrics
         pc = self.prefix_cache
+        uptime = max(1e-9, time.monotonic() - self._t_start)
         return {
             "step": self.step_idx,
+            "uptime_s": round(uptime, 3),
+            "steps_per_s": round(self.step_idx / uptime, 3),
+            "tracing": self.tracer.enabled,
             "mesh": self.mesh_info.get("mesh_shape"),
             "mesh_devices": self.mesh_info.get("mesh_devices"),
             "serving_axes": self.mesh_info.get("serving_axes"),

@@ -85,6 +85,9 @@ class TestElasticity:
 
 # ---------------------------------------------------- 1-bit compression
 class TestOnebit:
+    @pytest.mark.slow   # ~17s; the compressed-allreduce path is also
+    # exercised tier-1 by test_onebit_adam_converges below — the
+    # PR-1/PR-4 slow-lane policy (tier-1 brushed its 870s wall budget)
     def test_compressed_allreduce_matches_mean_with_error_feedback(self):
         from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
         from jax.sharding import PartitionSpec as P, Mesh

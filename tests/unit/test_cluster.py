@@ -327,6 +327,9 @@ def test_idempotent_rid_and_cancel_after_terminal(engine):
 HEALTH_SCHEMA = {
     # key -> allowed types (None listed where the field is nullable)
     "step": (int,),
+    "uptime_s": (float,),
+    "steps_per_s": (float,),
+    "tracing": (bool,),
     "mesh": (dict, type(None)),
     "mesh_devices": (int, type(None)),
     "serving_axes": (dict, type(None)),

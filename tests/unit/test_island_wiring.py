@@ -131,6 +131,11 @@ def _gpt2_cfg(**kw):
                           num_heads=4, max_seq_len=32, **kw))
 
 
+@pytest.mark.slow   # ~25s; PLD behavior also covered tier-1 by
+# test_pld_custom_loss_without_kwarg_fails_loudly here and
+# test_progressive_layer_drop in test_aux_subsystems — the PR-1/PR-4
+# slow-lane policy for the heaviest redundantly-covered tests (the
+# suite brushed the 870s tier-1 wall budget on this rig)
 def test_pld_config_drives_model():
     """pld in the json config reaches the GPT2 forward: dropped blocks
     change the loss vs an identical run without pld, theta anneals, and

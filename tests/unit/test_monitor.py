@@ -1,0 +1,169 @@
+"""Monitor-sink coverage + the serving event-taxonomy pin.
+
+Three contracts the observability tier rides on:
+
+* **RingBufferMonitor** — bounded, ordered ``tail()``: the live
+  interrogation surface for supervisors/health endpoints.
+* **csvMonitor** — one CSV per tag with a ``(step, value)`` schema that
+  round-trips: the artifact external dashboards ingest.
+* **Event taxonomy** — every ``serving/*`` / ``cluster/*`` event name
+  ``ServingMetrics``/``ClusterMetrics`` emit appears in
+  ``trace.EVENT_TAXONOMY`` AND in ``docs/observability.md``: a rename
+  fails HERE, not an operator's dashboard.
+* **step >= 1 invariant** — enforced centrally
+  (``monitor.clamp_min_step`` in ``MonitorMaster.write_events`` and the
+  metrics funnels), replacing the old per-callsite stamping (the
+  ``record_mesh`` step-1 hack).
+"""
+
+import csv
+import os
+import types
+
+from deepspeed_tpu.monitor.config import get_monitor_config
+from deepspeed_tpu.monitor.monitor import (MonitorMaster,
+                                           RingBufferMonitor, clamp_min_step,
+                                           csvMonitor)
+from deepspeed_tpu.serving.metrics import ClusterMetrics, ServingMetrics
+from deepspeed_tpu.serving.trace import EVENT_TAXONOMY
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------- sinks
+
+def test_ring_buffer_tail_ordering_and_bounds():
+    rb = RingBufferMonitor(maxlen=8)
+    for i in range(1, 21):
+        rb.write_events([("t/a", float(i), i)])
+    assert len(rb.events) == 8, "ring must stay bounded"
+    # tail(n) returns the MOST RECENT n, oldest-first
+    assert [s for _, _, s in rb.tail(3)] == [18, 19, 20]
+    assert [s for _, _, s in rb.tail(8)] == list(range(13, 21))
+    # n > len degrades to the whole buffer, still ordered
+    assert [s for _, _, s in rb.tail(99)] == list(range(13, 21))
+
+
+def test_csv_monitor_schema_round_trip(tmp_path):
+    cfg = types.SimpleNamespace(enabled=True, output_path=str(tmp_path),
+                                job_name="job")
+    mon = csvMonitor(cfg)
+    mon.write_events([("serving/ttft_ms", 12.5, 1),
+                      ("serving/ttft_ms", 7.25, 2),
+                      ("serving/queue_depth", 3, 2)])
+    # one file per tag, '/' flattened; header then (step, value) rows
+    path = tmp_path / "job" / "serving_ttft_ms.csv"
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "serving_ttft_ms"]
+    assert [(int(s), float(v)) for s, v in rows[1:]] == \
+        [(1, 12.5), (2, 7.25)]
+    with open(tmp_path / "job" / "serving_queue_depth.csv") as f:
+        rows = list(csv.reader(f))
+    assert [(int(s), float(v)) for s, v in rows[1:]] == [(2, 3.0)]
+
+
+# --------------------------------------------------- step >= 1 clamp
+
+def test_clamp_min_step_clamps_and_passes_through():
+    evs = [("a", 1.0, 0), ("b", 2.0, -3), ("c", 3.0, 5)]
+    out = clamp_min_step(evs, warn=False)
+    assert [s for _, _, s in out] == [1, 1, 5]
+    # the all-valid fast path returns the SAME list (no copy per step)
+    ok = [("a", 1.0, 1)]
+    assert clamp_min_step(ok) is ok
+
+
+def test_monitor_master_enforces_step_invariant(tmp_path):
+    """Regression (the record_mesh step-1 stamping hack): the invariant
+    lives in MonitorMaster.write_events now — any emitter handing a
+    step < 1 event gets it clamped centrally, with a warning."""
+    master = MonitorMaster(get_monitor_config({}))
+
+    class Sink:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, event_list):
+            self.events.extend(event_list)
+
+    sink = Sink()
+    master.csv_monitor = sink
+    master.write_events([("train/loss", 1.0, 0), ("train/lr", 0.1, 2)])
+    assert [s for _, _, s in sink.events] == [1, 2]
+
+
+def test_serving_metrics_funnel_clamps_construction_gauges():
+    """record_mesh fires at scheduler construction (step 0 by nature);
+    the central funnel stamps it to 1 — no sink ever sees step < 1,
+    with no per-callsite workaround in metrics.py."""
+    rb = RingBufferMonitor()
+    m = ServingMetrics(rb)
+    m.record_mesh({"mesh_shape": {"data": 2, "model": 4},
+                   "kv_pool_bytes_per_device": 1024})
+    cm = ClusterMetrics(rb)
+    cm.event(0, "failover")
+    assert rb.events, "gauges must reach the sink"
+    assert all(step >= 1 for _, _, step in rb.events)
+
+
+# ---------------------------------------------------- taxonomy pin
+
+def _drive_all_serving_events(m):
+    """Exercise every ServingMetrics recording path that emits monitor
+    events (a new record_* emitting an undocumented tag fails the
+    subset assertion below)."""
+    m.record_mesh({"mesh_shape": {"data": 1, "model": 1, "pipe": 1,
+                                  "expert": 1, "sequence": 1},
+                   "kv_pool_bytes_per_device": 1})
+    m.record_step(1, queue_depth=1, running=1, waiting=1,
+                  page_utilization=0.5, device_wait_s=0.1, host_s=0.1,
+                  cached_pages=2)
+    m.record_prefix(1, 16, 32)
+    m.record_cache_eviction(1, 2)
+    m.record_tbt(1, 0.01)
+    m.record_horizon(1, 8, 24, 0.002)
+    m.record_spec(1, proposed=8, accepted=6, emitted=7, rollbacks=1,
+                  rollback_tokens=2, k=8, slot_rounds=1)
+    m.record_spec_degrade(1, rid=1, reason="x")
+    m.record_spec_wait(1, 0.001)
+    m.record_handoff(1, 32)
+    m.record_first_token(1, 0.05)
+    m.record_token(1, 0.01)
+    for state in ("failed", "shed", "cancelled"):
+        m.record_terminal(1, state, rid=1, reason="x")
+
+
+_CLUSTER_TAGS = ("heartbeat_miss", "failover", "replay", "retry",
+                 "handoff", "handoff_degrade", "drain", "restart")
+
+
+def test_event_taxonomy_pins_every_emitted_name():
+    rb = RingBufferMonitor(maxlen=4096)
+    _drive_all_serving_events(ServingMetrics(rb))
+    cm = ClusterMetrics(rb)
+    for tag in _CLUSTER_TAGS:
+        cm.event(1, tag)
+    for state in ("finished", "failed", "shed", "cancelled"):
+        cm.record_terminal(1, state)
+    emitted = {tag for tag, _, _ in rb.events}
+    unknown = emitted - set(EVENT_TAXONOMY)
+    assert not unknown, (
+        f"events emitted outside the documented taxonomy: {unknown} — "
+        "add them to trace.EVENT_TAXONOMY AND docs/observability.md "
+        "(renames break operator dashboards; this pin breaks first)")
+
+
+def test_event_taxonomy_documented():
+    """Every taxonomy name appears verbatim in docs/observability.md —
+    the table operators read is the table the code emits."""
+    doc = open(os.path.join(REPO, "docs", "observability.md")).read()
+    missing = [name for name in EVENT_TAXONOMY if name not in doc]
+    assert not missing, f"undocumented events: {missing}"
+
+
+# The end-to-end "live serving loop emits only documented tags" pin
+# rides tests/unit/test_trace.py (it shares that module's engine).

@@ -1,0 +1,491 @@
+"""End-to-end request tracing + flight recorder (serving/trace.py).
+
+The two acceptance pins:
+
+* **Zero-cost-when-off** — with tracing disabled the scheduler runs the
+  byte-identical loop: same tokens, same compile counts, nothing
+  recorded (the shared NULL_TRACER).
+* **Failover oracle with tracing on** — a replica killed mid-stream
+  yields a merged fleet trace that loads as valid Chrome-trace JSON in
+  which the killed replica's spans and the survivor's replay spans
+  share the journal rid with an explicit flow link, the flight-recorder
+  dump correlates with the journal entries that were in flight, and
+  every output stays token-exact vs ``generate()``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import (ClusterRouter, FlightRecorder,
+                                   ServingScheduler, SpanTracer,
+                                   make_local_fleet, prometheus_text)
+from deepspeed_tpu.serving.trace import EVENT_TAXONOMY, NULL_TRACER
+
+CFG = dict(num_slots=3, num_pages=16, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+def _oracle(engine, prompts, max_new):
+    return [
+        [int(t) for t in
+         engine.generate(p[None], max_new_tokens=m, do_sample=False)[
+             0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+def _serve(engine, prompts, max_new, tracer=None, **kw):
+    sched = ServingScheduler(engine, tracer=tracer, **CFG, **kw)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    return sched, reqs, got
+
+
+def _chrome_ok(trace):
+    """Structural validity of a Chrome-trace JSON object: it must
+    round-trip through json and every event must carry the fields the
+    Perfetto/catapult loaders key on."""
+    trace = json.loads(json.dumps(trace))   # JSON-serializable
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert isinstance(e["name"], str)
+        assert e["ph"] in ("X", "i", "s", "f", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # process/thread metadata names the tracks
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in evs)
+    return evs
+
+
+# ------------------------------------------------- zero cost when off
+
+
+def test_tracing_off_is_zero_cost(engine):
+    """The pin: tracing disabled leaves tokens AND compile signatures
+    byte-identical, and records nothing anywhere (NULL_TRACER)."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, 7).astype(np.int32) for _ in range(4)]
+    max_new = [6, 5, 6, 5]
+    want = _oracle(engine, prompts, max_new)
+
+    sched_off, reqs_off, got_off = _serve(engine, prompts, max_new)
+    assert sched_off.tracer is NULL_TRACER
+    assert len(NULL_TRACER.events) == 0
+
+    def compiles():
+        return (engine.serving_decode_multi_compile_count(),
+                engine.serving_decode_compile_count(),
+                engine.serving_verify_compile_count(),
+                engine.serving_page_copy_compile_count())
+    compiles_after_off = compiles()
+
+    tracer = SpanTracer(process="t")
+    sched_on, reqs_on, got_on = _serve(engine, prompts, max_new,
+                                       tracer=tracer)
+    compiles_after_on = compiles()
+
+    for r_off, r_on, w in zip(reqs_off, reqs_on, want):
+        assert r_off.out_tokens == w, "untraced run must match generate()"
+        assert r_on.out_tokens == w, "traced run must match generate()"
+    # tracing is host-only: the traced run may not add ONE signature
+    assert compiles_after_on == compiles_after_off
+    assert tracer.events, "the traced run must actually record spans"
+
+
+def test_null_tracer_is_shared_and_inert(engine):
+    s1 = ServingScheduler(engine, **CFG)
+    s2 = ServingScheduler(engine, **CFG)
+    assert s1.tracer is s2.tracer is NULL_TRACER
+    assert not NULL_TRACER.enabled
+    with NULL_TRACER.span("x"):    # the no-op context manager
+        pass
+    NULL_TRACER.instant("x")
+    NULL_TRACER.complete("x", 0.0, 1.0)
+    NULL_TRACER.flow("s", "id", "x")
+    assert len(NULL_TRACER.events) == 0
+
+
+# ------------------------------------------------------- span model
+
+
+def test_lifecycle_spans_and_chrome_export(engine):
+    """One traced run produces the documented lifecycle phases and a
+    structurally valid Chrome-trace export with slot tracks."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 256, 12).astype(np.int32)
+               for _ in range(3)]
+    max_new = [6, 6, 6]
+    want = _oracle(engine, prompts, max_new)
+    tracer = SpanTracer(process="serve0")
+    sched, reqs, got = _serve(engine, prompts, max_new, tracer=tracer)
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w
+
+    names = {e[1] for e in tracer.events}
+    for must in ("queued", "prefill_chunk", "horizon_dispatch",
+                 "device_wait", "harvest", "decode_burst", "request"):
+        assert must in names, f"missing lifecycle span {must}"
+
+    evs = _chrome_ok(tracer.to_chrome())
+    # one track per slot: decode bursts land on distinct slot tids
+    burst_tids = {e["tid"] for e in evs if e["name"] == "decode_burst"}
+    assert len(burst_tids) >= 2
+    # per-request spans are rid-keyed and terminal-stated
+    req_spans = [e for e in evs if e["name"] == "request"]
+    assert {e["args"]["rid"] for e in req_spans} == \
+        {r.rid for r in reqs}
+    assert all(e["args"]["state"] == "finished" for e in req_spans)
+    # the queue-wait phase closes at admission with a real duration
+    assert all(e["dur"] >= 0 for e in evs
+               if e["name"] == "queued" and e["ph"] == "X")
+
+
+def test_prefix_and_cow_spans(engine):
+    """A full-page cache hit emits prefix_hit; a partial-page hit pays
+    (and records) the copy-on-write page copy."""
+    rng = np.random.default_rng(2)
+    base = rng.integers(0, 256, 20).astype(np.int32)
+    tracer = SpanTracer(process="serve0")
+    sched = ServingScheduler(engine, prefix_cache=True, tracer=tracer,
+                             **CFG)
+    r1 = sched.submit(base, max_new_tokens=5)
+    sched.run()
+    # full-page reuse: same first 16-token page + distinct tail
+    r2 = sched.submit(np.concatenate(
+        [base[:16], rng.integers(0, 256, 4).astype(np.int32)]),
+        max_new_tokens=4)
+    sched.run()
+    # partial-page reuse: 8 tokens into the cached page -> COW copy
+    r3 = sched.submit(np.concatenate(
+        [base[:8], rng.integers(0, 256, 6).astype(np.int32)]),
+        max_new_tokens=4)
+    sched.run()
+    assert r1.state == r2.state == r3.state == "finished"
+    names = [e[1] for e in tracer.events]
+    assert "prefix_hit" in names
+    assert "cow_copy" in names
+    hit = next(e for e in tracer.serialized()
+               if e["name"] == "prefix_hit")
+    assert hit["args"]["cached_tokens"] >= 8
+
+
+def test_spec_round_spans(engine):
+    """Speculative rounds emit propose/verify-dispatch spans and the
+    per-slot spec_round bursts, token-exact as ever."""
+    rng = np.random.default_rng(3)
+    motif = rng.integers(0, 256, 4).astype(np.int32)
+    prompts = [np.concatenate([np.tile(motif, 3),
+                               rng.integers(0, 256, 4).astype(np.int32)])]
+    want = _oracle(engine, prompts, [12])
+    tracer = SpanTracer(process="serve0")
+    sched, reqs, got = _serve(engine, prompts, [12], tracer=tracer,
+                              spec_decode="ngram", spec_k=4)
+    assert reqs[0].out_tokens == want[0]
+    names = {e[1] for e in tracer.events}
+    assert "spec_propose" in names
+    assert "spec_verify_dispatch" in names
+    assert "spec_round" in names
+
+
+def test_trace_ctx_propagates_journal_rid(engine):
+    """submit(trace_ctx=...) overrides the span identity: spans carry
+    the cluster-level trace id instead of the local rid."""
+    tracer = SpanTracer(process="serve0")
+    sched = ServingScheduler(engine, tracer=tracer, **CFG)
+    req = sched.submit(np.zeros(5, np.int32), max_new_tokens=3,
+                       trace_ctx={"trace_id": "client-42", "attempt": 0})
+    assert req.trace_rid == "client-42"
+    sched.run()
+    rids = {e[6] for e in tracer.events if e[6] is not None}
+    assert rids == {"client-42"}
+
+
+# -------------------------------------------------- failover oracle
+
+
+def test_failover_trace_rid_link_and_flight_record(engine, tmp_path):
+    """The acceptance oracle, tracing flavor: 3 traced replicas serving
+    mixed prefix-shared + spec traffic, replica0 killed mid-stream via
+    the fault point.  Assert (a) everything stays token-exact vs
+    generate(), (b) the merged fleet trace is valid Chrome JSON in
+    which the killed replica's spans and the survivor's replay spans
+    share the rid with an explicit s/f flow link, and (c) the
+    flight-recorder dump correlates with the journal entries that were
+    in flight on the dead replica."""
+    rng = np.random.default_rng(4)
+    head = rng.integers(0, 256, 11).astype(np.int32)
+    prompts, max_new = [], []
+    for _ in range(4):
+        prompts.append(np.concatenate(
+            [head, rng.integers(0, 256, 5).astype(np.int32)]))
+        max_new.append(int(rng.integers(5, 9)))
+    motif = rng.integers(0, 256, 4).astype(np.int32)
+    prompts.append(np.concatenate(
+        [np.tile(motif, 3), rng.integers(0, 256, 4).astype(np.int32)]))
+    max_new.append(12)
+    want = _oracle(engine, prompts, max_new)
+
+    reps = make_local_fleet(engine, 3, prefix_cache=True,
+                            spec_decode="ngram", spec_k=4, **CFG)
+    tracer = SpanTracer(process="router")
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    router = ClusterRouter(reps, tracer=tracer, flight_recorder=flight)
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.replica_kill", match={"replica": "replica0"},
+                  step=2, exc=RuntimeError("chaos"))
+    with faults.injected(inj):
+        entries = [router.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        got = router.run()
+    assert plan.fired == 1
+    h = router.health()
+    assert h["failovers"] == 1 and h["replays"] >= 1 and h["failed"] == 0
+    for e, w in zip(entries, want):
+        assert e.state == "finished" and got[e.rid] == w, \
+            (e.rid, e.state, e.replica_history)
+
+    # (b) merged fleet trace: valid, rid-linked across processes
+    trace_path = router.dump_trace(str(tmp_path / "fleet_trace.json"))
+    evs = _chrome_ok(json.load(open(trace_path)))
+    pname = {e["args"]["name"]: e["pid"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "replica0" in pname, "the dead replica must be in the trace"
+    replayed = [e for e in entries if e.replays > 0]
+    assert replayed
+    for entry in replayed:
+        rid_evs = [e for e in evs
+                   if e.get("args", {}).get("rid") == entry.rid]
+        pids = {e["pid"] for e in rid_evs}
+        assert pname["replica0"] in pids, \
+            "the killed replica's spans must carry the rid"
+        survivors = [pname[r] for r in entry.replica_history[1:]]
+        assert any(p in pids for p in survivors), \
+            "the survivor's replay spans must carry the same rid"
+        flows = [e for e in evs
+                 if e.get("id") == f"replay:{entry.rid}:1"]
+        assert {e["ph"] for e in flows} == {"s", "f"}, \
+            "the replay must be explicitly flow-linked"
+        s_ev = next(e for e in flows if e["ph"] == "s")
+        f_ev = next(e for e in flows if e["ph"] == "f")
+        assert s_ev["pid"] == pname["replica0"]
+        assert f_ev["pid"] != s_ev["pid"]
+    assert any(e["name"] == "replica_death" for e in evs)
+
+    # (c) the flight record correlates with the journal
+    assert flight.dumps, "replica death must trigger a dump"
+    rec = json.load(open(flight.dumps[0]))
+    assert rec["reason"].startswith("replica_death:replica0")
+    dumped_rids = {s["rid"] for s in rec["journal_entry"]}
+    assert dumped_rids, "the in-flight journal entries ride the dump"
+    assert dumped_rids <= {e.rid for e in entries}
+    assert {e.rid for e in replayed} <= dumped_rids
+    _chrome_ok(rec["trace"])
+    # ...and the journal dump round-trips with the replay recorded
+    router.journal.dump(str(tmp_path / "journal.json"))
+    jd = json.loads((tmp_path / "journal.json").read_text())
+    assert {s["rid"] for s in jd["entries"] if s["replays"]} == \
+        {e.rid for e in replayed}
+
+
+@pytest.mark.slow
+def test_process_replica_sigkill_trace(engine, tmp_path):
+    """The real thing, traced: two worker PROCESSES with span tracing
+    over the JSONL protocol, one SIGKILLed mid-stream.  The merged
+    fleet trace holds the dead worker's flushed spans (carrying the
+    journal rids), the router's death/replay spans, and the flow link;
+    outputs stay token-exact vs generate()."""
+    from deepspeed_tpu.serving import ProcessReplica
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, 5).astype(np.int32) for _ in range(4)]
+    max_new = [24] * 4
+    want = _oracle(engine, prompts, max_new)
+    reps = [ProcessReplica(f"proc{i}", model="gpt2-tiny",
+                           term_grace_s=5.0, trace=True)
+            for i in range(2)]
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        tracer = SpanTracer(process="router")
+        flight = FlightRecorder(str(tmp_path / "flight"))
+        router = ClusterRouter(reps, heartbeat_misses=1, tracer=tracer,
+                               flight_recorder=flight)
+        entries = [router.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        import time as _time
+        deadline = _time.monotonic() + 600
+        while _time.monotonic() < deadline:
+            router.step()
+            if sum(len(e.emitted) for e in entries) >= 2:
+                break
+            _time.sleep(0.05)
+        assert sum(len(e.emitted) for e in entries) >= 2
+        victim = next(r for r in reps if r.load() > 0)
+        victim.kill()
+        got = router.run(max_steps=200000)
+        h = router.health()
+        assert h["failovers"] == 1 and h["failed"] == 0
+        for e, w in zip(entries, want):
+            assert e.state == "finished" and got[e.rid] == w, \
+                (e.rid, e.state, e.replica_history)
+
+        evs = _chrome_ok(router.fleet_trace())
+        pname = {e["args"]["name"]: e["pid"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        # worker-side spans made it across the process boundary with
+        # the journal rid (the trace ctx rode the submit op)
+        worker_spans = [e for e in evs
+                       if e["pid"] in (pname.get("proc0"),
+                                       pname.get("proc1"))
+                       and e.get("args", {}).get("rid") is not None]
+        assert worker_spans, "worker spans must reach the router"
+        assert {e["args"]["rid"] for e in worker_spans} <= \
+            {e.rid for e in entries}
+        assert any(e["name"] == "replica_death" for e in evs)
+        replayed = [e for e in entries if e.replays > 0]
+        assert replayed
+        for entry in replayed:
+            flows = [e for e in evs
+                     if e.get("id") == f"replay:{entry.rid}:1"]
+            assert {e["ph"] for e in flows} == {"s", "f"}
+        assert flight.dumps, "the SIGKILL death must trigger a dump"
+        rec = json.load(open(flight.dumps[0]))
+        assert {s["rid"] for s in rec["journal_entry"]} <= \
+            {e.rid for e in entries}
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+# ------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_fault_trigger_and_bounds(engine, tmp_path):
+    """A fault point actually firing auto-dumps the recent-span window;
+    the recorder is bounded (limit files, then counted skips) and the
+    span ring is bounded (dropped counter)."""
+    tracer = SpanTracer(process="serve0", capacity=8)
+    flight = FlightRecorder(str(tmp_path), limit=1)
+    flight.register("serve0", tracer)
+    flight.arm_fault_observer()
+    try:
+        sched = ServingScheduler(engine, tracer=tracer, **CFG)
+        inj = faults.FaultInjector(seed=0)
+        inj.on("serve.step", steps=(1, 2), times=2,
+               action=lambda ctx: None)
+        with faults.injected(inj):
+            for _ in range(3):
+                sched.submit(np.zeros(5, np.int32), max_new_tokens=16)
+            sched.run()
+    finally:
+        flight.disarm_fault_observer()
+    assert flight.count == 1 and flight.skipped == 1, \
+        "2 firings, limit 1: one dump + one counted skip"
+    rec = json.load(open(flight.dumps[0]))
+    assert rec["reason"] == "fault:serve.step"
+    assert rec["extra"]["ctx"]["step"] == 1
+    # the ring is bounded: far more than 8 events were recorded
+    assert len(tracer.events) <= 8 and tracer.dropped > 0
+
+
+def test_flight_recorder_observer_never_breaks_faults(engine):
+    """An exploding observer must not alter fault semantics: the fired
+    plan's action still runs, nothing leaks out of the loop, and a
+    raising plan still raises into the containment path."""
+    def bomb(point, ctx):
+        raise RuntimeError("observer bug")
+    faults.observe(bomb)
+    try:
+        sched = ServingScheduler(engine, **CFG)
+        inj = faults.FaultInjector(seed=0)
+        benign = inj.on("serve.step", nth=1, action=lambda ctx: None)
+        raising = inj.on("serve.request", nth=1, exc=RuntimeError("x"))
+        with faults.injected(inj):
+            req = sched.submit(np.zeros(5, np.int32), max_new_tokens=3)
+            sched.run()
+        assert benign.fired == 1 and raising.fired == 1
+        # the raising plan's containment still classified the request
+        assert req.state == "failed" and "x" in req.error
+    finally:
+        faults.unobserve(bomb)
+
+
+# -------------------------------------------- telemetry exposition
+
+
+def test_prometheus_text_exposition(engine):
+    rng = np.random.default_rng(6)
+    sched, _, _ = _serve(engine,
+                         [rng.integers(0, 256, 5).astype(np.int32)], [3])
+    text = prometheus_text(sched.health(), prefix="ds_serving",
+                           labels={"replica": "r0"})
+    lines = [ln for ln in text.splitlines() if ln]
+    # every sample line: name{labels} value, preceded by a TYPE line
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    assert samples
+    for ln in samples:
+        name, val = ln.rsplit(" ", 1)
+        assert name.endswith('{replica="r0"}')
+        float(val)                      # numeric
+    assert any("ds_serving_completed" in ln for ln in samples)
+    assert any("ds_serving_uptime_s" in ln for ln in samples)
+    assert any("ds_serving_steps_per_s" in ln for ln in samples)
+    # booleans export as 0/1; strings/None/nested are skipped
+    assert any(ln.startswith("ds_serving_tracing") for ln in samples)
+    assert not any("last_error" in ln for ln in samples)
+    assert not any("spec_decode{" in ln for ln in samples)
+    # summary() percentiles export the same way
+    stext = prometheus_text(sched.summary())
+    assert "ds_serving_ttft_ms_p50" in stext
+
+
+def test_health_uptime_and_steps_per_s(engine):
+    import time as _time
+    sched = ServingScheduler(engine, **CFG)
+    h0 = sched.health()
+    assert h0["uptime_s"] >= 0 and h0["steps_per_s"] == 0.0
+    sched.submit(np.zeros(5, np.int32), max_new_tokens=3)
+    sched.run()
+    _time.sleep(0.01)
+    h1 = sched.health()
+    assert h1["uptime_s"] > h0["uptime_s"]
+    assert h1["steps_per_s"] > 0.0
+    assert abs(h1["steps_per_s"] - h1["step"] / h1["uptime_s"]) < 0.5
+
+
+def test_live_loop_emits_only_documented_tags(engine):
+    """End-to-end taxonomy pin over a REAL serving run with the
+    optional subsystems (prefix cache + spec decode) engaged."""
+    from deepspeed_tpu.monitor.monitor import RingBufferMonitor
+    rb = RingBufferMonitor(maxlen=8192)
+    sched = ServingScheduler(engine, prefix_cache=True,
+                             spec_decode="ngram", spec_k=4, monitor=rb,
+                             **CFG)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        sched.submit(rng.integers(0, 256, 7).astype(np.int32),
+                     max_new_tokens=8)
+    sched.run()
+    emitted = {tag for tag, _, _ in rb.events}
+    assert emitted <= set(EVENT_TAXONOMY), \
+        emitted - set(EVENT_TAXONOMY)
+    assert all(step >= 1 for _, _, step in rb.events)

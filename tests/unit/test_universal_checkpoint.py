@@ -258,6 +258,10 @@ def _hf_gpt2_to_megatron_shards(tp, pp):
     return hf, hf_cfg, stages
 
 
+@pytest.mark.slow   # ~16s; the universal-resume machinery keeps three
+# tier-1 siblings here (native->universal resume, moments roundtrip,
+# offload fp32 masters) — the PR-1/PR-4 slow-lane policy for the
+# heaviest redundantly-covered tests (tier-1 brushed its 870s budget)
 def test_megatron_3d_to_universal_training_resume(tmp_path):
     """The full foreign-resume path: a synthetic Megatron (tp=2, pp=2)
     checkpoint grid merges, converts, and RESUMES TRAINING in our

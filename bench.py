@@ -14,22 +14,12 @@ import time
 
 import numpy as np
 
-PEAK_FLOPS = {
-    # bf16 dense peak per chip
-    "v5e": 197e12,
-    "v5litepod": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "cpu": 1e12,  # nominal, so the script still runs off-TPU
-}
-
-
 def guess_peak(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return 197e12
+    # the per-chip peak table lives with the profiler now (the live MFU
+    # gauge in resilience/supervisor.py reads the same numbers)
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        peak_flops_per_device)
+    return peak_flops_per_device(device)
 
 
 def run_config(gas, batch, seq, n_dev):

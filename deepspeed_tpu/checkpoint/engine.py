@@ -38,12 +38,14 @@ import io
 import json
 import os
 import threading
+import time
 import zipfile
 import zlib
 
 import jax
 import numpy as np
 
+from deepspeed_tpu import tracing
 from deepspeed_tpu.resilience import faults
 
 _META = "checkpoint_meta.json"
@@ -256,12 +258,21 @@ def save_state(path, state, client_state=None, async_write=False,
         for key, arr in _leaf_chunks(leaf):
             chunks.append((f"{name}|{key}", arr))
 
+    # captured HERE, not inside write(): the async writer runs write()
+    # on a worker thread where the caller's contextvar scope is gone
+    tracer = tracing.current_tracer()
+
     def write():
         # fault point: a raised IOError here models a transient disk
         # failure — the supervisor's bounded-retry save path owns it
         faults.fire("ckpt.shard_write", path=shard_file)
+        _t0 = time.monotonic()
         crcs = _write_npz_streaming(shard_file + ".tmp", chunks)
         os.replace(shard_file + ".tmp", shard_file)
+        tracer.complete("ckpt_shard_write", _t0, time.monotonic(),
+                        cat="ckpt", track="ckpt",
+                        args={"file": os.path.basename(shard_file),
+                              "chunks": len(chunks)})
         # fault point: actions here mangle the DURABLE file (truncation,
         # bit rot) so integrity verification and rollback are testable
         faults.fire("ckpt.shard_written", path=shard_file)
@@ -283,7 +294,11 @@ def save_state(path, state, client_state=None, async_write=False,
                     pass
         # all hosts' shard files must be durable before the `latest`
         # pointer flips
+        _t0 = time.monotonic()
         _durability_barrier(save_id, path, on_writer_thread=async_write)
+        tracer.complete("ckpt_barrier", _t0, time.monotonic(),
+                        cat="ckpt", track="ckpt",
+                        args={"save_id": save_id})
         if on_done is not None and jax.process_index() == 0:
             on_done()
 

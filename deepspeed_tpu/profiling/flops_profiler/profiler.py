@@ -34,6 +34,34 @@ def _num(x, suffix=""):
     return f"{x:.2f} E{suffix}"
 
 
+# bf16 dense peak per chip (the bench.py anchor table); "cpu" is a
+# NOMINAL 1 TFLOP/s so MFU stays a defined, comparable number on dev
+# rigs — absolute CPU MFU values are meaningless, their TRENDS are not
+PEAK_FLOPS_PER_CHIP = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e12,
+}
+
+
+def peak_flops_per_device(device=None):
+    """Best-effort peak model flops of one device, for MFU accounting
+    (live gauge: ``ResilientTrainer``; offline: ``bench.py``).  Matches
+    on ``device_kind`` substrings; unknown TPUs fall back to the v5e
+    figure, non-TPU platforms to the nominal CPU figure."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS_PER_CHIP.items():
+        if key in kind:
+            return val
+    if getattr(device, "platform", "") != "tpu":
+        return PEAK_FLOPS_PER_CHIP["cpu"]
+    return PEAK_FLOPS_PER_CHIP["v5e"]
+
+
 def cost_analysis(fn, *args, static_argnums=(), **kwargs):
     """flops / bytes-accessed of `fn` compiled for the given args
     (concrete arrays or ShapeDtypeStructs)."""

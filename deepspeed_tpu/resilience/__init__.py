@@ -20,8 +20,11 @@ without cycles.
 """
 
 from deepspeed_tpu.resilience import faults  # noqa: F401
+from deepspeed_tpu.resilience.ledger import (CATEGORIES,  # noqa: F401
+                                             GoodputLedger)
 
-_LAZY = ("ResilientTrainer", "Preempted", "TrainReport", "DivergenceError")
+_LAZY = ("ResilientTrainer", "Preempted", "TrainReport", "DivergenceError",
+         "merge_train_trace")
 
 
 def __getattr__(name):

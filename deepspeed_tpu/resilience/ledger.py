@@ -1,0 +1,115 @@
+"""Goodput ledger: classify every wall-clock second of a supervised run.
+
+Large-scale training reports (MegaScale's straggler diagnosis, Google's
+"goodput" accounting for ML SLOs) treat *time attribution* as the
+first-class production metric: of the wall time a job held its chips,
+how much produced new optimizer steps, and where did the rest go?  The
+ledger answers that for a ``ResilientTrainer.train()`` run — including
+one interrupted and resumed across process incarnations — with six
+categories that always partition 100% of the measured wall time:
+
+``productive``
+    First-time train steps: ``global_steps`` advanced past the furthest
+    step this run had ever reached.
+``compile_warmup``
+    Steps during which the engine compiled a new executable (detected
+    via ``engine.train_compile_counts()`` deltas — every incarnation
+    pays this again, which is exactly the point of measuring it).
+``checkpoint_stall``
+    Wall time blocked inside the supervisor's ``save()`` (shard write,
+    post-save verification, retention rotation, retries).
+``recompute``
+    Re-running steps that an earlier incarnation (or a pre-rollback
+    present) had already completed — the price of restoring an older
+    checkpoint after a crash or corruption rollback.
+``divergence_retry``
+    NaN-watchdog handling: the rollback restore itself (the re-run
+    steps afterwards count as ``recompute``).
+``idle``
+    Everything else inside the ``train()`` wall: data loading, host
+    bookkeeping, the preemption drain, gauge emission.  Computed as
+    the remainder, which is what guarantees the partition.
+
+Accounting is **segment-based**: ``begin()`` opens a wall segment (one
+``train()`` call), ``add(category, seconds)`` attributes measured
+sub-intervals, ``finish()`` closes the segment and sweeps the
+unattributed remainder into ``idle``.  Totals accumulate across
+segments and across incarnations (the supervisor persists
+``snapshot()`` into ``run_state.json`` every step and seeds the next
+incarnation's ledger with it via ``carry``), so ``fractions()`` over a
+resumed run partitions the *sum of all incarnations'* train() wall
+time.
+"""
+
+import time
+
+CATEGORIES = ("productive", "compile_warmup", "checkpoint_stall",
+              "recompute", "divergence_retry", "idle")
+
+
+class GoodputLedger:
+    def __init__(self, carry=None):
+        self.seconds = {c: 0.0 for c in CATEGORIES}
+        if carry:
+            for c in CATEGORIES:
+                self.seconds[c] += float(carry.get(c, 0.0))
+        self._t0 = None          # open segment start (monotonic)
+        self._attributed = 0.0   # seconds attributed inside the segment
+
+    @property
+    def active(self):
+        return self._t0 is not None
+
+    def begin(self):
+        """Open a wall segment (one train() call)."""
+        self._t0 = time.monotonic()
+        self._attributed = 0.0
+
+    def add(self, category, seconds):
+        """Attribute ``seconds`` of the open segment to ``category``."""
+        if category not in self.seconds:
+            raise ValueError(f"unknown goodput category {category!r}")
+        seconds = max(0.0, float(seconds))
+        self.seconds[category] += seconds
+        if self._t0 is not None:
+            self._attributed += seconds
+
+    def finish(self):
+        """Close the segment: the unattributed remainder is idle time.
+        (Attribution can only under-count — every add() is a measured
+        sub-interval of the segment — so the remainder is >= 0 up to
+        clock granularity and the categories partition the wall.)"""
+        if self._t0 is None:
+            return
+        wall = time.monotonic() - self._t0
+        self.seconds["idle"] += max(0.0, wall - self._attributed)
+        self._t0 = None
+        self._attributed = 0.0
+
+    # ------------------------------------------------------- exporting
+    def snapshot(self):
+        """Crash-durable totals: category seconds as if the segment
+        ended now (idle-so-far included, nothing mutated).  What the
+        supervisor persists per step so a SIGKILLed incarnation still
+        hands its wall time to the next one."""
+        out = dict(self.seconds)
+        if self._t0 is not None:
+            wall = time.monotonic() - self._t0
+            out["idle"] += max(0.0, wall - self._attributed)
+        return out
+
+    def wall_s(self):
+        return sum(self.snapshot().values())
+
+    def fractions(self):
+        snap = self.snapshot()
+        total = sum(snap.values())
+        if total <= 0.0:
+            return {c: 0.0 for c in CATEGORIES}
+        return {c: snap[c] / total for c in CATEGORIES}
+
+    def as_dict(self):
+        snap = self.snapshot()
+        total = sum(snap.values())
+        return {"wall_s": total, "seconds": snap,
+                "fractions": self.fractions()}

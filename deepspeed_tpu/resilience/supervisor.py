@@ -25,29 +25,65 @@ training + Nebula tiered checkpoints):
   or rolled back to the last good checkpoint, per policy, with a
   bounded budget before the run halts loudly.
 
-All events flow through ``monitor/`` (``resilience/*`` tags) and are
-kept in an in-memory :class:`~deepspeed_tpu.monitor.monitor.RingBufferMonitor`
-for ``status()`` introspection.
+Observability (docs/observability.md, "Training-tier"):
+
+* **Step spans** — with a :class:`~deepspeed_tpu.tracing.SpanTracer`
+  installed (``tracer=`` / ``trace_dir=``), every train step, data
+  fetch, checkpoint save/verify/rotate, resume/rollback and the
+  preemption drain records host-side spans; the engine adds
+  ``fwd_bwd_dispatch`` / ``device_wait`` / ``optimizer_step`` /
+  ``grad_sync`` (and per-micro tracks under gas>1).  Spans persist per
+  *incarnation* under ``<save_dir>/trace/`` and
+  :func:`merge_train_trace` merges every incarnation of one run —
+  identified by the ``run_id`` persisted in ``run_state.json``, which
+  survives SIGTERM/crash — into a single Chrome/Perfetto JSON.
+* **Goodput ledger** — every wall second of ``train()`` classified into
+  :data:`~deepspeed_tpu.resilience.ledger.CATEGORIES` (productive /
+  compile_warmup / checkpoint_stall / recompute / divergence_retry /
+  idle), cumulative across incarnations, exported in ``TrainReport``,
+  the monitor stream (``train/goodput/*``) and
+  :meth:`prometheus_text`.
+* **Live MFU / throughput gauges** — per-window ``train/mfu``,
+  ``train/tokens_per_s``, ``train/tflops_achieved`` and
+  ``train/step_time_ms`` monitor events from the flops-profiler model
+  estimate + measured wall time.
+* **Stall/straggler watchdog** — an EWMA step-time anomaly emits
+  ``train/straggler`` (+ a flight-recorder dump); a no-progress timer
+  (``stall_timeout_s``) emits ``train/stall`` and dumps the recent
+  span window while the process is still alive to be debugged.
+
+All events flow through ``monitor/`` (``resilience/*`` + ``train/*``
+tags, the unified taxonomy in :data:`deepspeed_tpu.tracing.
+EVENT_TAXONOMY`) and are kept in an in-memory
+:class:`~deepspeed_tpu.monitor.monitor.RingBufferMonitor` for
+``status()`` introspection.
 
 Every recovery path here is covered by the deterministic fault harness
 (:mod:`deepspeed_tpu.resilience.faults`) in
-``tests/unit/test_resilience.py``.
+``tests/unit/test_resilience.py``; the observability layer by
+``tests/unit/test_train_trace.py``.
 """
 
 import dataclasses
+import json
 import os
 import re
 import signal
 import threading
 import time
+import uuid
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu import tracing
 from deepspeed_tpu.checkpoint.engine import (CheckpointCorrupt,
                                              verify_checkpoint)
 from deepspeed_tpu.monitor.monitor import RingBufferMonitor
+from deepspeed_tpu.resilience.ledger import CATEGORIES, GoodputLedger
+from deepspeed_tpu.tracing import (NULL_TRACER, SpanTracer, merge_chrome,
+                                   prometheus_text)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -72,6 +108,94 @@ class TrainReport:
     save_retries: int = 0           # failed save attempts that were retried
     resumed_from: str = None        # tag resume() restored, if any
     preempted_at_step: int = None
+    run_id: str = None              # persisted run identity (run_state.json)
+    incarnation: int = 0            # 1-based process incarnation of the run
+    stragglers: int = 0             # EWMA step-time anomalies
+    stalls: int = 0                 # no-progress watchdog firings
+    mfu: float = None               # last gauge-window MFU (if measurable)
+    tokens_per_s: float = None      # last gauge-window token throughput
+    ledger: dict = None             # goodput ledger (cumulative for the run)
+
+
+def merge_train_trace(trace_dir, out=None):
+    """Merge every incarnation's flushed span file
+    (``spans_inc*.jsonl``, one serialized event per line — append-only
+    so flushing costs O(new spans), not O(run history)) under
+    ``trace_dir`` into ONE Chrome-trace JSON — the single timeline of a
+    run that crossed process boundaries (each incarnation is a Perfetto
+    *process*; all share the run id in their process names).  Returns
+    the output path (default ``<trace_dir>/train_trace.json``)."""
+    lists = []
+    for name in sorted(os.listdir(trace_dir)):
+        if name.startswith("spans_inc") and name.endswith(".jsonl"):
+            events = []
+            try:
+                with open(os.path.join(trace_dir, name)) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            events.append(json.loads(line))
+            except (OSError, ValueError) as e:
+                # a torn tail line (SIGKILL mid-append) drops that line
+                # only; everything parsed before it is kept
+                logger.warning(f"partial span file {name}: {e}")
+            if events:
+                lists.append(events)
+    trace = merge_chrome(lists)
+    out = out or os.path.join(trace_dir, "train_trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    return out
+
+
+class _NoProgressWatchdog(threading.Thread):
+    """Fires once per stall episode when no train step has completed
+    for ``stall_timeout_s`` — dumping the flight record while the hung
+    process is still alive is the whole point (a SIGKILLed hang leaves
+    nothing).  Arms after the incarnation's FIRST completed step: the
+    first step legitimately stalls for however long XLA compilation
+    takes, and a timeout sized for steady-state steps would fire on
+    every cold start."""
+
+    def __init__(self, sup):
+        super().__init__(daemon=True, name="ds-train-stall-watchdog")
+        self.sup = sup
+        self._stop_ev = threading.Event()
+
+    def run(self):
+        timeout = float(self.sup.stall_timeout_s)
+        poll = max(0.01, min(timeout / 4.0, 1.0))
+        while not self._stop_ev.wait(poll):
+            sup = self.sup
+            if sup.report.steps < 1:
+                continue        # not armed until compile/warmup is paid
+            if sup._watchdog_paused:
+                continue        # a long save or restore is
+                # checkpoint_stall / divergence_retry (visible as the
+                # ckpt_save / resume spans and ledger categories), not
+                # a training hang — firing here would burn the bounded
+                # dump budget on false positives
+            stuck = time.monotonic() - sup._progress_beat
+            if stuck > timeout and not sup._stall_fired:
+                sup._stall_fired = True
+                sup.report.stalls += 1
+                step = sup.engine.global_steps
+                logger.warning(
+                    f"no train-step progress for {stuck:.1f}s "
+                    f"(step {step}); dumping flight record")
+                sup._emit_events([("train/stall", stuck, step)])
+                sup.tracer.instant("stall", cat="train", track="steps",
+                                   args={"stuck_s": round(stuck, 3),
+                                         "step": step})
+                if sup.flight_recorder is not None:
+                    sup.flight_recorder.dump(
+                        f"train_stall_step{step}",
+                        extra={"stuck_s": stuck, "step": step})
+
+    def stop(self):
+        self._stop_ev.set()
+        self.join(timeout=2.0)
 
 
 class ResilientTrainer:
@@ -101,13 +225,38 @@ class ResilientTrainer:
             (the SIGTERM-to-SIGKILL window). Defaults to the
             ``DS_PREEMPTION_GRACE_S`` env var the elastic agent
             publishes; None means unbounded.
+        tracer: a :class:`~deepspeed_tpu.tracing.SpanTracer` (installed
+            into the engine too); None disables tracing unless
+            ``trace_dir`` is set, in which case one is created.
+        trace_dir: directory for per-incarnation span files + the
+            merged ``train_trace.json`` (default ``<save_dir>/trace``
+            when tracing is on).
+        flight_recorder: a :class:`~deepspeed_tpu.tracing.
+            FlightRecorder`; the supervisor registers its tracer and
+            dumps on stall, straggler, divergence rollback,
+            checkpoint-corruption rollback and preemption.
+        stall_timeout_s: no-progress watchdog timeout (None = off).
+        straggler_factor: EWMA step-time anomaly threshold (a step
+            slower than ``factor x EWMA`` after warmup is a straggler).
+        gauge_interval: emit throughput/MFU/goodput monitor gauges
+            every N steps (0 = off).
+        mfu_gauge: include MFU/TFLOPS in the gauges (the first window
+            pays one XLA cost-analysis of the compiled step to learn
+            the model flops; tokens/s and step-time gauges are free).
+        peak_flops_per_device: override the per-device peak-flops
+            estimate used by the MFU gauge (default: autodetected per
+            device kind; a nominal 1e12 off-TPU, matching bench.py).
     """
 
     def __init__(self, engine, save_dir, *, save_interval=0, keep_last=3,
                  tag_prefix="step", save_retries=3, retry_backoff_s=0.25,
                  nan_policy="restore", max_nan_events=3,
                  monitor=None, signals=(signal.SIGTERM,),
-                 preemption_grace_s=None):
+                 preemption_grace_s=None,
+                 tracer=None, trace_dir=None, flight_recorder=None,
+                 stall_timeout_s=None, straggler_factor=3.0,
+                 gauge_interval=8, mfu_gauge=True,
+                 peak_flops_per_device=None):
         if nan_policy not in ("restore", "skip", "halt"):
             raise ValueError(f"unknown nan_policy {nan_policy!r}")
         self.engine = engine
@@ -134,16 +283,74 @@ class ResilientTrainer:
         self._old_handlers = {}
         self.report = TrainReport()
 
+        # ------------------------------- run identity (cross-incarnation)
+        # run_state.json survives SIGTERM/crash: the run id keys the
+        # merged trace, max_step_reached keys recompute attribution, and
+        # the ledger carry keeps the goodput partition cumulative across
+        # process incarnations.  Written atomically every step (cheap
+        # next to any train step) so even a SIGKILL loses at most the
+        # in-flight step's attribution.
+        self._run_state_path = os.path.join(self.save_dir, "run_state.json")
+        st = self._read_run_state()
+        self._had_run_state = bool(st)
+        self.run_id = st.get("run_id") or uuid.uuid4().hex[:12]
+        self.incarnation = int(st.get("incarnations", 0))
+        self._max_step_reached = int(st.get("max_step_reached", 0))
+        self.ledger = GoodputLedger(carry=st.get("ledger"))
+
+        # ------------------------------------------------------ tracing
+        if tracer is None and trace_dir is not None:
+            tracer = SpanTracer(process="train", capacity=32768)
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.trace_dir = trace_dir or (
+            os.path.join(self.save_dir, "trace")
+            if self.tracer.enabled else None)
+        self._trace_flushed_total = (
+            self.tracer.dropped + len(self.tracer.events))
+        self.flight_recorder = flight_recorder
+        if flight_recorder is not None and self.tracer.enabled:
+            flight_recorder.register(f"train:{self.run_id}", self.tracer)
+        if hasattr(engine, "set_tracer"):
+            engine.set_tracer(self.tracer)
+
+        # ------------------------------------------ watchdogs and gauges
+        self.stall_timeout_s = stall_timeout_s
+        self.straggler_factor = float(straggler_factor)
+        self.gauge_interval = int(gauge_interval)
+        self.mfu_gauge = bool(mfu_gauge)
+        self._peak_flops_per_device = peak_flops_per_device
+        self._peak_flops_total = None
+        self._flops = None              # lazy flops_profile (False = n/a)
+        self._ema_step_s = None
+        self._ema_n = 0
+        self._last_mfu = None
+        self._last_tokens_per_s = None
+        self._progress_beat = time.monotonic()
+        self._stall_fired = False
+        self._watchdog_paused = False
+        self._watchdog = None
+        self._gauge_t0 = time.monotonic()
+        self._gauge_steps0 = 0
+
     # ------------------------------------------------------------- events
-    def _emit(self, tag, value):
-        events = [(f"resilience/{tag}", float(value),
-                   self.engine.global_steps)]
+    def _emit_events(self, events):
+        """The unified monitor funnel: ring buffer + extra sink + the
+        engine's monitor.  Steps are clamped to >= 1 locally (the
+        pre-first-step gauges legitimately predate step 1; sinks index
+        by positive step — same invariant monitor.clamp_min_step owns
+        for MonitorMaster)."""
+        events = [(tag, float(value), max(1, int(step)))
+                  for tag, value, step in events]
         self.ring.write_events(events)
         if self._extra_monitor is not None:
             self._extra_monitor.write_events(events)
         eng_mon = getattr(self.engine, "monitor", None)
         if eng_mon is not None and getattr(eng_mon, "enabled", False):
             eng_mon.write_events(events)
+
+    def _emit(self, tag, value):
+        self._emit_events([(f"resilience/{tag}", float(value),
+                            self.engine.global_steps)])
 
     def status(self):
         """Live snapshot for operators/tests."""
@@ -154,7 +361,106 @@ class ResilientTrainer:
             "tags": self._tags(),
             "latest": self._read_latest(),
             "recent_events": self.ring.tail(20),
+            "run_id": self.run_id,
+            "incarnation": self.incarnation,
+            "goodput": self.ledger.as_dict(),
         }
+
+    def prometheus_text(self, prefix="ds_train"):
+        """The training-side Prometheus exposition: goodput seconds +
+        fractions, throughput/MFU gauges and run counters as
+        ``<prefix>_*`` gauges (the serving twin is
+        ``prometheus_text(sched.health())``)."""
+        led = self.ledger.as_dict()
+        flat = {"wall_s": led["wall_s"],
+                "global_steps": self.engine.global_steps,
+                "incarnation": self.incarnation,
+                "steps": self.report.steps,
+                "saves": self.report.saves,
+                "save_retries": self.report.save_retries,
+                "restores": self.report.restores,
+                "nan_events": self.report.nan_events,
+                "stragglers": self.report.stragglers,
+                "stalls": self.report.stalls,
+                "mfu": self._last_mfu,
+                "tokens_per_s": self._last_tokens_per_s,
+                "ema_step_s": self._ema_step_s}
+        for cat in CATEGORIES:
+            flat[f"goodput_{cat}_s"] = led["seconds"][cat]
+            flat[f"goodput_{cat}_frac"] = led["fractions"][cat]
+        flat = {k: v for k, v in flat.items() if v is not None}
+        return prometheus_text(flat, prefix=prefix,
+                               labels={"run_id": self.run_id})
+
+    # --------------------------------------------------------- run state
+    def _read_run_state(self):
+        try:
+            with open(self._run_state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _write_run_state(self):
+        try:
+            os.makedirs(self.save_dir, exist_ok=True)
+            st = {"run_id": self.run_id,
+                  "incarnations": self.incarnation,
+                  "max_step_reached": self._max_step_reached,
+                  "ledger": self.ledger.snapshot(),
+                  "wall_time": time.time()}
+            tmp = self._run_state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(st, f)
+            os.replace(tmp, self._run_state_path)
+        except OSError as e:
+            logger.warning(f"run_state write failed: {e}")
+
+    def _flush_trace(self, merge=True):
+        """Drain the span ring into this incarnation's file (appended —
+        per-incarnation files stay disjoint) and, with ``merge``,
+        rebuild the merged run trace.  Called at every verified save
+        (``merge=False`` — re-merging all history per save would make
+        checkpoint I/O grow with run length; ``merge_train_trace`` is a
+        public entry point for post-mortems on a SIGKILLed run) and at
+        train() exit (clean, preempted or crashed-with-exception,
+        ``merge=True``); a SIGKILL loses only spans since the last
+        flush — the same at-least-once window as the serving workers'
+        heartbeat flushes."""
+        if not self.tracer.enabled or not self.trace_dir:
+            return
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            # high-water mark, NOT drain: the ring must keep its window
+            # so a flight dump right after a save still shows recent
+            # history.  Total-pushed = dropped + len(ring); the last
+            # (total - flushed) ring entries are the unflushed ones.
+            events = self.tracer.serialized()
+            pushed_total = self.tracer.dropped + len(events)
+            new = pushed_total - self._trace_flushed_total
+            if new > len(events):
+                logger.warning(
+                    f"{new - len(events)} spans rotated out of the ring "
+                    "before reaching disk (raise SpanTracer capacity or "
+                    "save more often)")
+                new = len(events)
+            events = events[len(events) - new:] if new > 0 else []
+            self._trace_flushed_total = pushed_total
+            if events:
+                # append-only JSONL: one event per line, so a flush
+                # costs O(new spans) regardless of how long the run has
+                # been going (and a torn tail line after SIGKILL drops
+                # one event, not the file)
+                path = os.path.join(
+                    self.trace_dir,
+                    f"spans_inc{max(1, self.incarnation):03d}.jsonl")
+                with open(path, "a") as f:
+                    for ev in events:
+                        f.write(json.dumps(ev))
+                        f.write("\n")
+            if merge and os.path.isdir(self.trace_dir):
+                merge_train_trace(self.trace_dir)
+        except OSError as e:
+            logger.warning(f"trace flush failed: {e}")
 
     # ---------------------------------------------------------- signals
     def request_preemption(self):
@@ -265,80 +571,253 @@ class ResilientTrainer:
         path."""
         tag = str(tag or f"{self.tag_prefix}{self.engine.global_steps}")
         path = os.path.join(self.save_dir, tag)
-        deadline = None if budget_s is None else time.monotonic() + budget_s
-        last_err = None
-        for attempt in range(1, self.save_retries + 1):
-            try:
-                client = {"resilience": {"rng_key": self._rng_state()}}
-                # synchronous by design: the integrity gate below must
-                # read the durable bytes before `latest` may advance, so
-                # an async writer would be joined immediately anyway
-                # (the engine's own async_save remains available for
-                # unsupervised checkpointing)
-                self.engine.save_checkpoint(
-                    self.save_dir, tag=tag, client_state=client,
-                    save_latest=False, async_save=False)
-                self.engine.wait_checkpoint()
-                ok, problems = verify_checkpoint(path)
-                if not ok:
-                    raise CheckpointCorrupt(
-                        f"post-save verification of {path} failed: "
-                        + "; ".join(problems))
-                self._advance_latest(tag)
-                self._rotate()
-                self.report.saves += 1
-                self._emit("checkpoint_saved", self.engine.global_steps)
-                return path
-            except Exception as e:
-                last_err = e
-                self.report.save_retries += 1
-                self._emit("save_retry", attempt)
-                logger.warning(
-                    f"checkpoint save attempt {attempt}/"
-                    f"{self.save_retries} failed: {e}")
-                backoff = self.retry_backoff_s * (2 ** (attempt - 1))
-                if deadline is not None and \
-                        time.monotonic() + backoff >= deadline:
-                    logger.error(
-                        "save budget exhausted before the grace window "
-                        "ends; giving up rather than sleeping into "
-                        "SIGKILL")
-                    break
-                if attempt < self.save_retries:
-                    time.sleep(backoff)
-        raise last_err
+        t_save0 = time.monotonic()
+        self._watchdog_paused = True
+        try:
+            deadline = None if budget_s is None \
+                else time.monotonic() + budget_s
+            last_err = None
+            for attempt in range(1, self.save_retries + 1):
+                try:
+                    client = {"resilience": {
+                        "rng_key": self._rng_state(),
+                        # trace/ledger continuity survives even if
+                        # run_state.json is lost with the work dir
+                        "run_id": self.run_id,
+                        "max_step_reached": self._max_step_reached}}
+                    # synchronous by design: the integrity gate below
+                    # must read the durable bytes before `latest` may
+                    # advance, so an async writer would be joined
+                    # immediately anyway (the engine's own async_save
+                    # remains available for unsupervised checkpointing)
+                    with tracing.scope(self.tracer):
+                        self.engine.save_checkpoint(
+                            self.save_dir, tag=tag, client_state=client,
+                            save_latest=False, async_save=False)
+                        self.engine.wait_checkpoint()
+                    with self.tracer.span("ckpt_verify", cat="ckpt",
+                                          track="ckpt",
+                                          args={"tag": tag}):
+                        ok, problems = verify_checkpoint(path)
+                    if not ok:
+                        raise CheckpointCorrupt(
+                            f"post-save verification of {path} failed: "
+                            + "; ".join(problems))
+                    self._advance_latest(tag)
+                    with self.tracer.span("rotate", cat="ckpt",
+                                          track="ckpt"):
+                        self._rotate()
+                    self.report.saves += 1
+                    self._emit("checkpoint_saved", self.engine.global_steps)
+                    self._write_run_state()
+                    self._flush_trace(merge=False)
+                    return path
+                except Exception as e:
+                    last_err = e
+                    self.report.save_retries += 1
+                    self._emit("save_retry", attempt)
+                    logger.warning(
+                        f"checkpoint save attempt {attempt}/"
+                        f"{self.save_retries} failed: {e}")
+                    backoff = self.retry_backoff_s * (2 ** (attempt - 1))
+                    if deadline is not None and \
+                            time.monotonic() + backoff >= deadline:
+                        logger.error(
+                            "save budget exhausted before the grace window "
+                            "ends; giving up rather than sleeping into "
+                            "SIGKILL")
+                        break
+                    if attempt < self.save_retries:
+                        time.sleep(backoff)
+            raise last_err
+        finally:
+            t_save1 = time.monotonic()
+            # beat reset BEFORE unpausing: the watchdog polling between
+            # the two writes must never see unpaused + a pre-save beat
+            self._progress_beat = time.monotonic()
+            self._watchdog_paused = False
+            if self.ledger.active:
+                self.ledger.add("checkpoint_stall", t_save1 - t_save0)
+            self.tracer.complete("ckpt_save", t_save0, t_save1,
+                                 cat="ckpt", track="ckpt",
+                                 args={"tag": tag})
 
     def resume(self, example_batch=None):
         """Restore the newest INTACT tag (rollback order: descending
         step number; every candidate is verified before any restore is
         attempted — never a silent partial restore). Returns the tag
         loaded, or None when no intact checkpoint exists."""
-        for tag in reversed(self._tags()):
-            path = os.path.join(self.save_dir, tag)
-            ok, problems = verify_checkpoint(path)
-            if not ok:
-                logger.warning(
-                    f"checkpoint {path} failed verification "
-                    f"({'; '.join(problems[:3])}); rolling back")
-                self._emit("rollback", self._tag_step(tag))
-                self._quarantine(tag)
-                continue
-            try:
-                _, client = self.engine.load_checkpoint(
-                    self.save_dir, tag=tag, example_batch=example_batch)
-            except Exception as e:
-                # verified-but-unloadable (e.g. structure mismatch):
-                # surface it, try older — but do NOT quarantine; the
-                # files are intact
-                logger.warning(f"restore of {path} failed: {e}")
-                self._emit("rollback", self._tag_step(tag))
-                continue
-            self._restore_rng(client or {})
-            self._advance_latest(tag)   # repair a latest that pointed
-            self.report.resumed_from = tag  # at a now-quarantined tag
-            self._emit("resumed", self._tag_step(tag))
-            return tag
-        return None
+        t0 = time.monotonic()
+        restored = None
+        self._watchdog_paused = True
+        try:
+            for tag in reversed(self._tags()):
+                path = os.path.join(self.save_dir, tag)
+                ok, problems = verify_checkpoint(path)
+                if not ok:
+                    logger.warning(
+                        f"checkpoint {path} failed verification "
+                        f"({'; '.join(problems[:3])}); rolling back")
+                    self._emit("rollback", self._tag_step(tag))
+                    self.tracer.instant(
+                        "rollback", cat="ckpt", track="ckpt",
+                        args={"tag": tag, "reason": "verify_failed"})
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.dump(
+                            f"ckpt_rollback_{tag}",
+                            extra={"tag": tag,
+                                   "problems": problems[:5]})
+                    self._quarantine(tag)
+                    continue
+                try:
+                    _, client = self.engine.load_checkpoint(
+                        self.save_dir, tag=tag,
+                        example_batch=example_batch)
+                except Exception as e:
+                    # verified-but-unloadable (e.g. structure mismatch):
+                    # surface it, try older — but do NOT quarantine; the
+                    # files are intact
+                    logger.warning(f"restore of {path} failed: {e}")
+                    self._emit("rollback", self._tag_step(tag))
+                    self.tracer.instant(
+                        "rollback", cat="ckpt", track="ckpt",
+                        args={"tag": tag, "reason": "load_failed"})
+                    continue
+                self._restore_rng(client or {})
+                saved = (client or {}).get("resilience") or {}
+                # run identity fallback: when run_state.json was lost
+                # (checkpoints copied to a fresh save_dir, work-dir
+                # cleanup) the checkpoint's own record keeps the run id
+                # stable, so the merged trace and the ds_train_* run_id
+                # label don't fork mid-run
+                if not self._had_run_state and saved.get("run_id"):
+                    self.run_id = str(saved["run_id"])
+                    self._had_run_state = True
+                # recompute attribution after the restore: the furthest
+                # step the run EVER reached, from the checkpoint's own
+                # record (run_state.json may be newer; take the max)
+                if saved.get("max_step_reached"):
+                    self._max_step_reached = max(
+                        self._max_step_reached,
+                        int(saved["max_step_reached"]))
+                self._advance_latest(tag)   # repair a latest that pointed
+                self.report.resumed_from = tag  # at a quarantined tag
+                self._emit("resumed", self._tag_step(tag))
+                restored = tag
+                return tag
+            return None
+        finally:
+            self._progress_beat = time.monotonic()
+            self._watchdog_paused = False
+            self.tracer.complete("resume", t0, time.monotonic(),
+                                 cat="ckpt", track="ckpt",
+                                 args={"restored": restored})
+
+    # --------------------------------------------------- goodput + gauges
+    def _compile_count(self):
+        probe = getattr(self.engine, "train_compile_count", None)
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:
+            return None
+
+    def _account_step(self, fstep, t0, t1, pre_cc):
+        """Classify one completed train step's wall time, feed the
+        EWMA straggler watchdog, and advance the progress beat."""
+        dt = t1 - t0
+        post_cc = self._compile_count()
+        if pre_cc is not None and post_cc is not None and post_cc > pre_cc:
+            category = "compile_warmup"
+        elif fstep < self._max_step_reached:
+            category = "recompute"
+        else:
+            category = "productive"
+        self.ledger.add(category, dt)
+        self.tracer.complete("train_step", t0, t1, cat="train",
+                             track="steps",
+                             args={"step": fstep, "category": category,
+                                   "ms": round(dt * 1e3, 3)})
+        # EWMA straggler watchdog — compile steps are expected outliers
+        # and stay out of both the check and the average
+        if category != "compile_warmup":
+            if self._ema_step_s is not None and self._ema_n >= 3 and \
+                    dt > self.straggler_factor * self._ema_step_s:
+                self.report.stragglers += 1
+                self._emit_events([("train/straggler", dt, fstep + 1)])
+                self.tracer.instant(
+                    "straggler", cat="train", track="steps",
+                    args={"step": fstep, "s": round(dt, 4),
+                          "ema_s": round(self._ema_step_s, 4)})
+                if self.flight_recorder is not None:
+                    self.flight_recorder.dump(
+                        f"train_straggler_step{fstep}",
+                        extra={"step": fstep, "step_s": dt,
+                               "ema_s": self._ema_step_s})
+            self._ema_step_s = dt if self._ema_step_s is None \
+                else 0.3 * dt + 0.7 * self._ema_step_s
+            self._ema_n += 1
+        self._max_step_reached = max(self._max_step_reached,
+                                     self.engine.global_steps)
+        self._progress_beat = time.monotonic()
+        self._stall_fired = False
+        self._write_run_state()
+        return category
+
+    def _flops_profile_cached(self):
+        if self._flops is None:
+            if not self.mfu_gauge:
+                self._flops = False
+            else:
+                try:
+                    self._flops = self.engine.flops_profile()
+                except Exception as e:
+                    logger.warning(
+                        f"flops profile unavailable; MFU gauge off ({e})")
+                    self._flops = False
+        return self._flops or None
+
+    def _resolve_peak(self):
+        if self._peak_flops_total is None:
+            per_dev = self._peak_flops_per_device
+            if per_dev is None:
+                from deepspeed_tpu.profiling.flops_profiler.profiler \
+                    import peak_flops_per_device
+                per_dev = peak_flops_per_device()
+            self._peak_flops_total = float(per_dev) * jax.device_count()
+        return self._peak_flops_total
+
+    def _emit_gauges(self):
+        """Per-window throughput gauges over WALL time since the last
+        emission (bench semantics: data loading and bookkeeping count
+        against throughput, exactly as they do in a real run)."""
+        now = time.monotonic()
+        steps = self.report.steps - self._gauge_steps0
+        wall = now - self._gauge_t0
+        if steps <= 0 or wall <= 0:
+            return
+        step_no = self.engine.global_steps
+        events = [("train/step_time_ms", wall / steps * 1e3, step_no)]
+        # the first call may pay a one-time XLA cost-analysis; it runs
+        # AFTER this window's wall was read and BEFORE the next window
+        # opens (below), so it lands in ledger idle, never in a gauge
+        prof = self._flops_profile_cached()
+        if prof:
+            tokens_per_step = prof["flops_per_step"] / \
+                max(prof["flops_per_token"], 1e-9)
+            self._last_tokens_per_s = tokens_per_step * steps / wall
+            achieved = prof["flops_per_step"] * steps / wall
+            self._last_mfu = achieved / self._resolve_peak()
+            events += [
+                ("train/tokens_per_s", self._last_tokens_per_s, step_no),
+                ("train/tflops_achieved", achieved / 1e12, step_no),
+                ("train/mfu", self._last_mfu, step_no)]
+        events += [(f"train/goodput/{c}", f, step_no)
+                   for c, f in self.ledger.fractions().items()]
+        self._emit_events(events)
+        self._gauge_t0, self._gauge_steps0 = (time.monotonic(),
+                                              self.report.steps)
 
     # ---------------------------------------------------------- training
     def train(self, num_steps, batch_fn=None, data_iter=None):
@@ -354,29 +833,64 @@ class ResilientTrainer:
         assert batch_fn is not None or data_iter is not None or \
             self.engine.training_dataloader is not None
         self.report = TrainReport()
+        self.incarnation += 1
+        self.report.run_id = self.run_id
+        self.report.incarnation = self.incarnation
+        if self.tracer.enabled:
+            self.tracer.process = \
+                f"train:{self.run_id}:inc{self.incarnation}"
         consecutive_nan = 0
         self._install_signals()
+        self.ledger.begin()
+        self._gauge_t0 = time.monotonic()
+        self._gauge_steps0 = 0
+        self._progress_beat = time.monotonic()
+        self._stall_fired = False
+        self._write_run_state()
+        if self.stall_timeout_s:
+            self._watchdog = _NoProgressWatchdog(self)
+            self._watchdog.start()
         try:
             while self.engine.global_steps < num_steps:
                 if self._preempt_requested:
-                    self.report.preempted_at_step = self.engine.global_steps
-                    tag = f"{self.tag_prefix}{self.engine.global_steps}"
+                    t_drain = time.monotonic()
+                    step = self.engine.global_steps
+                    self.report.preempted_at_step = step
+                    self.tracer.instant("preemption", cat="train",
+                                        track="steps",
+                                        args={"step": step})
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.dump(
+                            f"preemption_step{step}",
+                            extra={"step": step})
+                    tag = f"{self.tag_prefix}{step}"
                     if self._read_latest() != tag:   # periodic save may
                         self.save(tag,               # have just landed
                                   budget_s=self.preemption_grace_s)
                     self.report.status = "preempted"
-                    self._emit("preempted", self.engine.global_steps)
+                    self._emit("preempted", step)
+                    self.tracer.complete("preemption_drain", t_drain,
+                                         time.monotonic(), cat="train",
+                                         track="steps",
+                                         args={"step": step})
                     logger.warning(
-                        f"preemption checkpoint at step "
-                        f"{self.engine.global_steps}; exiting cleanly")
+                        f"preemption checkpoint at step {step}; "
+                        "exiting cleanly")
                     return self.report
                 batches = None
                 if batch_fn is not None:
-                    batches = batch_fn(self.engine.global_steps)
+                    with self.tracer.span(
+                            "data_load", cat="train", track="data",
+                            args={"step": self.engine.global_steps}):
+                        batches = batch_fn(self.engine.global_steps)
                     if isinstance(batches, dict):
                         batches = [batches]
+                fstep = self.engine.global_steps
+                pre_cc = self._compile_count()
+                t0 = time.monotonic()
                 loss = self.engine.train_batch(data_iter=data_iter,
                                                batches=batches, sync=True)
+                self._account_step(fstep, t0, time.monotonic(), pre_cc)
                 self.report.steps += 1
                 self.report.last_loss = float(loss)
                 if not np.isfinite(loss):
@@ -389,34 +903,61 @@ class ResilientTrainer:
                 if self.save_interval and self.engine.global_steps and \
                         self.engine.global_steps % self.save_interval == 0:
                     self.save()
+                if self.gauge_interval and \
+                        self.report.steps % self.gauge_interval == 0:
+                    self._emit_gauges()
             self.report.status = "completed"
             return self.report
         finally:
             self._restore_signals()
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+            self.ledger.finish()
+            self.report.ledger = self.ledger.as_dict()
+            self.report.mfu = self._last_mfu
+            self.report.tokens_per_s = self._last_tokens_per_s
+            step_no = self.engine.global_steps
+            self._emit_events(
+                [(f"train/goodput/{c}", f, step_no)
+                 for c, f in self.ledger.fractions().items()])
+            self._write_run_state()
+            self._flush_trace()
 
     def _handle_nan(self, consecutive_nan):
-        if self.nan_policy == "halt":
-            raise DivergenceError(
-                f"non-finite loss at step {self.engine.global_steps}")
-        if self.nan_policy == "skip":
-            logger.warning(
-                f"non-finite loss at step {self.engine.global_steps}; "
-                f"policy=skip ({consecutive_nan} consecutive)")
-            if consecutive_nan > self.max_nan_events:
+        t0 = time.monotonic()
+        try:
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump(
+                    f"divergence_step{self.engine.global_steps}",
+                    extra={"step": self.engine.global_steps,
+                           "policy": self.nan_policy})
+            if self.nan_policy == "halt":
                 raise DivergenceError(
-                    f"{consecutive_nan} consecutive non-finite losses "
-                    f"exceed budget {self.max_nan_events}")
-            return
-        # restore policy: roll back to the newest intact checkpoint
-        if self.report.restores >= self.max_nan_events:
-            raise DivergenceError(
-                f"watchdog restore budget ({self.max_nan_events}) "
-                "exhausted")
-        tag = self.resume()
-        if tag is None:
-            raise DivergenceError(
-                "non-finite loss and no intact checkpoint to restore")
-        self.report.restores += 1
-        logger.warning(
-            f"non-finite loss: restored {tag} "
-            f"(step {self.engine.global_steps}) and continuing")
+                    f"non-finite loss at step {self.engine.global_steps}")
+            if self.nan_policy == "skip":
+                logger.warning(
+                    f"non-finite loss at step {self.engine.global_steps}; "
+                    f"policy=skip ({consecutive_nan} consecutive)")
+                if consecutive_nan > self.max_nan_events:
+                    raise DivergenceError(
+                        f"{consecutive_nan} consecutive non-finite losses "
+                        f"exceed budget {self.max_nan_events}")
+                return
+            # restore policy: roll back to the newest intact checkpoint
+            if self.report.restores >= self.max_nan_events:
+                raise DivergenceError(
+                    f"watchdog restore budget ({self.max_nan_events}) "
+                    "exhausted")
+            tag = self.resume()
+            if tag is None:
+                raise DivergenceError(
+                    "non-finite loss and no intact checkpoint to restore")
+            self.report.restores += 1
+            logger.warning(
+                f"non-finite loss: restored {tag} "
+                f"(step {self.engine.global_steps}) and continuing")
+        finally:
+            if self.ledger.active:
+                self.ledger.add("divergence_retry",
+                                time.monotonic() - t0)

@@ -56,6 +56,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, has_overflow
                                                     update_scale)
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule
 from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.tracing import NULL_TRACER
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,
                                        FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
@@ -314,13 +315,23 @@ class DeepSpeedEngine:
 
         self.timers = SynchronizedWallClockTimer() \
             if self._config.wall_clock_breakdown else NoopTimer()
-        self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size(),
-            steps_per_output=self._config.steps_per_print)
 
         # monitor
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(self._config.monitor_config)
+
+        # throughput reporting rides the monitor event stream when a
+        # sink is enabled (train/samples_per_s*), else the legacy print
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self._config.steps_per_print,
+            monitor=self.monitor)
+
+        # host-side span tracing (deepspeed_tpu/tracing.py): the shared
+        # no-op singleton unless a supervisor/caller installs a real
+        # tracer — tracing off must stay byte-identical (no device op,
+        # no new jit signature; pinned by tests/unit/test_train_trace.py)
+        self.tracer = NULL_TRACER
 
         dist.configure(self._config)
 
@@ -1351,6 +1362,36 @@ class DeepSpeedEngine:
         self._flops_profile_cache = out   # shapes are fixed per engine
         return out
 
+    def set_tracer(self, tracer):
+        """Install a host-side span tracer (None restores the shared
+        no-op singleton).  Tracing is host bookkeeping only — it can
+        never change tokens, losses or compile counts."""
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+
+    # jitted train callables whose signature-cache sizes define "the
+    # compile count" of a training run (the goodput ledger's
+    # compile_warmup detector and the tracing-off parity pin both
+    # consume this; mirrors the serving-side *_compile_count methods)
+    _TRAIN_JIT_FNS = ("_step_gas1", "_micro_first", "_micro_next",
+                      "_step_last", "_step_gasN", "_step_loop",
+                      "_micro_offload", "_step_sparse_dp",
+                      "_step_onebit", "_step_onebit_gasN")
+
+    def train_compile_counts(self):
+        """Compiled-signature counts per jitted train callable (only
+        the ones this configuration has built)."""
+        out = {}
+        for name in self._TRAIN_JIT_FNS:
+            fn = getattr(self, name, None)
+            cache_size = getattr(fn, "_cache_size", None)
+            if cache_size is not None:
+                out[name.lstrip("_")] = cache_size()
+        return out
+
+    def train_compile_count(self):
+        """Total compiled train-step signatures (cheap per-step probe)."""
+        return sum(self.train_compile_counts().values())
+
     def _maybe_log_flops(self):
         cfg = self._config.flops_profiler
         if not cfg.enabled or self.global_steps != cfg.profile_step:
@@ -1622,16 +1663,20 @@ class DeepSpeedEngine:
         assert self._next_state is not None, \
             "step() must follow forward()+backward() at the GAS boundary"
         self.timers(STEP_GLOBAL_TIMER).start()
-        self.state = self._next_state
-        metrics = self._next_metrics
-        self._next_state = None
-        self._next_metrics = None
-        lr = float(self.get_lr()[0])   # the lr this step was taken with
-        self.global_steps += 1
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-        self._last_metrics = metrics
-        self._maybe_update_moq()
+        # host share only: the optimizer math itself was fused into the
+        # boundary dispatch — this publishes state + advances schedules
+        with self.tracer.span("optimizer_step", cat="train",
+                              args={"step": self.global_steps}):
+            self.state = self._next_state
+            metrics = self._next_metrics
+            self._next_state = None
+            self._next_metrics = None
+            lr = float(self.get_lr()[0])  # the lr this step was taken with
+            self.global_steps += 1
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self._last_metrics = metrics
+            self._maybe_update_moq()
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._maybe_log_flops()
 
@@ -1739,8 +1784,12 @@ class DeepSpeedEngine:
         work NOT hidden behind device compute."""
         futs, self._offload_futs = self._offload_futs, []
         t0 = time.perf_counter()
-        for f in futs:
-            f.result()
+        # the host-visible share of grad sync in offload mode: D2H +
+        # fp32 accumulate not hidden behind device compute
+        with self.tracer.span("grad_sync", cat="train", track="device",
+                              args={"joined": len(futs)}):
+            for f in futs:
+                f.result()
         if self._offload is not None:
             self._offload.phase.setdefault("join_stall_s", 0.0)
             self._offload.phase["join_stall_s"] += \
@@ -1778,6 +1827,7 @@ class DeepSpeedEngine:
         total time ~ max(host step, transfer), not the sum."""
         self.timers(STEP_GLOBAL_TIMER).start()
         self._join_offload()
+        _t_opt = time.monotonic()
         lr = float(self.get_lr()[0])
         if self._params_nvme:
             # ZeRO-Infinity param tier: the sweep rewrites the NVMe
@@ -1815,6 +1865,11 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         self._last_metrics = metrics
         self._maybe_update_moq()
+        # the host Adam sweep + H2D push IS the optimizer step here
+        self.tracer.complete("optimizer_step", _t_opt, time.monotonic(),
+                             cat="train",
+                             args={"step": self.global_steps,
+                                   "offload": True})
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._maybe_log_flops()
         if self.monitor.enabled and self.global_steps % \
@@ -1847,8 +1902,11 @@ class DeepSpeedEngine:
         faults.fire("train.step", step=fstep)
         if data_iter is None and batches is None:
             data_iter = iter(self.training_dataloader)
+        tr = self.tracer
         if batches is None and self.gas > 1:
-            batches = [next(data_iter) for _ in range(self.gas)]
+            with tr.span("data_load", cat="train", track="data",
+                         args={"n_micro": self.gas, "step": fstep}):
+                batches = [next(data_iter) for _ in range(self.gas)]
         if batches is not None:
             # init BEFORE deciding on the fused path: initialization is
             # what instantiates the offload optimizer that rules it out
@@ -1860,9 +1918,19 @@ class DeepSpeedEngine:
         losses = []
         self.tput_timer.start()
         for i in range(self.gas):
-            batch = batches[i] if batches is not None else next(data_iter)
-            loss = self.forward(batch)
-            self.backward(loss)
+            if batches is not None:
+                batch = batches[i]
+            else:
+                with tr.span("data_load", cat="train", track="data",
+                             args={"micro": i, "step": fstep}):
+                    batch = next(data_iter)
+            # one span per micro dispatch; gas>1 gets per-micro tracks
+            # so the accumulation window reads as parallel timeline rows
+            with tr.span("fwd_bwd_dispatch", cat="train",
+                         track=f"micro{i}" if self.gas > 1 else "scheduler",
+                         args={"micro": i, "step": fstep}):
+                loss = self.forward(batch)
+                self.backward(loss)
             losses.append(loss)
         metrics = self.step()
         self.tput_timer.stop(global_step=True)
@@ -1872,7 +1940,9 @@ class DeepSpeedEngine:
             # metric the fused path reports)
             return faults.transform("train.loss",
                                     jnp.mean(jnp.stack(losses)), step=fstep)
-        mean_loss = float(np.mean([jax.device_get(l) for l in losses]))
+        with tr.span("device_wait", cat="train", track="device",
+                     args={"step": fstep}):
+            mean_loss = float(np.mean([jax.device_get(l) for l in losses]))
         self._log_train_step(mean_loss, metrics)
         # fault transform: force a NaN loss on an exact step so the
         # supervisor's divergence watchdog is testable end to end
@@ -1927,21 +1997,30 @@ class DeepSpeedEngine:
             raise RuntimeError("fused window requires an aligned boundary")
         self.tput_timer.start()
         self._last_batch = batches[0]
-        dev = self._inject_reserved_keys(self._stack_batches(batches),
-                                         n_micro=self.gas)
-        rng, self._rng = jax.random.split(self._rng)
-        if self._compressed_axis:
-            mean_loss_dev, new_state, metrics, self._onebit_we, \
-                self._onebit_se = self._step_onebit_gasN(
+        tr = self.tracer
+        # the whole fused window (fwd+bwd+optimizer apply, grad sync
+        # fused inside the XLA program) is ONE async dispatch: batch
+        # staging + launch is the host's share; the blocking fetch below
+        # is the device's
+        fused_span = tr.span("fwd_bwd_dispatch", cat="train",
+                             args={"gas": self.gas, "fused": True,
+                                   "step": self.global_steps})
+        with fused_span:
+            dev = self._inject_reserved_keys(self._stack_batches(batches),
+                                             n_micro=self.gas)
+            rng, self._rng = jax.random.split(self._rng)
+            if self._compressed_axis:
+                mean_loss_dev, new_state, metrics, self._onebit_we, \
+                    self._onebit_se = self._step_onebit_gasN(
+                        self.state.params, self.state.opt_state,
+                        self.state.replace(params=None, opt_state=None),
+                        dev, rng, float(self.get_lr()[0]),
+                        self._onebit_we, self._onebit_se)
+            else:
+                mean_loss_dev, new_state, metrics = self._step_gasN(
                     self.state.params, self.state.opt_state,
                     self.state.replace(params=None, opt_state=None),
-                    dev, rng, float(self.get_lr()[0]),
-                    self._onebit_we, self._onebit_se)
-        else:
-            mean_loss_dev, new_state, metrics = self._step_gasN(
-                self.state.params, self.state.opt_state,
-                self.state.replace(params=None, opt_state=None),
-                dev, rng, float(self.get_lr()[0]))
+                    dev, rng, float(self.get_lr()[0]))
         self.state = new_state
         self.micro_steps += self.gas
         self.global_samples += self.train_micro_batch_size_per_gpu() * \
@@ -1953,13 +2032,15 @@ class DeepSpeedEngine:
         self._maybe_update_moq()
         self.tput_timer.stop(global_step=True)
         self._maybe_log_flops()
-        if self.global_steps % self._config.steps_per_print == 0:
-            self._log_train_step(float(jax.device_get(mean_loss_dev)),
-                                 metrics)
+        if sync or self.global_steps % self._config.steps_per_print == 0:
+            with tr.span("device_wait", cat="train", track="device",
+                         args={"step": self.global_steps}):
+                mean_loss_host = float(jax.device_get(mean_loss_dev))
+            if self.global_steps % self._config.steps_per_print == 0:
+                self._log_train_step(mean_loss_host, metrics)
         # sync=False returns the device scalar (async): a float() fetch
         # per step costs a full host round trip on relayed devices
-        return float(jax.device_get(mean_loss_dev)) if sync \
-            else mean_loss_dev
+        return mean_loss_host if sync else mean_loss_dev
 
     def train_loop(self, batches, sync=False):
         """Run ``len(batches) // gas`` complete optimizer steps in a

@@ -1,5 +1,13 @@
 """End-to-end request tracing for the serving tier.
 
+The tracing core — :class:`SpanTracer`, :class:`FlightRecorder`,
+:func:`merge_chrome`, :func:`prometheus_text`, the shared
+:data:`EVENT_TAXONOMY` and the :data:`NULL_TRACER` singleton — lives in
+:mod:`deepspeed_tpu.tracing` since the training tier adopted the same
+machinery (step spans, goodput ledger, stall watchdog); this module
+re-exports it unchanged for the serving tier's callers and keeps the
+serving-only pieces (the device-profile integration below).
+
 Three export surfaces over ONE span stream (Dapper-style per-request
 tracing plus a flight recorder — the standard answer for "where did the
 time go / what was the fleet doing" in a multi-tier serving system):
@@ -42,423 +50,14 @@ routed span to the survivor's replay admission.
 
 import json
 import os
-import time
-from collections import deque
 
-from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.tracing import (EVENT_TAXONOMY,  # noqa: F401
+                                   NULL_TRACER,
+                                   FlightRecorder,
+                                   SpanTracer,
+                                   merge_chrome,
+                                   prometheus_text)
 from deepspeed_tpu.utils.logging import logger
-
-# ---------------------------------------------------------------------
-# Event taxonomy: every (tag, value, step) event name the serving tier
-# emits through the monitor/ write_events contract.  This is an API —
-# dashboards, the CSV sinks and the Prometheus exposition key on these
-# names — so tests/unit/test_monitor.py pins that (a) everything
-# ServingMetrics/ClusterMetrics emits is listed here and (b) every name
-# here is documented in docs/observability.md.  Renaming an event
-# without updating both fails the pin, not an operator's dashboard.
-
-EVENT_TAXONOMY = {
-    # per-step gauges
-    "serving/queue_depth": "requests waiting for a slot, per step",
-    "serving/running": "live decode slots, per step",
-    "serving/waiting": "queued requests, per step (= queue_depth)",
-    "serving/page_utilization": "KV page pool occupancy fraction",
-    "serving/device_wait_ms": "host time blocked on the device, per step",
-    "serving/host_ms": "host bookkeeping time, per step",
-    # request latency
-    "serving/ttft_ms": "submit -> first token, per request",
-    "serving/token_latency_ms": "inter-token gap, per token",
-    "serving/tbt_ms": "time between token bursts (horizon cadence)",
-    # fused horizons
-    "serving/horizon": "fused decode horizon harvested",
-    "serving/horizon_tokens": "tokens delivered by one horizon",
-    "serving/horizon_wait_ms": "device wait at one horizon's harvest",
-    # terminal outcomes (distinct from finished)
-    "serving/failed": "request failed (contained per-request error)",
-    "serving/shed": "request refused (deadline/capacity)",
-    "serving/cancelled": "request cancelled by the client",
-    # prefix cache
-    "serving/prefix_cache/cached_pages": "pages held by the radix cache",
-    "serving/prefix_cache/cached_prefix_tokens":
-        "prompt tokens served from cache at one admission",
-    "serving/prefix_cache/hit_rate": "admission-time cache hit rate",
-    "serving/prefix_cache/prefill_tokens_saved":
-        "cumulative prefill tokens not computed",
-    "serving/prefix_cache/evicted_pages":
-        "cached pages drained under pool pressure",
-    # speculative decoding
-    "serving/spec/k": "draft K of one verify round",
-    "serving/spec/proposed": "draft tokens scored in one round",
-    "serving/spec/accepted": "drafts the target argmax matched",
-    "serving/spec/emitted": "tokens one verify round produced",
-    "serving/spec/acceptance_rate": "per-round acceptance fraction",
-    "serving/spec/rollback_tokens": "KV positions rolled back",
-    "serving/spec/degraded": "drafter/verify fault contained",
-    "serving/spec/wait_ms": "device wait harvesting a verify round",
-    # disaggregation
-    "serving/handoff": "one prefill->decode KV chain handed off",
-    "serving/handoff_tokens": "prefilled positions transferred",
-    # serving topology (construction-time gauges; axis set =
-    # MeshConfig's known axes)
-    "serving/mesh/data": "mesh data-axis size",
-    "serving/mesh/model": "mesh model-axis size",
-    "serving/mesh/pipe": "mesh pipe-axis size",
-    "serving/mesh/expert": "mesh expert-axis size",
-    "serving/mesh/sequence": "mesh sequence-axis size",
-    "serving/mesh/kv_pool_bytes_per_device":
-        "per-device KV pool footprint",
-    # cluster tier (ClusterMetrics)
-    "cluster/finished": "journal entry finished",
-    "cluster/failed": "journal entry failed",
-    "cluster/shed": "journal entry shed",
-    "cluster/cancelled": "journal entry cancelled",
-    "cluster/heartbeat_miss": "one missed replica heartbeat",
-    "cluster/failover": "replica death detected",
-    "cluster/replay": "dead replica's entry requeued onto survivors",
-    "cluster/retry": "backpressure admission retry",
-    "cluster/handoff": "prefill->decode packet delivered",
-    "cluster/handoff_degrade": "handoff failed; requeued unified",
-    "cluster/drain": "replica drain completed",
-    "cluster/restart": "replica restarted",
-}
-
-
-# ---------------------------------------------------------------- spans
-
-class _NullSpan:
-    """Reusable no-op context manager for the disabled tracer."""
-
-    __slots__ = ()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
-
-
-_NULL_SPAN = _NullSpan()
-
-
-class _Span:
-    """Context manager recording one complete ("X") span on exit."""
-
-    __slots__ = ("tracer", "name", "cat", "track", "rid", "args",
-                 "process", "t0")
-
-    def __init__(self, tracer, name, cat, track, rid, args, process):
-        self.tracer = tracer
-        self.name = name
-        self.cat = cat
-        self.track = track
-        self.rid = rid
-        self.args = args
-        self.process = process
-        self.t0 = time.monotonic()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.tracer.complete(self.name, self.t0, time.monotonic(),
-                             cat=self.cat, track=self.track, rid=self.rid,
-                             args=self.args, process=self.process)
-        return False
-
-
-class SpanTracer:
-    """Low-overhead host-side span recorder with a bounded ring buffer.
-
-    The ring (``capacity`` events) makes every tracer double as its own
-    flight recorder: a dump after an incident contains the most recent
-    window of spans without any always-on file I/O.  All methods are
-    no-ops semantically when ``enabled`` is False — but prefer the
-    shared :data:`NULL_TRACER` for the disabled case so call sites pay
-    one attribute load, not an allocation.
-    """
-
-    def __init__(self, process="serve", enabled=True, capacity=8192):
-        self.process = process
-        self.enabled = bool(enabled)
-        self.capacity = int(capacity)
-        # events are flat tuples (ph, name, cat, ts, dur, track, rid,
-        # args, process, flow_id) — recording sits on the serving hot
-        # path, so the per-span cost is one tuple + one deque append;
-        # dict building is deferred to export
-        self.events = deque(maxlen=self.capacity)
-        self.dropped = 0          # events rotated out of the ring
-        # monotonic -> epoch shift, captured once so exported spans from
-        # different processes line up on the wall clock
-        self._epoch_offset = time.time() - time.monotonic()
-
-    # ------------------------------------------------------- recording
-    def _push(self, ev):
-        if len(self.events) == self.capacity:
-            self.dropped += 1
-        self.events.append(ev)
-
-    def span(self, name, *, cat="serving", track="scheduler", rid=None,
-             args=None, process=None):
-        """``with tracer.span("prefill_chunk", track=slot, rid=rid):``"""
-        if not self.enabled:
-            return _NULL_SPAN
-        return _Span(self, name, cat, track, rid, args, process)
-
-    def complete(self, name, t0, t1, *, cat="serving", track="scheduler",
-                 rid=None, args=None, process=None):
-        """Record a finished span from two monotonic timestamps (for
-        phases whose start predates the call, e.g. queue wait)."""
-        if not self.enabled:
-            return
-        self._push(("X", name, cat, t0, t1 - t0 if t1 > t0 else 0.0,
-                    track, rid, args, process, None))
-
-    def instant(self, name, *, cat="serving", track="scheduler", rid=None,
-                args=None, process=None, ts=None):
-        if not self.enabled:
-            return
-        self._push(("i", name, cat,
-                    time.monotonic() if ts is None else ts, 0.0,
-                    track, rid, args, process, None))
-
-    def flow(self, phase, flow_id, name, *, cat="failover",
-             track="scheduler", rid=None, args=None, process=None):
-        """Chrome-trace flow event: ``phase`` 's' starts an arrow,
-        'f' finishes it; events sharing ``flow_id`` are linked (the
-        explicit dead-replica -> survivor replay link)."""
-        if not self.enabled:
-            return
-        self._push((phase, name, cat, time.monotonic(), 0.0,
-                    track, rid, args, process, flow_id))
-
-    # -------------------------------------------------------- exporting
-    def serialized(self, drain=False):
-        """Events with epoch-resolved timestamps (µs) but unresolved
-        process/track labels — the wire format a worker process ships to
-        the router's collector.  ``drain=True`` empties the ring (ship
-        each span once)."""
-        out = []
-        src = self.events
-        for ph, name, cat, ts, dur, track, rid, args, process, fid \
-                in list(src):
-            e = {"ph": ph, "name": name, "cat": cat,
-                 "ts": (ts + self._epoch_offset) * 1e6,
-                 "track": track, "rid": rid, "args": args,
-                 "process": process or self.process}
-            if ph == "X":
-                e["dur"] = dur * 1e6
-            if fid is not None:
-                e["id"] = fid
-            out.append(e)
-        if drain:
-            src.clear()
-        return out
-
-    def to_chrome(self, extra_events=None):
-        """The full Chrome-trace JSON object for this tracer (merge
-        tracers with :func:`merge_chrome`)."""
-        return merge_chrome([self.serialized() + list(extra_events or [])])
-
-    def dump(self, path):
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_chrome(), f)
-            f.write("\n")
-        return path
-
-
-class _NullTracer(SpanTracer):
-    """The disabled tracer: every method is a no-op, ``span`` returns a
-    shared no-op context manager.  One module-level instance is shared
-    by every untraced scheduler so "tracing off" costs one attribute
-    load and one falsy check per call site."""
-
-    def __init__(self):
-        super().__init__(process="null", enabled=False, capacity=1)
-
-    def _push(self, ev):     # pragma: no cover — nothing may record
-        raise AssertionError("NULL_TRACER must never record events")
-
-
-NULL_TRACER = _NullTracer()
-
-
-def merge_chrome(event_lists):
-    """Merge serialized event lists (each from :meth:`SpanTracer.
-    serialized`) into one Chrome-trace JSON object: processes become
-    pids (with ``process_name`` metadata), (process, track) pairs
-    become tids (with ``thread_name`` metadata), flows keep their
-    ids."""
-    pids = {}
-    tids = {}
-    out = []
-
-    def pid_for(process):
-        if process not in pids:
-            pids[process] = len(pids) + 1
-            out.append({"ph": "M", "name": "process_name",
-                        "pid": pids[process], "tid": 0,
-                        "args": {"name": str(process)}})
-        return pids[process]
-
-    def tid_for(process, track):
-        key = (process, track)
-        if key not in tids:
-            tids[key] = len([k for k in tids if k[0] == process]) + 1
-            out.append({"ph": "M", "name": "thread_name",
-                        "pid": pid_for(process), "tid": tids[key],
-                        "args": {"name": track if isinstance(track, str)
-                                 else f"slot {track}"}})
-        return tids[key]
-
-    for events in event_lists:
-        for ev in events:
-            process = ev.get("process") or "serve"
-            row = {"name": ev["name"], "cat": ev.get("cat", "serving"),
-                   "ph": ev["ph"], "ts": ev["ts"],
-                   "pid": pid_for(process),
-                   "tid": tid_for(process, ev.get("track", "scheduler"))}
-            if ev["ph"] == "X":
-                row["dur"] = ev.get("dur", 0.0)
-            if ev["ph"] == "i":
-                row["s"] = "t"      # thread-scoped instant
-            if "id" in ev:
-                row["id"] = ev["id"]
-            args = dict(ev.get("args") or {})
-            if ev.get("rid") is not None:
-                args["rid"] = ev["rid"]
-            if args:
-                row["args"] = args
-            out.append(row)
-    return {"traceEvents": out, "displayTimeUnit": "ms"}
-
-
-# ------------------------------------------------------ flight recorder
-
-class FlightRecorder:
-    """Bounded post-incident dumps of the recent span window.
-
-    Register every tracer in the process (router + one per replica);
-    :meth:`dump` writes one JSON file per incident into ``out_dir``:
-    the trigger reason, the journal entry in flight (when the caller
-    has one — the dead replica's replayed request), and the merged
-    recent-span window from every registered source.  ``limit`` bounds
-    files per process so an incident storm cannot fill a disk.
-
-    Triggers wired by the serving tier:
-
-    * replica death (``ClusterRouter._on_death``),
-    * a fault point actually firing (:meth:`arm_fault_observer` hooks
-      ``resilience.faults.observe``),
-    * an uncontained serving-loop error (``bin/ds_serve``).
-    """
-
-    def __init__(self, out_dir, limit=16):
-        self.out_dir = out_dir
-        self.limit = int(limit)
-        self.count = 0
-        self.skipped = 0
-        self._tracers = {}        # label -> SpanTracer
-        self._extra_events = []   # pre-serialized events (dead workers)
-        self._fault_observer = None
-        self.dumps = []           # paths written
-
-    def register(self, label, source):
-        """``source``: a :class:`SpanTracer`, or any callable returning
-        a list of pre-serialized events (a ProcessReplica's collected
-        worker spans)."""
-        self._tracers[label] = source
-
-    def add_events(self, events):
-        """Adopt already-serialized span events (e.g. collected from a
-        worker process that has since been SIGKILLed)."""
-        self._extra_events.extend(events)
-
-    def dump(self, reason, *, journal_entry=None, extra=None):
-        """Write one flight record; returns the path (None once
-        ``limit`` is reached — the count of skipped dumps is kept)."""
-        if self.count >= self.limit:
-            self.skipped += 1
-            return None
-        self.count += 1
-        lists, dropped = [], {}
-        for label, src in self._tracers.items():
-            lists.append(src.serialized() if hasattr(src, "serialized")
-                         else list(src()))
-            dropped[label] = getattr(src, "dropped", 0)
-        record = {
-            "reason": reason,
-            "wall_time": time.time(),
-            "journal_entry": journal_entry,
-            "extra": extra,
-            "dropped_spans": dropped,
-            "trace": merge_chrome(lists + [self._extra_events]),
-        }
-        os.makedirs(self.out_dir, exist_ok=True)
-        safe = "".join(c if c.isalnum() or c in "-_." else "_"
-                       for c in str(reason))[:64]
-        path = os.path.join(self.out_dir,
-                            f"flight_{self.count:03d}_{safe}.json")
-        with open(path, "w") as f:
-            json.dump(record, f)
-            f.write("\n")
-        self.dumps.append(path)
-        return path
-
-    # ---------------------------------------------------- fault trigger
-    def arm_fault_observer(self):
-        """Auto-dump whenever a fault point actually FIRES (an armed
-        plan's action ran) — the injected chaos is exactly the moment
-        the recent-span window is worth keeping."""
-        if self._fault_observer is not None:
-            return
-        def _on_fire(point, ctx):
-            self.dump(f"fault:{point}", extra={"ctx": {
-                k: v for k, v in ctx.items()
-                if isinstance(v, (int, float, str, bool, type(None)))}})
-        self._fault_observer = faults.observe(_on_fire)
-
-    def disarm_fault_observer(self):
-        if self._fault_observer is not None:
-            faults.unobserve(self._fault_observer)
-            self._fault_observer = None
-
-
-# --------------------------------------------------- prometheus export
-
-def _prom_name(prefix, key):
-    safe = "".join(c if c.isalnum() or c == "_" else "_"
-                   for c in str(key))
-    return f"{prefix}_{safe}"
-
-
-def prometheus_text(metrics, *, prefix="ds_serving", labels=None,
-                    help_map=None):
-    """Render a flat dict of counters/gauges (``health()`` and/or
-    ``summary()`` output) in the Prometheus text exposition format.
-
-    Non-numeric values (strings, lists, nested dicts, None) are
-    skipped — the JSONL health dump carries those; this surface is for
-    scrapers.  Booleans export as 0/1.  ``labels`` (dict) are attached
-    to every sample, e.g. ``{"replica": "replica0"}``.
-    """
-    label_s = ""
-    if labels:
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-        label_s = "{" + inner + "}"
-    lines = []
-    for key in sorted(metrics):
-        val = metrics[key]
-        if isinstance(val, bool):
-            val = int(val)
-        if not isinstance(val, (int, float)) or val != val:  # skip NaN
-            continue
-        name = _prom_name(prefix, key)
-        if help_map and key in help_map:
-            lines.append(f"# HELP {name} {help_map[key]}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{label_s} {val}")
-    return "\n".join(lines) + "\n"
 
 
 # -------------------------------------------- device-profile integration

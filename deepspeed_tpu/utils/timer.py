@@ -134,9 +134,21 @@ class NoopTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPs accounting (reference: utils/timer.py:137)."""
+    """Samples/sec + TFLOPs accounting (reference: utils/timer.py:137).
 
-    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False):
+    With a ``monitor`` whose ``enabled`` flag is truthy, the periodic
+    report rides the monitor event stream (``train/samples_per_s`` +
+    ``train/samples_per_s_avg``, stepped by global step) instead of the
+    bare ``log_dist`` print — same cadence, same numbers, one telemetry
+    surface (docs/observability.md taxonomy).  Without one (or with a
+    disabled MonitorMaster) the legacy print is preserved byte-for-byte.
+    """
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor_memory=False, monitor=None,
+                 event_prefix="train/"):
+        self.monitor = monitor
+        self.event_prefix = event_prefix
         self.start_time = 0
         self.end_time = 0
         self.started = False
@@ -193,12 +205,24 @@ class ThroughputTimer:
                     # report: with sync only at window edges, a single
                     # step's delta would absorb the async queue drain
                     window = self.batch_size * self._steps_since_report
-                    log_dist(
-                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                        f"global_step={self.global_step_count}, "
-                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
-                        f"CurrSamplesPerSec={window / self.step_elapsed_time:.4f}",
-                        ranks=[0])
+                    curr = window / self.step_elapsed_time
+                    avg = self.avg_samples_per_sec()
+                    if self.monitor is not None and \
+                            getattr(self.monitor, "enabled", True):
+                        events = [(self.event_prefix + "samples_per_s",
+                                   float(curr), self.global_step_count)]
+                        if avg > float("-inf"):
+                            events.append(
+                                (self.event_prefix + "samples_per_s_avg",
+                                 float(avg), self.global_step_count))
+                        self.monitor.write_events(events)
+                    else:
+                        log_dist(
+                            f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                            f"global_step={self.global_step_count}, "
+                            f"RunningAvgSamplesPerSec={avg:.4f}, "
+                            f"CurrSamplesPerSec={curr:.4f}",
+                            ranks=[0])
                     self.step_elapsed_time = 0
                     self._steps_since_report = 0
 

@@ -1,6 +1,6 @@
-"""Monitor-sink coverage + the serving event-taxonomy pin.
+"""Monitor-sink coverage + the unified event-taxonomy pin.
 
-Three contracts the observability tier rides on:
+Contracts the observability tier rides on:
 
 * **RingBufferMonitor** — bounded, ordered ``tail()``: the live
   interrogation surface for supervisors/health endpoints.
@@ -8,15 +8,22 @@ Three contracts the observability tier rides on:
   round-trips: the artifact external dashboards ingest.
 * **Event taxonomy** — every ``serving/*`` / ``cluster/*`` event name
   ``ServingMetrics``/``ClusterMetrics`` emit appears in
-  ``trace.EVENT_TAXONOMY`` AND in ``docs/observability.md``: a rename
-  fails HERE, not an operator's dashboard.
+  ``tracing.EVENT_TAXONOMY`` AND in ``docs/observability.md``: a rename
+  fails HERE, not an operator's dashboard.  (The ``train/*`` +
+  ``resilience/*`` half of the taxonomy is pinned against the live
+  supervisor in ``test_train_trace.py``; the doc pin below covers ALL
+  names.)
 * **step >= 1 invariant** — enforced centrally
   (``monitor.clamp_min_step`` in ``MonitorMaster.write_events`` and the
   metrics funnels), replacing the old per-callsite stamping (the
   ``record_mesh`` step-1 hack).
+* **Prometheus exposition hardening** — arbitrary ``health()`` keys and
+  label values cannot emit malformed exposition: metric/label names are
+  sanitized, label values escaped.
 """
 
 import csv
+import math
 import os
 import types
 
@@ -26,6 +33,7 @@ from deepspeed_tpu.monitor.monitor import (MonitorMaster,
                                            csvMonitor)
 from deepspeed_tpu.serving.metrics import ClusterMetrics, ServingMetrics
 from deepspeed_tpu.serving.trace import EVENT_TAXONOMY
+from deepspeed_tpu.tracing import prometheus_text
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -165,5 +173,53 @@ def test_event_taxonomy_documented():
     assert not missing, f"undocumented events: {missing}"
 
 
+# ------------------------------------------ prometheus hardening
+
+def test_prometheus_metric_names_are_sanitized():
+    """health() keys are arbitrary strings; the exposition format only
+    allows [a-zA-Z0-9_:] in metric names — every other char becomes
+    '_' so a weird key can't emit an unparseable line."""
+    text = prometheus_text({"a b/c-d%": 1.0, "ok_name": 2.0,
+                            "per-request p99 (ms)": 3.5},
+                           prefix="ds_test")
+    lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert "ds_test_a_b_c_d_ 1.0" in lines
+    assert "ds_test_ok_name 2.0" in lines
+    assert "ds_test_per_request_p99__ms_ 3.5" in lines
+    for ln in lines:
+        name = ln.split(" ", 1)[0].split("{", 1)[0]
+        assert all(c.isalnum() or c in "_:" for c in name), ln
+
+
+def test_prometheus_label_values_are_escaped():
+    r"""Backslash, double-quote and newline in label VALUES must escape
+    per the exposition format (\\, \", \n) — a fault reason or model
+    path in a label can't break the sample line."""
+    text = prometheus_text(
+        {"x": 1},
+        labels={"reason": 'disk "full"\nretry', "path": "C:\\tmp"})
+    sample = [ln for ln in text.splitlines()
+              if not ln.startswith("#")][0]
+    assert "\n" not in sample, "raw newline must never survive"
+    assert '\\"full\\"' in sample
+    assert "\\n" in sample
+    assert "C:\\\\tmp" in sample
+    # label names sanitize too (invalid chars -> _, no leading digit)
+    text2 = prometheus_text({"x": 1}, labels={"9bad-key": "v"})
+    assert '_9bad_key="v"' in text2
+
+
+def test_prometheus_value_filtering():
+    """Booleans export 0/1; NaN, strings, None and nested dicts are
+    skipped rather than emitted malformed."""
+    text = prometheus_text({"flag": True, "off": False,
+                            "nan": math.nan, "s": "str",
+                            "none": None, "nested": {"a": 1}},
+                           prefix="p")
+    lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert lines == ["p_flag 1", "p_off 0"]
+
+
 # The end-to-end "live serving loop emits only documented tags" pin
-# rides tests/unit/test_trace.py (it shares that module's engine).
+# rides tests/unit/test_trace.py (it shares that module's engine);
+# the training-side live pin rides tests/unit/test_train_trace.py.

@@ -1,0 +1,408 @@
+"""Training-tier observability (tracing.py + resilience/supervisor.py).
+
+The training-side mirror of ``test_trace.py``'s pins:
+
+* **Zero-cost-when-off** — tracing disabled leaves the loss trajectory
+  AND the compile counts bitwise-identical (the engine holds the shared
+  ``NULL_TRACER``), and records nothing anywhere.
+* **Goodput ledger acceptance** — a fault-injected crash + resume run:
+  the ledger's categories partition 100% of the measured train() wall
+  time, recompute-after-restore and checkpoint-stall are separately
+  nonzero, and the merged cross-incarnation trace loads as valid
+  Chrome JSON with spans from both processes sharing the run id.
+* **Live MFU gauge** — within the documented tolerance of the
+  bench-style MFU (same flops source, externally measured wall) on the
+  same config.
+* **Watchdogs** — an EWMA step-time anomaly emits ``train/straggler``
+  and the no-progress timer emits ``train/stall``; both trigger
+  flight-recorder dumps.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.monitor import RingBufferMonitor
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.ledger import CATEGORIES, GoodputLedger
+from deepspeed_tpu.resilience.supervisor import (ResilientTrainer,
+                                                 merge_train_trace)
+from deepspeed_tpu.tracing import (EVENT_TAXONOMY, NULL_TRACER,
+                                   FlightRecorder, SpanTracer)
+
+from tests.unit.simple_model import (SimpleModel, random_regression_data,
+                                     simple_loss_fn)
+
+
+def make_engine():
+    model = SimpleModel()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, loss_fn=simple_loss_fn(model))
+    return engine
+
+
+def batch_fn(step):
+    return random_regression_data(n=32, seed=step)
+
+
+def _chrome_ok(trace):
+    """Structural validity of a Chrome-trace JSON object (the same
+    checks test_trace.py applies to fleet traces)."""
+    trace = json.loads(json.dumps(trace))
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert isinstance(e["name"], str)
+        assert e["ph"] in ("X", "i", "s", "f", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+    return evs
+
+
+# ------------------------------------------------- zero cost when off
+
+
+def test_tracing_off_training_is_bitwise_identical(tmp_path):
+    """The pin: a traced run and an untraced run produce the SAME loss
+    trajectory and the SAME compile counts — tracing is host-side
+    bookkeeping only; and with tracing off the engine holds the shared
+    NULL_TRACER, which records nothing."""
+    null_events_before = len(NULL_TRACER.events)
+    eng_off = make_engine()
+    assert eng_off.tracer is NULL_TRACER
+    losses_off = [eng_off.train_batch(batches=[batch_fn(i)])
+                  for i in range(5)]
+    compiles_off = eng_off.train_compile_counts()
+
+    eng_on = make_engine()
+    tracer = SpanTracer(process="train-test")
+    eng_on.set_tracer(tracer)
+    losses_on = [eng_on.train_batch(batches=[batch_fn(i)])
+                 for i in range(5)]
+    compiles_on = eng_on.train_compile_counts()
+
+    assert losses_on == losses_off, \
+        "traced training must be bitwise-identical to untraced"
+    assert compiles_on == compiles_off, \
+        "tracing may not add or change compiled signatures"
+    assert compiles_off["step_gas1"] == 1
+    assert tracer.events, "the traced run must actually record spans"
+    names = {e[1] for e in tracer.events}
+    for must in ("fwd_bwd_dispatch", "device_wait", "optimizer_step"):
+        assert must in names, f"missing train span {must}"
+    assert len(NULL_TRACER.events) == null_events_before, \
+        "NULL_TRACER must never record"
+
+    # an untraced supervisor shares the singleton (no per-run alloc)
+    sup = ResilientTrainer(eng_off, str(tmp_path / "d"))
+    assert sup.tracer is NULL_TRACER and eng_off.tracer is NULL_TRACER
+    # set_tracer(None) restores the singleton
+    eng_on.set_tracer(None)
+    assert eng_on.tracer is NULL_TRACER
+
+
+# -------------------------------------------- goodput ledger acceptance
+
+
+def test_goodput_ledger_crash_resume_partition(tmp_path):
+    """Acceptance: periodic save at step 3, injected hard crash at step
+    5 (a preemption with no grace — nothing saved at the boundary), a
+    fresh process resumes from step3 and re-runs steps 4-5.  The
+    cumulative ledger partitions 100% of the measured wall across BOTH
+    incarnations, attributes recompute and checkpoint-stall separately
+    nonzero, and the merged trace is one valid Chrome JSON whose two
+    processes share the persisted run id."""
+    run_dir = str(tmp_path / "run")
+
+    eng1 = make_engine()
+    sup1 = ResilientTrainer(eng1, run_dir, save_interval=3,
+                            tracer=SpanTracer(process="t"))
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.step", step=5, exc=RuntimeError("hard preemption"))
+    t0 = time.monotonic()
+    with faults.injected(inj):
+        with pytest.raises(RuntimeError, match="hard preemption"):
+            sup1.train(8, batch_fn=batch_fn)
+    wall1 = time.monotonic() - t0
+    assert eng1.global_steps == 5
+
+    eng2 = make_engine()
+    sup2 = ResilientTrainer(eng2, run_dir, save_interval=3,
+                            tracer=SpanTracer(process="t"))
+    assert sup2.run_id == sup1.run_id, \
+        "run identity must survive the crash (run_state.json)"
+    assert sup2.resume(example_batch=batch_fn(0)) == "step3"
+    t1 = time.monotonic()
+    rep = sup2.train(8, batch_fn=batch_fn)
+    wall2 = time.monotonic() - t1
+    assert rep.status == "completed" and eng2.global_steps == 8
+    assert rep.incarnation == 2
+
+    led = rep.ledger
+    # categories partition 100% of wall time, exactly
+    assert abs(sum(led["fractions"].values()) - 1.0) < 1e-9
+    assert set(led["seconds"]) == set(CATEGORIES)
+    # ...and the wall they partition is the SUM of both incarnations'
+    # train() walls (measured externally; loose bound for clock skew
+    # between the ledger's monotonic reads and ours)
+    assert abs(led["wall_s"] - (wall1 + wall2)) < 0.25 * (wall1 + wall2)
+    # the attribution the run actually earned
+    assert led["seconds"]["recompute"] > 0, \
+        "re-running steps 4-5 after the step3 restore is recompute"
+    assert led["seconds"]["checkpoint_stall"] > 0, \
+        "the periodic saves must be attributed"
+    assert led["seconds"]["compile_warmup"] > 0, \
+        "each incarnation pays compile again"
+    assert led["seconds"]["productive"] > 0
+
+    # merged cross-incarnation trace: one valid Chrome JSON, both
+    # processes named by the shared run id, spans from both
+    trace_path = os.path.join(run_dir, "trace", "train_trace.json")
+    evs = _chrome_ok(json.load(open(trace_path)))
+    procs = {e["args"]["name"]: e["pid"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(procs) == 2, procs
+    assert all(sup1.run_id in name for name in procs), procs
+    step_pids = {e["pid"] for e in evs if e["name"] == "train_step"}
+    assert step_pids == set(procs.values()), \
+        "train_step spans must come from BOTH incarnations"
+    cats = {e["args"]["category"] for e in evs
+            if e["name"] == "train_step"}
+    assert "recompute" in cats and "productive" in cats
+    names = {e["name"] for e in evs}
+    for must in ("ckpt_save", "ckpt_verify", "ckpt_shard_write",
+                 "resume", "data_load"):
+        assert must in names, f"missing span {must}"
+    # merge_train_trace is idempotent and callable standalone
+    out = merge_train_trace(os.path.join(run_dir, "trace"),
+                            out=str(tmp_path / "again.json"))
+    _chrome_ok(json.load(open(out)))
+
+    # run-identity fallback: run_state.json lost but checkpoints kept —
+    # resume() adopts the run id recorded in the checkpoint client
+    # state, so the trace/exposition identity doesn't fork mid-run
+    os.remove(os.path.join(run_dir, "run_state.json"))
+    eng3 = make_engine()
+    sup3 = ResilientTrainer(eng3, run_dir)
+    assert sup3.run_id != sup1.run_id      # fresh uuid before resume
+    assert sup3.resume(example_batch=batch_fn(0)) is not None
+    assert sup3.run_id == sup1.run_id, \
+        "the checkpoint's saved run id must restore the identity"
+
+
+def test_preemption_drain_spans_and_flight_dump(tmp_path):
+    """A real SIGTERM preemption records the drain span + instant and
+    dumps a flight record before exiting cleanly (the PR-2 preemption
+    contract is untouched: in-flight step finishes, save at the
+    boundary, status 'preempted')."""
+    eng = make_engine()
+    tracer = SpanTracer(process="t")
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    sup = ResilientTrainer(eng, str(tmp_path / "run"), save_interval=3,
+                           tracer=tracer, flight_recorder=flight)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.step", step=4, action=faults.sigterm_self())
+    with faults.injected(inj):
+        rep = sup.train(8, batch_fn=batch_fn)
+    assert rep.status == "preempted"
+    assert rep.preempted_at_step == 5, "the in-flight step must finish"
+    assert any(os.path.basename(p).startswith("flight_")
+               and "preemption" in p for p in flight.dumps)
+    trace = json.load(open(os.path.join(
+        str(tmp_path / "run"), "trace", "train_trace.json")))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "preemption_drain" in names and "preemption" in names
+    assert "ckpt_save" in names
+
+
+# --------------------------------------------------- live MFU gauge
+
+
+def test_live_mfu_gauge_matches_bench_formula(tmp_path):
+    """The live gauge and the bench compute MFU from the same inputs
+    (model flops per step from the XLA cost analysis, measured wall,
+    peak flops): after warmup, the mean of the emitted window gauges
+    must agree with an external bench-style measurement over the same
+    steps.  Documented tolerance: a factor of [0.5, 2.0] on this
+    host-bound CPU rig (docs/observability.md) — window boundaries and
+    OS jitter move individual windows, not the magnitude."""
+    eng = make_engine()
+    ring = RingBufferMonitor(maxlen=4096)
+    sup = ResilientTrainer(eng, str(tmp_path / "run"), monitor=ring,
+                           gauge_interval=3)
+    sup.train(2, batch_fn=batch_fn)          # compile outside the window
+    eng.flops_profile()                      # cost analysis outside too
+    t0 = time.monotonic()
+    sup.train(11, batch_fn=batch_fn)         # 9 steps, 3 gauge windows
+    wall = time.monotonic() - t0
+
+    prof = eng.flops_profile()
+    peak = sup._resolve_peak()
+    bench_mfu = prof["flops_per_step"] * 9 / (wall * peak)
+    bench_tps = (prof["flops_per_step"] / prof["flops_per_token"]) * 9 \
+        / wall
+
+    mfu_gauges = [v for t, v, _ in ring.events if t == "train/mfu"]
+    tps_gauges = [v for t, v, _ in ring.events
+                  if t == "train/tokens_per_s"]
+    assert len(mfu_gauges) == 3 and len(tps_gauges) == 3
+    mean_mfu = float(np.mean(mfu_gauges))
+    mean_tps = float(np.mean(tps_gauges))
+    assert 0.5 * bench_mfu <= mean_mfu <= 2.0 * bench_mfu, \
+        (mean_mfu, bench_mfu)
+    assert 0.5 * bench_tps <= mean_tps <= 2.0 * bench_tps, \
+        (mean_tps, bench_tps)
+    assert sup.report.mfu == pytest.approx(mfu_gauges[-1])
+
+    # the live run emits only documented tags (the train-side taxonomy
+    # pin; test_monitor.py pins taxonomy <-> docs)
+    emitted = {tag for tag, _, _ in ring.events}
+    unknown = emitted - set(EVENT_TAXONOMY)
+    assert not unknown, (
+        f"undocumented monitor tags from training: {unknown} — add them "
+        "to tracing.EVENT_TAXONOMY AND docs/observability.md")
+    assert "train/goodput/productive" in emitted
+    assert all(step >= 1 for _, _, step in ring.events)
+
+    # unified exposition: the goodput ledger + gauges render as
+    # ds_train_* Prometheus gauges
+    text = sup.prometheus_text()
+    for must in ("ds_train_goodput_productive_frac",
+                 "ds_train_goodput_checkpoint_stall_s",
+                 "ds_train_mfu", "ds_train_tokens_per_s",
+                 'run_id="'):
+        assert must in text, text
+
+
+# ------------------------------------------------------- watchdogs
+
+
+def test_straggler_and_stall_watchdogs_fire_and_dump(tmp_path):
+    """One injected 0.6s sleep inside a train step trips BOTH
+    watchdogs: the EWMA straggler check (the step is >> 3x the EWMA of
+    the fast steps before it) and the 0.15s no-progress timer (which
+    fires mid-step, while the process is stuck — that is the point).
+    Both emit taxonomy events and flight-recorder dumps."""
+    eng = make_engine()
+    ring = RingBufferMonitor(maxlen=4096)
+    tracer = SpanTracer(process="t")
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    sup = ResilientTrainer(eng, str(tmp_path / "run"), monitor=ring,
+                           tracer=tracer, flight_recorder=flight,
+                           stall_timeout_s=0.15, straggler_factor=3.0)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.step", step=5, action=faults.sleep_s(0.6))
+    with faults.injected(inj):
+        rep = sup.train(7, batch_fn=batch_fn)
+    assert rep.status == "completed"
+    assert rep.stragglers >= 1, "the 0.6s step must be an EWMA anomaly"
+    assert rep.stalls >= 1, "the no-progress timer must fire mid-sleep"
+    tags = {t for t, _, _ in ring.events}
+    assert "train/straggler" in tags and "train/stall" in tags
+    reasons = [os.path.basename(p) for p in flight.dumps]
+    assert any("train_straggler" in r for r in reasons), reasons
+    assert any("train_stall" in r for r in reasons), reasons
+    # dumps carry the recent span window (the tracer is registered)
+    rec = json.load(open(flight.dumps[-1]))
+    assert rec["trace"]["traceEvents"], "dump must hold the span window"
+    # once per stall EPISODE, not once per watchdog poll — and the
+    # compile step did not count as a stall (the watchdog arms after
+    # the first completed step)
+    assert rep.stalls == 1
+
+
+def test_divergence_rollback_attribution_and_dump(tmp_path):
+    """A NaN loss under the restore policy: the watchdog's rollback
+    time lands in divergence_retry, the re-run steps in recompute, and
+    the divergence triggers a flight dump."""
+    eng = make_engine()
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    sup = ResilientTrainer(eng, str(tmp_path / "run"), save_interval=2,
+                           nan_policy="restore", max_nan_events=2,
+                           tracer=SpanTracer(process="t"),
+                           flight_recorder=flight)
+    inj = faults.FaultInjector(seed=0)
+    inj.on("train.loss", step=4, replace=float("nan"))
+    with faults.injected(inj):
+        rep = sup.train(6, batch_fn=batch_fn)
+    assert rep.status == "completed" and rep.restores == 1
+    assert rep.ledger["seconds"]["divergence_retry"] > 0, \
+        "the rollback restore must be attributed"
+    assert rep.ledger["seconds"]["recompute"] > 0, \
+        "steps re-run after the rollback are recompute"
+    assert any("divergence" in os.path.basename(p) for p in flight.dumps)
+
+
+# ------------------------------------------------- ledger unit + timer
+
+
+def test_goodput_ledger_unit():
+    led = GoodputLedger()
+    led.begin()
+    led.add("productive", 0.10)
+    led.add("checkpoint_stall", 0.02)
+    time.sleep(0.01)
+    led.finish()
+    d = led.as_dict()
+    assert abs(sum(d["fractions"].values()) - 1.0) < 1e-9
+    assert d["seconds"]["productive"] == pytest.approx(0.10)
+    assert d["seconds"]["idle"] >= 0.0
+    # carry keeps totals cumulative across incarnations
+    led2 = GoodputLedger(carry=led.snapshot())
+    led2.begin()
+    led2.add("recompute", 0.05)
+    led2.finish()
+    d2 = led2.as_dict()
+    assert d2["seconds"]["productive"] == pytest.approx(0.10)
+    assert d2["seconds"]["recompute"] == pytest.approx(0.05)
+    assert abs(sum(d2["fractions"].values()) - 1.0) < 1e-9
+    with pytest.raises(ValueError):
+        led2.add("nonsense", 1.0)
+
+
+def test_throughput_timer_routes_monitor_events():
+    """The satellite: ThroughputTimer's periodic report rides the
+    monitor event stream when a sink is attached (same cadence as the
+    old print), and stays print-only (no events, no crash) without
+    one — the API is unchanged."""
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+
+    ring = RingBufferMonitor()
+    t = ThroughputTimer(batch_size=4, start_step=1, steps_per_output=2,
+                        monitor=ring)
+    for _ in range(6):
+        t.start()
+        time.sleep(0.002)
+        t.stop(global_step=True)
+    tags = [tag for tag, _, _ in ring.events]
+    assert tags.count("train/samples_per_s") >= 2
+    assert "train/samples_per_s_avg" in tags
+    assert all(tag in EVENT_TAXONOMY for tag in tags)
+    vals = [v for tag, v, _ in ring.events
+            if tag == "train/samples_per_s"]
+    assert all(v > 0 for v in vals)
+    steps = [s for tag, _, s in ring.events
+             if tag == "train/samples_per_s"]
+    assert steps == sorted(steps) and steps[0] >= 1
+
+    # legacy path: no monitor -> the print branch (nothing to assert
+    # but absence of events/errors; MonitorMaster disabled behaves the
+    # same via its enabled flag)
+    t2 = ThroughputTimer(batch_size=4, start_step=1, steps_per_output=2)
+    for _ in range(4):
+        t2.start()
+        t2.stop(global_step=True)
+    assert t2.monitor is None

@@ -66,6 +66,17 @@ CHECKS = [
     ("cluster hit-rate gain vs round-robin", "cluster.hit_rate_gain",
      "info", None),
     ("tracing overhead frac", "tracing.overhead_frac", "ceiling", None),
+    # memory-telemetry rows (PR 11): overhead stays informational like
+    # the other telemetry numbers on shared CI runners; the steady-state
+    # prefix-cache occupancy fraction is the capacity trend line the
+    # quantized-KV work and the autotuner's prefix_cache_pages knob
+    # will price against — info, never gating
+    ("mem-telemetry overhead frac", "memory.overhead_frac",
+     "info", None),
+    ("prefix-cache occupancy frac (steady state)",
+     "memory.occupancy_frac", "info", None),
+    ("mem page-seconds (shared workload)",
+     "memory.mem_on.page_seconds_total", "info", None),
     ("continuous tokens/s (best H)", "continuous.tokens_per_sec",
      "info", None),
     ("tracing tokens/s (on)", "tracing.trace_on.tokens_per_sec",

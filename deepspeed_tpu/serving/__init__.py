@@ -3,6 +3,12 @@
 See serving/README.md for the page-table layout and the scheduler loop.
 """
 
+from deepspeed_tpu.serving.mem_telemetry import (NULL_MEM,  # noqa: F401
+                                                 PAGE_STATES,
+                                                 AuditError,
+                                                 MemTelemetry,
+                                                 audit_pool,
+                                                 classify)
 from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from deepspeed_tpu.serving.page_manager import (PagedKVManager,  # noqa: F401
                                                 PagePool,
@@ -20,7 +26,8 @@ from deepspeed_tpu.serving.trace import (EVENT_TAXONOMY,  # noqa: F401
                                          FlightRecorder,
                                          SpanTracer,
                                          merge_chrome,
-                                         prometheus_text)
+                                         prometheus_text,
+                                         start_metrics_server)
 from deepspeed_tpu.serving.scheduler import (CANCELLED,  # noqa: F401
                                              FAILED,
                                              FINISHED,

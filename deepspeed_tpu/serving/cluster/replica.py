@@ -71,6 +71,19 @@ class LocalReplica:
         self.tracer = tracer
         if self.sched is not None:
             self.sched.tracer = tracer
+            if self.sched.mem.enabled:
+                # memory telemetry rides the replica's tracer too (the
+                # pool counter track lands in the fleet trace)
+                self.sched.mem.bind(self.sched.metrics, tracer)
+
+    def attach_mem_flight(self, flight):
+        """Router wiring: a scheduler built with memory telemetry gets
+        the fleet FlightRecorder, so a sustained-pressure episode on
+        this replica dumps fleet-correlatable forensics.  Survives
+        die/restart (fresh schedulers are re-wired)."""
+        self._mem_flight = flight
+        if self.sched is not None and self.sched.mem.enabled:
+            self.sched.mem.flight = flight
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
@@ -232,6 +245,11 @@ class LocalReplica:
             self.sched.on_handoff = self._handoff_sink
         if self.tracer is not None:
             self.sched.tracer = self.tracer
+            if self.sched.mem.enabled:
+                self.sched.mem.bind(self.sched.metrics, self.tracer)
+        if getattr(self, "_mem_flight", None) is not None and \
+                self.sched.mem.enabled:
+            self.sched.mem.flight = self._mem_flight
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
@@ -274,7 +292,8 @@ class ProcessReplica:
     def __init__(self, replica_id, *, model="gpt2-tiny", num_slots=3,
                  num_pages=32, page_size=16, max_pages_per_slot=8,
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
-                 hb_timeout_s=60.0, env=None, trace=False):
+                 hb_timeout_s=60.0, env=None, trace=False,
+                 mem_telemetry=False):
         self.id = replica_id
         self.state = UP
         self.death_reason = None
@@ -287,7 +306,8 @@ class ProcessReplica:
                          num_pages=num_pages, page_size=page_size,
                          max_pages_per_slot=max_pages_per_slot,
                          prefill_chunk=prefill_chunk,
-                         prefix_cache=prefix_cache, trace=bool(trace))
+                         prefix_cache=prefix_cache, trace=bool(trace),
+                         mem_telemetry=bool(mem_telemetry))
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
@@ -325,6 +345,8 @@ class ProcessReplica:
                "--prefill-chunk", str(cfg["prefill_chunk"])]
         if cfg["prefix_cache"]:
             cmd.append("--prefix-cache")
+        if cfg["mem_telemetry"]:
+            cmd.append("--mem-telemetry")
         if cfg["trace"]:
             cmd += ["--trace", "--trace-label", str(self.id)]
         try:

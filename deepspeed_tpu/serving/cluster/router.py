@@ -133,6 +133,11 @@ class ClusterRouter:
                     self.flight.register(
                         str(rep.id),
                         (lambda r: (lambda: list(r.trace_events)))(rep))
+                if hasattr(rep, "attach_mem_flight"):
+                    # replicas running memory telemetry dump their
+                    # sustained-pressure episodes into the FLEET
+                    # recorder (journal-correlatable rids ride along)
+                    rep.attach_mem_flight(self.flight)
         for rep in self.replicas:
             if rep.role == "prefill" and hasattr(rep, "set_handoff_sink"):
                 rep.set_handoff_sink(self._make_handoff_sink(rep))
@@ -636,6 +641,50 @@ class ClusterRouter:
             f.write("\n")
         return path
 
+    # ------------------------------------------------------------- audit
+    def audit(self, raise_on_error=True):
+        """Fleet-wide refcount invariant audit.  Unlike a scheduler's
+        own ``audit()`` — which over a SHARED disaggregated pool can
+        only check structure (its peers hold references it cannot
+        see) — the router sees every sharer: it groups live schedulers
+        by physical pool, adds its own in-flight handoff packets (the
+        pages a chain holds between detach and adopt), and runs the
+        EXACT census on each pool.  This is the machine check for the
+        bug class PR-7's review caught by hand: a replica die/restart
+        over a shared pool that leaks (or double-frees) pages."""
+        from deepspeed_tpu.serving.mem_telemetry import audit_pool
+        pools = {}
+
+        def entry(pool):
+            return pools.setdefault(
+                id(pool), {"pool": pool, "managers": [], "caches": [],
+                           "chains": []})
+
+        for rep in self.replicas:
+            sched = getattr(rep, "sched", None)
+            if sched is None:
+                continue          # DEAD local replica / process replica
+            ent = entry(sched.kv.pool)
+            ent["managers"].append(sched.kv)
+            if sched.prefix_cache is not None:
+                ent["caches"].append(sched.prefix_cache)
+            ent["chains"].extend(r._attach[0]
+                                 for r in sched._pending_attach)
+            if sched._spec is not None and \
+                    getattr(sched._spec, "kv", None) is not None:
+                dent = entry(sched._spec.kv.pool)
+                dent["managers"].append(sched._spec.kv)
+        for pkt in self._packets:
+            entry(pkt.pool)["chains"].append(pkt.pages)
+        reports = []
+        for i, ent in enumerate(pools.values()):
+            pool = ent.pop("pool")
+            reports.append(audit_pool(pool, exact=True,
+                                      label=f"fleet_pool{i}",
+                                      raise_on_error=raise_on_error,
+                                      **ent))
+        return {"ok": all(r["ok"] for r in reports), "reports": reports}
+
     # ------------------------------------------------------------ health
     def health(self):
         """Fleet snapshot: per-replica state + aggregate counters the
@@ -646,6 +695,27 @@ class ClusterRouter:
             hits += h
             lookups += lo
             reused += tr
+        # fleet memory aggregation.  Free pages are a POOL property, so
+        # group by physical pool (a disaggregated group's sharers would
+        # otherwise multiply-count the one pool they share); process
+        # replicas have no local pool object and contribute their last
+        # heartbeat figure (they never share a pool cross-process).
+        # Pressure counters are per-scheduler detections and sum as-is.
+        mem_free = mem_episodes = mem_events = 0
+        seen_pools = set()
+        for rep in self.replicas:
+            lh = rep.last_health or {}
+            mem_episodes += lh.get("mem_pressure_episodes") or 0
+            mem_events += lh.get("mem_pressure_events") or 0
+            if rep.state == DEAD:
+                continue   # stale heartbeat, no live pool to report
+            sched = getattr(rep, "sched", None)
+            if sched is not None:
+                if id(sched.kv.pool) not in seen_pools:
+                    seen_pools.add(id(sched.kv.pool))
+                    mem_free += sched.kv.pool.free_pages
+            else:
+                mem_free += lh.get("mem_free_pages") or 0
         return {
             "step": self.step_idx,
             "routing": self.routing,
@@ -672,6 +742,9 @@ class ClusterRouter:
             "aggregate_prefix_hit_rate":
                 round(hits / lookups, 4) if lookups else 0.0,
             "aggregate_tokens_reused": reused,
+            "aggregate_mem_free_pages": mem_free,
+            "aggregate_mem_pressure_events": mem_events,
+            "aggregate_mem_pressure_episodes": mem_episodes,
             **self.metrics.summary(),
         }
 

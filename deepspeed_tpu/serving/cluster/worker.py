@@ -74,6 +74,10 @@ def main(argv=None):
     p.add_argument("--max-pages-per-slot", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=8)
     p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--mem-telemetry", action="store_true",
+                   help="page-state attribution + per-request "
+                        "page-seconds + pressure forensics; the mem_* "
+                        "health fields ride the heartbeat to the router")
     p.add_argument("--trace", action="store_true",
                    help="record serving spans and flush them over the "
                         "protocol with each heartbeat")
@@ -101,7 +105,8 @@ def main(argv=None):
         engine, num_slots=args.num_slots, num_pages=args.num_pages,
         page_size=args.page_size,
         max_pages_per_slot=args.max_pages_per_slot,
-        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache)
+        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
+        mem_telemetry=args.mem_telemetry)
 
     tracer = {"t": None}
 
@@ -112,6 +117,9 @@ def main(argv=None):
                 process=label or args.trace_label or
                 f"worker-{os.getpid()}")
             sched.tracer = tracer["t"]
+            if sched.mem.enabled:
+                # the pool counter track rides the worker's span flushes
+                sched.mem.bind(sched.metrics, tracer["t"])
 
     if args.trace:
         enable_trace()

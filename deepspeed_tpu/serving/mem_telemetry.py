@@ -1,0 +1,444 @@
+"""HBM capacity observability: page-pool attribution, per-request
+memory accounting, pressure forensics, and the refcount invariant
+auditor.
+
+This is the MEMORY half of the observability stack, mirroring how the
+tracing tier (PR 8/9) covers the TIME half.  Page capacity is the
+currency of every serving mechanism — horizons shrink, the prefix
+cache drains, spec-K collapses, and admissions shed under pool
+pressure — and this module makes every page's lifecycle visible:
+
+* **Attribution** (:func:`classify`) — every page of the pool is
+  classified at all times into the page-state taxonomy
+  ``{slot, prefix_shared, prefix_sole, handoff, draft, free}``
+  (plus ``unattributed`` for shared-pool peers, 0 standalone).  The
+  split is **conservation-exact**: the main-pool states always sum to
+  ``num_pages`` (the draft pool is a physically separate pool and
+  conserves against its own ``draft_num_pages``).  Exported per step as
+  ``serving/mem/*`` monitor gauges, as a Perfetto *counter track*
+  (``"C"`` events merged into the Chrome trace next to the PR-8 spans),
+  and in ``health()`` / the Prometheus exposition with per-device byte
+  figures derived from the existing ``pool_bytes_per_device``.
+
+* **Per-request accounting** — each request's pages-held high-water
+  mark (``Request.pages_hwm``) and page-seconds integral
+  (``Request.page_seconds``): the unit the serving autotuner's cost
+  model and future per-tenant quotas bill in.  Reported in ds_serve
+  rows and ``summary()``.
+
+* **Pressure forensics** — every capacity decision the scheduler makes
+  (slot growth, horizon pre-reservation shrink, spec-K shrink, chained
+  dispatch reclaim, admission blocking) records a *causal chain*:
+  trigger -> pages needed -> cache pages drained -> victim evicted /
+  horizon shrunk / request shed.  Chains land in a bounded
+  :class:`PressureLog` ring, as tracer instants, and as
+  ``serving/mem/pressure`` events.  A **sustained-pressure episode**
+  (free fraction below ``pressure_threshold`` for ``pressure_steps``
+  consecutive steps) fires once per episode: it triggers the attached
+  :class:`~deepspeed_tpu.tracing.FlightRecorder` with a pool snapshot,
+  the recent pressure chains, and the live request/trace rids the
+  journal correlates on.
+
+* **Refcount invariant auditor** (:func:`audit_pool`) — cross-checks
+  the pool's refcounts against every known holder (slot page tables,
+  the prefix-cache trie, parked/in-flight handoff chains) and raises
+  :class:`AuditError` on a leak, double-free hazard, or orphan table
+  entry — turning the class of bug PR-7's review caught by hand (the
+  rolling-restart page leak) into a machine-checked invariant.  Opt-in
+  on the scheduler via ``audit_every=N`` (barrier steps) and on the
+  cluster via ``ClusterRouter.audit()`` (which sees ALL sharers of a
+  disaggregated pool, including router-held handoff packets).
+
+**Zero-cost-when-off.**  Telemetry off is the shared :data:`NULL_MEM`
+singleton, exactly like ``NULL_TRACER``: every scheduler call site pays
+one attribute load and a falsy check, no device op, no new jit
+signature — tokens and compile counts are byte-identical (pinned by
+``tests/unit/test_mem_telemetry.py``).  Everything here is pure host
+bookkeeping over the host-side page tables; like the page manager it
+is mesh-agnostic by contract (page ids are global; only byte figures
+consult the recorded topology snapshot).
+"""
+
+import time
+from collections import deque
+
+PAGE_STATES = ("slot", "prefix_shared", "prefix_sole", "handoff",
+               "draft", "free")
+
+
+class AuditError(RuntimeError):
+    """The refcount auditor found a leak / double-free hazard / orphan."""
+
+
+# ------------------------------------------------------------- auditor
+
+def audit_pool(pool, *, managers=(), caches=(), chains=(), exact=True,
+               label="pool", raise_on_error=True):
+    """Cross-check ``pool``'s refcounts against every known holder.
+
+    ``managers`` are :class:`PagedKVManager`\\ s over the pool (their
+    slot chains each hold one reference per page), ``caches`` are
+    :class:`PrefixCache`\\ s (one reference per trie node), ``chains``
+    are detached-but-owned page lists in flight (parked
+    ``attach_handoff`` chains, router handoff packets — each holds one
+    reference per page).  With ``exact=True`` the holder census must
+    match the refcounts EXACTLY; ``exact=False`` (a shared pool audited
+    from one scheduler that cannot see its peers) skips the
+    leaked-reference direction and checks only structural integrity +
+    the double-free direction.
+
+    Violations detected:
+
+    * **free-list corruption** — duplicate/out-of-range ids, a page
+      both free and allocated, free+allocated != num_pages;
+    * **orphan** — a table/trie/chain references a FREE page
+      (use-after-free: the next allocate hands it to someone else);
+    * **double-free hazard** — more known holders than refcounts (one
+      ``free`` by any holder recycles a page others still read);
+    * **leak** — more refcounts than known holders (pages that can
+      never recycle; the rolling-restart bug class).
+
+    Returns a report dict; raises :class:`AuditError` listing every
+    violation when ``raise_on_error`` (the default)."""
+    errors = []
+    free = pool._free
+    free_set = set(free)
+    if len(free_set) != len(free):
+        errors.append(f"{label}: duplicate page ids on the free list")
+    bad = [p for p in free_set if not (0 <= p < pool.num_pages)]
+    if bad:
+        errors.append(f"{label}: out-of-range free pages {sorted(bad)[:8]}")
+    both = free_set & set(pool._refs)
+    if both:
+        errors.append(f"{label}: pages both free and allocated "
+                      f"{sorted(both)[:8]}")
+    if len(free) + len(pool._refs) != pool.num_pages:
+        errors.append(
+            f"{label}: free({len(free)}) + allocated({len(pool._refs)}) "
+            f"!= num_pages({pool.num_pages})")
+    holders = {}                      # page -> [who, ...]
+
+    def hold(page, who):
+        holders.setdefault(int(page), []).append(who)
+
+    for i, mgr in enumerate(managers):
+        for slot, pages in enumerate(mgr._slot_pages):
+            for p in pages:
+                hold(p, f"manager{i}/slot{slot}")
+    for i, cache in enumerate(caches):
+        if cache is None:
+            continue
+        for p in cache.iter_pages():
+            hold(p, f"cache{i}")
+    for i, chain in enumerate(chains):
+        for p in chain:
+            hold(p, f"chain{i}")
+    for p, who in holders.items():
+        actual = pool.ref_count(p)
+        if actual == 0:
+            errors.append(
+                f"{label}: page {p} referenced by {who} but FREE "
+                "(orphan table entry / double-free)")
+        elif actual < len(who):
+            errors.append(
+                f"{label}: page {p} has {len(who)} holders {who} but "
+                f"refcount {actual} (missing share -> double-free hazard)")
+    if exact:
+        for p, rc in pool._refs.items():
+            known = len(holders.get(p, ()))
+            if rc > known:
+                errors.append(
+                    f"{label}: page {p} refcount {rc} > {known} known "
+                    "holder(s) (leaked reference)")
+    report = {"label": label, "errors": errors,
+              "pages_checked": pool.num_pages,
+              "holders": sum(len(v) for v in holders.values()),
+              "ok": not errors}
+    if errors and raise_on_error:
+        raise AuditError(
+            f"page-pool audit failed ({len(errors)} violation(s)):\n  "
+            + "\n  ".join(errors))
+    return report
+
+
+# -------------------------------------------------------- attribution
+
+def classify(sched):
+    """Classify every page of ``sched``'s pool into the page-state
+    taxonomy.  Conservation-exact by construction:
+    ``slot + prefix_shared + prefix_sole + handoff + unattributed +
+    free == num_pages``.  ``unattributed`` is pages a shared pool's
+    PEER schedulers hold (always 0 for a standalone scheduler — a
+    nonzero value there is a leak, which ``audit()`` flags).  The
+    draft-model pool is physically separate, so ``draft`` /
+    ``draft_free`` conserve against ``draft_num_pages`` instead.
+    Pure host sweep over the page tables: O(num_pages + slots).
+
+    Thread-tolerant like ``SpanTracer.serialized``: a /metrics scrape
+    thread may sweep while the serving loop mutates the dicts/trie —
+    retry the (CPython-atomic in practice) snapshot a few times rather
+    than let a mutated-during-iteration RuntimeError turn every busy
+    scrape into a 500; the last resort is a degraded-but-conserving
+    split (everything allocated reported unattributed)."""
+    pool = sched.kv.pool
+    for _ in range(4):
+        try:
+            return _classify_once(sched, pool)
+        except RuntimeError:
+            continue
+    counts = dict.fromkeys(PAGE_STATES, 0)
+    counts["free"] = pool.free_pages
+    counts["unattributed"] = pool.num_pages - counts["free"]
+    return counts
+
+
+def _classify_once(sched, pool):
+    trie = set()
+    if sched.prefix_cache is not None:
+        trie = set(sched.prefix_cache.iter_pages())
+    slot_pages = set()
+    for pages in list(sched.kv._slot_pages):
+        slot_pages.update(pages)
+    handoff_pages = set()
+    for req in list(sched._pending_attach):
+        handoff_pages.update(req._attach[0])
+    counts = dict.fromkeys(PAGE_STATES, 0)
+    counts["unattributed"] = 0
+    for p in list(pool._refs):
+        if p in trie:
+            key = "prefix_shared" if pool.ref_count(p) > 1 \
+                else "prefix_sole"
+        elif p in slot_pages:
+            key = "slot"
+        elif p in handoff_pages:
+            key = "handoff"
+        else:
+            key = "unattributed"
+        counts[key] += 1
+    # the free count and the _refs snapshot may straddle a mutation on
+    # the serving thread: re-derive free from the allocated census so
+    # one scrape stays internally conservation-exact
+    counts["free"] = pool.num_pages - sum(
+        counts[k] for k in ("slot", "prefix_shared", "prefix_sole",
+                            "handoff", "unattributed"))
+    # getattr: custom drafters predating the Drafter.mem_stats hook
+    # (or duck-typed ones in tests) simply report no draft pool
+    stats = None if sched._spec is None else \
+        getattr(sched._spec, "mem_stats", lambda: None)()
+    if stats:
+        counts["draft"] = stats["draft_pages"]
+        counts["draft_free"] = stats["draft_free"]
+        counts["draft_num_pages"] = stats["draft_num_pages"]
+    return counts
+
+
+# ------------------------------------------------- pressure forensics
+
+class _NullChain:
+    """Shared no-op causal chain for the disabled telemetry."""
+
+    __slots__ = ()
+
+    def add(self, act, **fields):
+        pass
+
+    def close(self, outcome=None):
+        pass
+
+
+NULL_CHAIN = _NullChain()
+
+
+class PressureChain:
+    """One capacity decision's causal event chain: the trigger (who
+    needed pages, how many, how many were free) plus the ordered
+    actions taken (cache pages drained, victim evicted, horizon/spec-K
+    shrunk) and the outcome.  Committed to the :class:`PressureLog`
+    ring — and as a tracer instant — on :meth:`close`."""
+
+    __slots__ = ("mem", "event")
+
+    def __init__(self, mem, trigger, **fields):
+        self.mem = mem
+        self.event = {"trigger": trigger, **fields, "actions": []}
+
+    def add(self, act, **fields):
+        self.event["actions"].append({"act": act, **fields})
+
+    def close(self, outcome=None):
+        if self.mem is None:
+            return              # idempotent: a chain commits once
+        self.event["outcome"] = outcome
+        mem, self.mem = self.mem, None
+        mem._commit_chain(self.event)
+
+
+class MemTelemetry:
+    """Per-scheduler memory telemetry driver (see module docstring).
+
+    Constructed by ``ServingScheduler(mem_telemetry=True)`` — or built
+    by the caller and passed in for custom thresholds — and driven from
+    the scheduler's step loop.  ``flight`` may be attached at any time
+    (``ds_serve``/``ClusterRouter`` wire their FlightRecorder after
+    construction) to turn sustained-pressure episodes into flight
+    dumps."""
+
+    enabled = True
+
+    def __init__(self, *, pressure_threshold=0.125, pressure_steps=8,
+                 log_capacity=256, flight=None):
+        self.pressure_threshold = float(pressure_threshold)
+        self.pressure_steps = int(pressure_steps)
+        self.pressure_log = deque(maxlen=int(log_capacity))
+        self.flight = flight
+        self.metrics = None          # bound by the scheduler
+        self.tracer = None
+        self.page_seconds = 0.0      # cumulative integral, all requests
+        self.pages_hwm = 0           # max concurrent non-free pages seen
+        self.churn = {}              # pool alloc/free/share event totals
+        self.pressure_events = 0     # causal chains recorded
+        self.pressure_episodes = 0   # sustained episodes fired
+        self._streak = 0
+        self._armed = True           # one dump per episode
+        self._t_last = None
+
+    def bind(self, metrics, tracer):
+        """Scheduler wiring: where gauges and counter samples go."""
+        self.metrics = metrics
+        self.tracer = tracer
+
+    # --------------------------------------------- pool event hook
+    def on_pool_event(self, kind, n):
+        """``PagePool.observer`` target: page-granular churn counters
+        (allocate/free/share events since start), folded into the
+        pressure-episode flight dump — an episode with huge churn and
+        steady occupancy reads "thrashing", one with monotone growth
+        reads "squeeze".  On a SHARED pool the last binder owns the
+        hook; churn is a pool-level figure either way."""
+        self.churn[kind] = self.churn.get(kind, 0) + n
+
+    # ------------------------------------------------- causal chains
+    def chain(self, trigger, **fields):
+        return PressureChain(self, trigger, **fields)
+
+    def _commit_chain(self, event):
+        self.pressure_log.append(event)
+        self.pressure_events += 1
+        if self.metrics is not None:
+            self.metrics.record_pressure(event.get("step", 1),
+                                         event["trigger"])
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant("mem_pressure", cat="mem",
+                                rid=event.get("rid"), args=event)
+
+    # --------------------------------------------------- step driver
+    def on_step(self, sched, now=None):
+        """Barrier-cadence accounting, called once per scheduler step:
+        refresh the page-state attribution, integrate per-request
+        page-seconds, emit gauges + the Perfetto counter sample, and
+        run sustained-pressure detection."""
+        if now is None:
+            now = time.monotonic()
+        prev, self._t_last = self._t_last, now
+        counts = classify(sched)
+        pool = sched.kv.pool
+        in_use = pool.pages_in_use
+        self.pages_hwm = max(self.pages_hwm, in_use)
+        if prev is not None:
+            for slot in range(sched.num_slots):
+                req = sched.slot_req[slot]
+                n = len(sched.kv._slot_pages[slot])
+                if req is not None and n:
+                    # bill from when THIS request could actually have
+                    # held the pages: a request admitted after an idle
+                    # gap (the accounting clock last ticked at the
+                    # previous run()'s drain) must not be billed for
+                    # the gap — page-seconds is the tenant-billing
+                    # unit, so over-billing is a correctness bug
+                    start = prev if req.t_admit is None \
+                        else max(prev, req.t_admit)
+                    span = now - start
+                    if span > 0:
+                        req.page_seconds += n * span
+                        self.page_seconds += n * span
+        for slot in range(sched.num_slots):
+            req = sched.slot_req[slot]
+            if req is not None:
+                req.pages_hwm = max(req.pages_hwm,
+                                    len(sched.kv._slot_pages[slot]))
+        free_frac = pool.free_pages / pool.num_pages
+        if self.metrics is not None:
+            self.metrics.record_mem(sched.step_idx, counts, free_frac,
+                                    self.page_seconds)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.counter(
+                "mem/pages",
+                {k: counts.get(k, 0) for k in
+                 ("slot", "prefix_shared", "prefix_sole", "handoff",
+                  "draft", "unattributed", "free")})
+            self.tracer.counter("mem/free_frac", {"free_frac": free_frac})
+        # sustained-pressure episode: free fraction under the threshold
+        # for N consecutive steps fires ONCE, re-arming only after the
+        # pool recovers above the threshold (a long-lived squeeze is
+        # one episode, not a dump per step)
+        if free_frac < self.pressure_threshold:
+            self._streak += 1
+            if self._armed and self._streak >= self.pressure_steps:
+                self._armed = False
+                self.pressure_episodes += 1
+                self._fire_episode(sched, counts, free_frac)
+        else:
+            self._streak = 0
+            self._armed = True
+
+    def _fire_episode(self, sched, counts, free_frac):
+        if self.metrics is not None:
+            self.metrics.record_pressure_episode(sched.step_idx)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant(
+                "mem_pressure_episode", cat="mem",
+                args={"free_frac": round(free_frac, 4),
+                      "steps": self._streak, **counts})
+        if self.flight is not None:
+            live = [getattr(r, "trace_rid", r.rid)
+                    for r in sched.requests.values()]
+            self.flight.dump(
+                "mem_pressure",
+                extra={"pool": counts,
+                       "free_frac": round(free_frac, 4),
+                       "steps_under_threshold": self._streak,
+                       "threshold": self.pressure_threshold,
+                       "page_churn": dict(self.churn),
+                       "live_rids": live[:64],
+                       "pressure_log": list(self.pressure_log)[-32:]})
+
+    def summary_fields(self):
+        return {
+            "page_seconds_total": round(self.page_seconds, 3),
+            "pages_in_use_hwm": self.pages_hwm,
+            "mem_pressure_events": self.pressure_events,
+            "mem_pressure_episodes": self.pressure_episodes,
+        }
+
+
+class _NullMemTelemetry(MemTelemetry):
+    """Telemetry off: one shared, inert instance (the NULL_TRACER
+    pattern) — every call site costs one attribute load and a falsy
+    check, and nothing may ever record."""
+
+    enabled = False
+
+    def __init__(self):
+        super().__init__(log_capacity=1)
+
+    def chain(self, trigger, **fields):   # pragma: no cover - trivial
+        return NULL_CHAIN
+
+    def on_step(self, sched, now=None):   # pragma: no cover
+        raise AssertionError("NULL_MEM must never be driven")
+
+    def _commit_chain(self, event):       # pragma: no cover
+        raise AssertionError("NULL_MEM must never record")
+
+
+NULL_MEM = _NullMemTelemetry()

@@ -57,6 +57,9 @@ class ServingMetrics:
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
         self.handoffs = 0              # prefill->decode KV chains handed
         self.handoff_tokens = 0        # prefilled positions transferred
+        # memory telemetry (MemTelemetry drives these; all 0 when off)
+        self.mem_pressure_events = 0   # capacity causal chains recorded
+        self.mem_pressure_episodes = 0  # sustained episodes fired
         self.mesh_info = {}            # serving topology (record_mesh)
         self._events = []
 
@@ -203,6 +206,40 @@ class ServingMetrics:
         forward and yields mean_accepted + 1 tokens)."""
         return self.spec_accepted / self.spec_slot_rounds \
             if self.spec_slot_rounds else 0.0
+
+    def record_mem(self, step, counts, free_frac, page_seconds):
+        """One memory-attribution sample (MemTelemetry.on_step): the
+        page-state split of the pool (conservation-exact — the states
+        sum to num_pages), the free fraction, and the cumulative
+        page-seconds integral across all requests."""
+        self._write([
+                ("serving/mem/slot_pages", counts.get("slot", 0), step),
+                ("serving/mem/prefix_shared_pages",
+                 counts.get("prefix_shared", 0), step),
+                ("serving/mem/prefix_sole_pages",
+                 counts.get("prefix_sole", 0), step),
+                ("serving/mem/handoff_pages",
+                 counts.get("handoff", 0), step),
+                ("serving/mem/draft_pages", counts.get("draft", 0), step),
+                ("serving/mem/unattributed_pages",
+                 counts.get("unattributed", 0), step),
+                ("serving/mem/free_pages", counts.get("free", 0), step),
+                ("serving/mem/free_frac", free_frac, step),
+                ("serving/mem/page_seconds", page_seconds, step),
+            ])
+
+    def record_pressure(self, step, trigger):
+        """One capacity-decision causal chain was recorded (the
+        which/why — trigger, drained pages, victim — lives in the
+        MemTelemetry pressure log; the monitor sinks are scalar-only)."""
+        self.mem_pressure_events += 1
+        self._write([("serving/mem/pressure", 1, step)])
+
+    def record_pressure_episode(self, step):
+        """Sustained pool pressure: the free fraction stayed under the
+        episode threshold for the configured step window."""
+        self.mem_pressure_episodes += 1
+        self._write([("serving/mem/pressure_episode", 1, step)])
 
     def record_handoff(self, step, tokens):
         """One prefill->decode KV handoff: ``tokens`` prefilled

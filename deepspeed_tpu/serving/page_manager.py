@@ -69,6 +69,12 @@ class PagePool:
         self.total_allocs = 0        # pages taken off the free list
         self.total_frees = 0         # pages returned to the free list
         self.total_shares = 0        # extra holders added via share()
+        # memory-telemetry event hook: observer(kind, n_pages) with kind
+        # in {"alloc", "free", "share"}, called AFTER the books update.
+        # None by default — the off path costs one attribute load and a
+        # falsy check per pool operation (pool ops are page-granular,
+        # never per-token), preserving the zero-cost-when-off contract
+        self.observer = None
 
     @property
     def free_pages(self):
@@ -98,28 +104,64 @@ class PagePool:
             self._refs[p] = 1
         self.total_allocs += n
         self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
+        if self.observer is not None:
+            self.observer("alloc", n)
         return pages
 
     def share(self, pages):
         """Add one holder to each already-allocated page (read-only
-        prefix sharing / prefix-cache retention)."""
+        prefix sharing / prefix-cache retention).  Sharing a free or
+        foreign page id raises :class:`ValueError` — an unallocated
+        page gaining a phantom holder would never recycle (a leak) or,
+        worse, recycle under a reader (regression-tested in
+        tests/unit/test_mem_telemetry.py)."""
+        # validate the WHOLE list before mutating anything: a mixed
+        # good/bad list must reject atomically, or the caller — who
+        # sees only the exception — would be left with phantom holders
+        # it cannot account for
         for p in pages:
             if p not in self._refs:
-                raise ValueError(f"cannot share free/foreign page {p}")
+                raise ValueError(
+                    f"cannot share page {p}: not currently allocated "
+                    f"(free or foreign id; pool has {self.num_pages} "
+                    "pages)")
+        for p in pages:
             self._refs[p] += 1
         self.total_shares += len(pages)
+        if self.observer is not None:
+            self.observer("share", len(pages))
 
     def free(self, pages):
         """Drop one holder per page; a page returns to the free list
-        only when its last holder releases it."""
+        only when its last holder releases it.  Freeing a page that is
+        not allocated — a double free, or a foreign id — raises
+        :class:`ValueError` instead of silently corrupting the free
+        list (a duplicate free-list entry would hand the same page to
+        two owners on the next allocate)."""
+        # two-pass like share(): reject the whole call before touching
+        # the books, so a bad id cannot leave a half-applied free
+        # behind the ValueError.  A page listed twice is legal while
+        # its refcount covers both drops — count multiplicity here.
+        need = {}
         for p in pages:
-            if p not in self._refs:
-                raise ValueError(f"double free / foreign page {p}")
+            need[p] = need.get(p, 0) + 1
+        for p, n in need.items():
+            if self._refs.get(p, 0) < n:
+                raise ValueError(
+                    f"cannot free page {p} x{n}: "
+                    f"{self._refs.get(p, 0)} holder(s) "
+                    f"(double free or foreign id; pool has "
+                    f"{self.num_pages} pages)")
+        freed = 0
+        for p in pages:
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 del self._refs[p]
                 self._free.append(p)
                 self.total_frees += 1
+                freed += 1
+        if self.observer is not None and pages:
+            self.observer("free", freed)
 
     def utilization(self):
         return self.pages_in_use / self.num_pages

@@ -268,6 +268,17 @@ class PrefixCache:
             results[id(node)] = (count, ok)
         return results[id(self._root)][0]
 
+    def iter_pages(self):
+        """Every page id the trie currently holds one pool reference
+        for (one per node) — the census the memory-telemetry auditor
+        (``serving/mem_telemetry.audit_pool``) and page-state
+        classifier sweep.  Pure iterative walk, no refcounts move."""
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n.page
+
     def prefix_len(self, tokens, limit=None):
         """Fingerprint export for the cluster router: how many leading
         tokens of ``tokens`` this cache could serve RIGHT NOW (whole

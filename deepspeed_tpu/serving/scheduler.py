@@ -114,6 +114,8 @@ from collections import deque
 import numpy as np
 
 from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import mem_telemetry as memtel
+from deepspeed_tpu.serving.mem_telemetry import NULL_MEM, MemTelemetry
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.page_manager import (PagedKVManager,
                                                 PagePoolExhausted,
@@ -174,6 +176,12 @@ class Request:
         self.state = WAITING
         self.prefill_pos = 0
         self.cached_prefix_tokens = 0   # prefix-cache reuse at last admit
+        # per-request memory attribution (MemTelemetry; 0 when off):
+        # pages-held high-water mark and the page-seconds integral —
+        # the unit the autotuner's cost model and per-tenant quotas
+        # will bill in (reported in ds_serve rows and summary())
+        self.pages_hwm = 0
+        self.page_seconds = 0.0
         self.error = None            # reason string for failed/shed
         self.handoff = False         # prefill-worker mode (see submit)
         self.cancelled = False
@@ -212,7 +220,7 @@ class ServingScheduler:
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
                  spec_decode=None, spec_k=8, spec_drafter=None,
                  shared_pool=None, pools_ref=None, on_handoff=None,
-                 tracer=None):
+                 tracer=None, mem_telemetry=False, audit_every=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
@@ -269,6 +277,41 @@ class ServingScheduler:
         self.completed = deque(maxlen=int(completed_history))
         self._collect = None         # active run()'s result accumulator
         self.metrics = ServingMetrics(monitor)
+        # memory telemetry (serving/mem_telemetry.py): page-state
+        # attribution, per-request page-seconds, pressure forensics.
+        # Off is the shared NULL_MEM singleton — one attribute load and
+        # a falsy check per call site, tokens and compile counts
+        # byte-identical (pinned by tests/unit/test_mem_telemetry.py).
+        # Pass True for defaults or a MemTelemetry instance for custom
+        # pressure thresholds / an attached FlightRecorder.
+        if isinstance(mem_telemetry, MemTelemetry):
+            if mem_telemetry.metrics is not None:
+                # an instance shared by two schedulers would cross-wire
+                # their gauges and corrupt both page-seconds clocks —
+                # one MemTelemetry per scheduler, always
+                raise ValueError(
+                    "this MemTelemetry instance is already bound to "
+                    "another scheduler; pass mem_telemetry=True (or a "
+                    "fresh instance) per scheduler")
+            self.mem = mem_telemetry
+        elif mem_telemetry:
+            self.mem = MemTelemetry()
+        else:
+            self.mem = NULL_MEM
+        if self.mem.enabled:
+            self.mem.bind(self.metrics, self.tracer)
+            # page-granular churn events ride the pool's observer hook
+            # (None when telemetry is off — the zero-cost path)
+            self.kv.pool.observer = self.mem.on_pool_event
+        # refcount invariant auditor: with audit_every=N every N-th
+        # BARRIER step cross-checks pool refcounts against the slot
+        # tables + prefix trie + parked handoff chains and raises
+        # AuditError on a leak/double-free/orphan.  A shared
+        # (disaggregated) pool is audited structurally only — peers
+        # hold references this scheduler cannot see; the exact census
+        # runs fleet-side via ClusterRouter.audit().
+        self.audit_every = None if not audit_every else int(audit_every)
+        self._pool_shared = shared_pool is not None
         if self.mesh_info:
             self.metrics.record_mesh(self.mesh_info)
         self.step_idx = 0
@@ -486,10 +529,13 @@ class ServingScheduler:
         self._finalize(req, state, reason)
         self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
 
-    def _preempt_youngest(self, protect=None):
+    def _preempt_youngest(self, protect=None, chain=None):
         """Evict the most recently admitted live request (vLLM's
         recompute preemption), re-queueing it at the queue head. Returns
-        the freed slot or None if there was nothing to evict."""
+        the freed slot or None if there was nothing to evict.
+        ``chain`` is the caller's pressure causal chain: the eviction is
+        recorded on it with the victim's rid, so a forensics reader can
+        answer "who was evicted, for whom, and after what"."""
         candidates = [s for s in range(self.num_slots)
                       if self.slot_req[s] is not None and s != protect]
         if not candidates:
@@ -499,6 +545,9 @@ class ServingScheduler:
             return None
         victim = max(candidates, key=lambda s: self.slot_req[s].t_admit)
         req = self.slot_req[victim]
+        if chain is not None:
+            chain.add("evict", victim_slot=victim, victim_rid=req.rid,
+                      pages_freed=len(self.kv._slot_pages[victim]))
         self._spec_release(victim, req)
         self.kv.release_slot(victim)
         self.slot_req[victim] = None
@@ -529,8 +578,12 @@ class ServingScheduler:
         ``slot`` itself was preempted. Raises
         :class:`PagePoolExhausted` on a genuine dead-end (cache drained
         AND no evictable victim) — callers shed the slot's request
-        rather than letting the loop die."""
+        rather than letting the loop die.  Every pressure resolution
+        records a causal chain on the memory telemetry (trigger ->
+        drained cache pages -> evicted victim rid -> outcome); the
+        no-pressure fast path records nothing."""
         req = self.slot_req[slot]
+        chain = None
         try:
             faults.fire("serve.page_alloc", step=self.step_idx, slot=slot,
                         rid=None if req is None else req.rid)
@@ -538,23 +591,53 @@ class ServingScheduler:
             # an injected exhaustion episode models pool pressure: the
             # cache must drain before any victim is shed — only a
             # drained cache makes the episode terminal
-            if not self._reclaim_cached(self.kv.pool.num_pages):
+            if self.mem.enabled:
+                chain = self._open_pressure_chain(
+                    "grow", slot, req, target_len,
+                    injected_exhaustion=True)
+            drained = self._reclaim_cached(self.kv.pool.num_pages)
+            if chain is not None and drained:
+                chain.add("cache_drain", pages=drained)
+            if not drained:
+                if chain is not None:
+                    chain.close("dead_end")
                 raise
         while not self.kv.ensure_capacity(slot, target_len):
+            if chain is None and self.mem.enabled:
+                chain = self._open_pressure_chain("grow", slot, req,
+                                                  target_len)
             # reclaim the whole known shortfall in ONE batched drain
             # (evict() amortizes its tree scans per layer, not per page)
             short = self.kv.pages_needed(slot, target_len) - \
                 self.kv.pool.free_pages
-            if self._reclaim_cached(max(1, short)):
+            drained = self._reclaim_cached(max(1, short))
+            if drained:
+                if chain is not None:
+                    chain.add("cache_drain", pages=drained)
                 continue
-            victim = self._preempt_youngest(protect=slot)
+            victim = self._preempt_youngest(protect=slot, chain=chain)
             if victim is None:
+                if chain is not None:
+                    chain.close("dead_end")
                 raise PagePoolExhausted(
                     f"cannot grow slot {slot} to {target_len} tokens: "
                     "pool exhausted with no evictable request")
             if victim == slot:
+                if chain is not None:
+                    chain.close("self_preempted")
                 return False
+        if chain is not None:
+            chain.close("grown")
         return True
+
+    def _open_pressure_chain(self, trigger, slot, req, target_len,
+                             **extra):
+        return self.mem.chain(
+            trigger, step=self.step_idx, slot=slot,
+            rid=None if req is None else req.trace_rid,
+            target_len=int(target_len),
+            pages_needed=self.kv.pages_needed(slot, target_len),
+            free_pages=self.kv.pool.free_pages, **extra)
 
     # ----------------------------------------------------- failure policy
     def _estimated_service_steps(self, req):
@@ -685,6 +768,17 @@ class ServingScheduler:
             device_wait_s=t_wait, host_s=max(0.0, dt - t_wait),
             cached_pages=None if self.prefix_cache is None
             else self.prefix_cache.cached_pages)
+        if self.mem.enabled:
+            # rolling page-state attribution + per-request page-seconds
+            # + sustained-pressure detection (one host sweep per step)
+            self.mem.on_step(self)
+        if self.audit_every and not chained and \
+                self.step_idx % self.audit_every == 0:
+            # barrier steps only: a chained step's host view is not
+            # authoritative, but page refcounts are — we still skip it
+            # to keep audit cadence aligned with host-authoritative
+            # bookkeeping (and off the overlap hot path)
+            self.audit()
         return bool(self.waiting) or n_running > 0 or \
             bool(self._inflight) or bool(self._pending_attach)
 
@@ -724,6 +818,11 @@ class ServingScheduler:
                                     ([hit[1]] if hit[1] is not None else []))
             short = need - self.kv.pool.free_pages
             if short > 0:
+                chain = self.mem.chain(
+                    "admission", step=self.step_idx, rid=req.trace_rid,
+                    pages_needed=need,
+                    free_pages=self.kv.pool.free_pages) \
+                    if self.mem.enabled else None
                 # pre-check with the EXACT drainable count (under the
                 # same protect set the drain will honor) before touching
                 # the cache: a shortfall the drain provably cannot cover
@@ -731,8 +830,15 @@ class ServingScheduler:
                 # request stays blocked anyway
                 if self.prefix_cache is None or short > \
                         self.prefix_cache.reclaimable_pages(protect):
+                    if chain is not None:
+                        chain.close("blocked")
                     break
-                if self._reclaim_cached(short, protect) < short:
+                drained = self._reclaim_cached(short, protect)
+                if chain is not None:
+                    chain.add("cache_drain", pages=drained)
+                    chain.close("admitted" if drained >= short
+                                else "blocked")
+                if drained < short:
                     break
             self.waiting.popleft()
             self.slot_req[slot] = req
@@ -1065,6 +1171,8 @@ class ServingScheduler:
         at horizon 1 the legacy evict/shed policy applies unchanged.
         Returns (horizon, surviving slots)."""
         reclaimable = None   # lazy: the cache can't change mid-loop
+        h0 = horizon
+        chain = None
         while horizon > 1:
             need = sum(self.kv.pages_needed(
                 s, int(self.lengths[s]) +
@@ -1082,7 +1190,16 @@ class ServingScheduler:
                 avail += reclaimable
             if need <= avail:
                 break
+            if chain is None and self.mem.enabled:
+                chain = self.mem.chain(
+                    "reserve", step=self.step_idx, slots=len(running),
+                    horizon=h0, pages_needed=need,
+                    free_pages=self.kv.pool.free_pages,
+                    reclaimable=reclaimable or 0)
             horizon = self._bucket_floor(horizon - 1)
+        if chain is not None:
+            chain.add("horizon_shrink", from_h=h0, to_h=horizon)
+            chain.close("shrunk")
         kept = []
         for slot in running:
             req = self.slot_req[slot]
@@ -1224,6 +1341,8 @@ class ServingScheduler:
         # shrink the K bucket before any eviction would run — same
         # policy ladder as the horizon pre-reservation
         reclaimable = None
+        k0 = k
+        chain = None
         while k > 1:
             need = sum(self.kv.pages_needed(
                 s, int(self.lengths[s]) + min(len(drafts.get(s, ())), k)
@@ -1235,7 +1354,16 @@ class ServingScheduler:
                 avail += reclaimable
             if need <= avail:
                 break
+            if chain is None and self.mem.enabled:
+                chain = self.mem.chain(
+                    "spec_reserve", step=self.step_idx,
+                    slots=len(running), spec_k=k0, pages_needed=need,
+                    free_pages=self.kv.pool.free_pages,
+                    reclaimable=reclaimable or 0)
             k = self._spec_bucket_floor(k - 1)
+        if chain is not None:
+            chain.add("spec_k_shrink", from_k=k0, to_k=k)
+            chain.close("shrunk")
         kept = []
         for slot in running:
             req = self.slot_req[slot]
@@ -1425,10 +1553,26 @@ class ServingScheduler:
             # keeps the overlap alive under a warm cache.  Pre-check
             # the exact drainable count so a hopeless chain attempt
             # does not flush the cache on its way to the barrier.
+            chain = self.mem.chain(
+                "chain", step=self.step_idx, slots=len(cont),
+                pages_needed=need,
+                free_pages=self.kv.pool.free_pages) \
+                if self.mem.enabled else None
             if self.prefix_cache is None or \
                     short > self.prefix_cache.reclaimable_pages():
+                # provably-uncoverable shortfall: the most common
+                # reason overlap degrades to a barrier step — it must
+                # leave a forensics chain like every other capacity
+                # decision, not vanish silently
+                if chain is not None:
+                    chain.close("barrier_fallback")
                 return False
-            if self._reclaim_cached(short) < short:
+            drained = self._reclaim_cached(short)
+            if chain is not None:
+                chain.add("cache_drain", pages=drained)
+                chain.close("drained" if drained >= short
+                            else "barrier_fallback")
+            if drained < short:
                 return False
         try:
             for s in cont:
@@ -1636,6 +1780,60 @@ class ServingScheduler:
         # queued/running for further step() calls
         return results
 
+    # -------------------------------------------------------------- audit
+    def audit(self, raise_on_error=True):
+        """Refcount invariant audit (serving/mem_telemetry.audit_pool):
+        cross-check the pool's refcounts against THIS scheduler's
+        holders — slot page tables, the prefix-cache trie, parked
+        handoff chains — and the draft pool against the drafter's
+        tables.  Raises :class:`~deepspeed_tpu.serving.mem_telemetry.
+        AuditError` on a leak, double-free hazard, or orphan table
+        entry.  Over a SHARED (disaggregated) pool only the structural
+        + double-free directions run (``exact=False``): peer schedulers
+        and router-held packets hold references this scheduler cannot
+        see — the exact fleet-wide census is ``ClusterRouter.audit()``.
+        Also asserts the page-state attribution is conservation-exact
+        (the states sum to ``num_pages``)."""
+        chains = [r._attach[0] for r in self._pending_attach]
+        report = memtel.audit_pool(
+            self.kv.pool, managers=[self.kv],
+            caches=[self.prefix_cache] if self.prefix_cache is not None
+            else [], chains=chains, exact=not self._pool_shared,
+            label="kv_pool", raise_on_error=raise_on_error)
+        reports = [report]
+        # getattr like classify(): a duck-typed custom drafter without
+        # the mem_stats hook must not turn a telemetry opt-in into an
+        # AttributeError that kills a working serving loop
+        stats = None if self._spec is None else \
+            getattr(self._spec, "mem_stats", lambda: None)()
+        if stats is not None and getattr(self._spec, "kv", None) \
+                is not None:
+            reports.append(memtel.audit_pool(
+                self._spec.kv.pool, managers=[self._spec.kv],
+                exact=True, label="draft_pool",
+                raise_on_error=raise_on_error))
+        counts = memtel.classify(self)
+        total = sum(counts.get(k, 0) for k in
+                    ("slot", "prefix_shared", "prefix_sole", "handoff",
+                     "unattributed", "free"))
+        if total != self.kv.pool.num_pages:
+            msg = (f"page-state attribution not conservation-exact: "
+                   f"{counts} sums to {total} != "
+                   f"{self.kv.pool.num_pages}")
+            if raise_on_error:
+                raise memtel.AuditError(msg)
+            reports.append({"label": "attribution", "errors": [msg],
+                            "ok": False})
+        if not self._pool_shared and counts["unattributed"]:
+            msg = (f"{counts['unattributed']} allocated page(s) with no "
+                   "known holder on a private pool (leak)")
+            if raise_on_error:
+                raise memtel.AuditError(msg)
+            reports.append({"label": "attribution", "errors": [msg],
+                            "ok": False})
+        return {"ok": all(r.get("ok", True) for r in reports),
+                "reports": reports, "counts": counts}
+
     # ------------------------------------------------------------- health
     def health(self):
         """Liveness/saturation snapshot for operators (exposed by
@@ -1644,6 +1842,18 @@ class ServingScheduler:
         m = self.metrics
         pc = self.prefix_cache
         uptime = max(1e-9, time.monotonic() - self._t_start)
+        # page-state attribution: a fresh host sweep per snapshot (the
+        # heartbeat cadence, not the hot loop), so health() reports the
+        # split whether or not per-step telemetry is on.  Per-device
+        # bytes derive from the existing pool_bytes_per_device figure.
+        mem_counts = memtel.classify(self)
+        bpp = None
+        per_dev = self.mesh_info.get("kv_pool_bytes_per_device")
+        if per_dev:
+            bpp = per_dev // self.kv.pool.num_pages
+
+        def _bytes(pages):
+            return None if bpp is None else int(pages) * bpp
         return {
             "step": self.step_idx,
             "uptime_s": round(uptime, 3),
@@ -1682,6 +1892,26 @@ class ServingScheduler:
             "spec_accepted_tokens": m.spec_accepted,
             "spec_rollbacks": m.spec_rollbacks,
             "spec_degraded": m.spec_degraded,
+            "mem_telemetry": self.mem.enabled,
+            "mem_slot_pages": mem_counts["slot"],
+            "mem_prefix_shared_pages": mem_counts["prefix_shared"],
+            "mem_prefix_sole_pages": mem_counts["prefix_sole"],
+            "mem_handoff_pages": mem_counts["handoff"],
+            "mem_draft_pages": mem_counts.get("draft", 0),
+            "mem_unattributed_pages": mem_counts["unattributed"],
+            "mem_free_pages": mem_counts["free"],
+            "mem_free_frac": round(
+                self.kv.pool.free_pages / self.kv.pool.num_pages, 4),
+            "mem_page_seconds": round(self.mem.page_seconds, 3)
+            if self.mem.enabled else 0.0,
+            "mem_pressure_events": m.mem_pressure_events,
+            "mem_pressure_episodes": m.mem_pressure_episodes,
+            "mem_slot_bytes_per_device": _bytes(mem_counts["slot"]),
+            "mem_prefix_bytes_per_device": _bytes(
+                mem_counts["prefix_shared"] + mem_counts["prefix_sole"]),
+            "mem_handoff_bytes_per_device": _bytes(
+                mem_counts["handoff"]),
+            "mem_free_bytes_per_device": _bytes(mem_counts["free"]),
             "inflight_horizons": len(self._inflight),
             "draining": self.draining,
             "handoffs": m.handoffs,
@@ -1696,4 +1926,9 @@ class ServingScheduler:
         }
 
     def summary(self):
-        return self.metrics.summary(getattr(self, "_wall_s", None))
+        out = self.metrics.summary(getattr(self, "_wall_s", None))
+        if self.mem.enabled:
+            # per-request memory attribution aggregates: page-seconds
+            # is the unit the autotuner's cost model bills capacity in
+            out.update(self.mem.summary_fields())
+        return out

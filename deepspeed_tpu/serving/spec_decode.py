@@ -76,6 +76,14 @@ class Drafter:
     def on_release(self, slot, req):
         """The slot was vacated (any terminal or preemption path)."""
 
+    def mem_stats(self):
+        """Memory-telemetry hook: drafters that own device memory (the
+        draft model's private page pool) report it here so the
+        page-state attribution can account the draft pool next to the
+        main one.  ``None`` means "no pool of my own" (NgramDrafter,
+        stateless custom drafters)."""
+        return None
+
 
 class NgramDrafter(Drafter):
     """Prompt-lookup / n-gram drafting: match the sequence's trailing
@@ -279,3 +287,9 @@ class DraftModelDrafter(Drafter):
         self.kv.release_slot(slot)
         self.lengths[slot] = 0
         self._written[slot] = 0
+
+    def mem_stats(self):
+        pool = self.kv.pool
+        return {"draft_pages": pool.pages_in_use,
+                "draft_free": pool.free_pages,
+                "draft_num_pages": pool.num_pages}

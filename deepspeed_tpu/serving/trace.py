@@ -56,7 +56,8 @@ from deepspeed_tpu.tracing import (EVENT_TAXONOMY,  # noqa: F401
                                    FlightRecorder,
                                    SpanTracer,
                                    merge_chrome,
-                                   prometheus_text)
+                                   prometheus_text,
+                                   start_metrics_server)
 from deepspeed_tpu.utils.logging import logger
 
 

@@ -95,6 +95,27 @@ EVENT_TAXONOMY = {
     # disaggregation
     "serving/handoff": "one prefill->decode KV chain handed off",
     "serving/handoff_tokens": "prefilled positions transferred",
+    # HBM capacity / page-pool attribution (MemTelemetry; the page-state
+    # taxonomy is conservation-exact: slot + prefix_shared + prefix_sole
+    # + handoff + unattributed + free == num_pages at every step)
+    "serving/mem/slot_pages": "pages held as live-slot KV",
+    "serving/mem/prefix_shared_pages":
+        "prefix-cache pages shared with >= 1 live reader",
+    "serving/mem/prefix_sole_pages":
+        "prefix-cache pages held by the cache alone (reclaimable)",
+    "serving/mem/handoff_pages":
+        "pages parked in prefill->decode handoff chains",
+    "serving/mem/draft_pages": "draft-model pool pages in use",
+    "serving/mem/unattributed_pages":
+        "shared-pool pages held by a peer scheduler (0 standalone)",
+    "serving/mem/free_pages": "pages on the free list",
+    "serving/mem/free_frac": "free fraction of the page pool",
+    "serving/mem/page_seconds":
+        "cumulative page-seconds integral across all requests",
+    "serving/mem/pressure":
+        "one capacity-decision causal chain recorded (value = 1)",
+    "serving/mem/pressure_episode":
+        "sustained-pressure episode fired (free_frac under threshold)",
     # serving topology (construction-time gauges; axis set =
     # MeshConfig's known axes)
     "serving/mesh/data": "mesh data-axis size",
@@ -248,6 +269,19 @@ class SpanTracer:
                     time.monotonic() if ts is None else ts, 0.0,
                     track, rid, args, process, None))
 
+    def counter(self, name, values, *, cat="mem", track="counters",
+                rid=None, process=None, ts=None):
+        """Perfetto *counter track* sample ("C" event): ``values`` is a
+        flat {series: number} dict — Perfetto renders one stacked
+        counter track per (process, name) with one series per key (the
+        page-pool occupancy split rides this).  Samples are cheap flat
+        tuples like spans; the dict is only serialized at export."""
+        if not self.enabled:
+            return
+        self._push(("C", name, cat,
+                    time.monotonic() if ts is None else ts, 0.0,
+                    track, rid, values, process, None))
+
     def flow(self, phase, flow_id, name, *, cat="failover",
              track="scheduler", rid=None, args=None, process=None):
         """Chrome-trace flow event: ``phase`` 's' starts an arrow,
@@ -398,6 +432,9 @@ def merge_chrome(event_lists):
                 row["dur"] = ev.get("dur", 0.0)
             if ev["ph"] == "i":
                 row["s"] = "t"      # thread-scoped instant
+            # "C" counter samples need no extra fields: Perfetto keys a
+            # counter track on (pid, name) and plots one series per
+            # args entry (the page-pool state split)
             if "id" in ev:
                 row["id"] = ev["id"]
             args = dict(ev.get("args") or {})
@@ -580,3 +617,58 @@ def prometheus_text(metrics, *, prefix="ds_serving", labels=None,
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{label_s} {val}")
     return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------- metrics HTTP endpoint
+
+def start_metrics_server(health_fn, *, summary_fn=None, port=0,
+                         prefix="ds_serving", labels=None,
+                         host="127.0.0.1"):
+    """Serve the Prometheus exposition of ``health_fn()`` (and
+    optionally ``summary_fn()`` under ``<prefix>_summary_*``) over a
+    stdlib HTTP endpoint — ``GET /metrics`` for scrapers, ``GET
+    /healthz`` for the raw health JSON — so the ``.prom``
+    textfile-collector dance (``ds_serve --health-interval``) becomes
+    optional.  ``port=0`` binds an ephemeral port; read it back from
+    ``server.server_port``.  Runs on a daemon thread; call
+    ``server.shutdown()`` to stop.  A failing health callable answers
+    500 rather than killing the serving loop's thread."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                if self.path.split("?")[0] == "/healthz":
+                    body = _json.dumps(health_fn()).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/metrics":
+                    text = prometheus_text(health_fn(), prefix=prefix,
+                                           labels=labels)
+                    if summary_fn is not None:
+                        text += prometheus_text(summary_fn(),
+                                                prefix=prefix + "_summary",
+                                                labels=labels)
+                    body = text.encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+            except Exception:   # a broken source must answer, not hang
+                self.send_response(500)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):   # scrapers must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer((host, int(port)), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

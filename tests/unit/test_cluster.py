@@ -84,8 +84,11 @@ def test_failover_zero_lost_token_exact(engine, tmp_path):
     prompts, max_new = _mixed_workload(rng)
     want = _oracle(engine, prompts, max_new)
 
+    # audit_every=1: the PR-11 refcount auditor rides every replica's
+    # barrier steps through the whole failover scenario
     reps = make_local_fleet(engine, 3, prefix_cache=True,
-                            spec_decode="ngram", spec_k=4, **CFG)
+                            spec_decode="ngram", spec_k=4,
+                            audit_every=1, **CFG)
     router = ClusterRouter(reps)
     inj = faults.FaultInjector(seed=0)
     plan = inj.on("cluster.replica_kill", match={"replica": "replica0"},
@@ -95,6 +98,7 @@ def test_failover_zero_lost_token_exact(engine, tmp_path):
                    for p, m in zip(prompts, max_new)]
         got = router.run()
     assert plan.fired == 1, "the kill must actually land mid-stream"
+    router.audit()   # fleet-wide refcount census after the failover
     h = router.health()
     assert h["failovers"] == 1
     assert h["replays"] >= 1, "the dead replica held work"
@@ -359,6 +363,26 @@ HEALTH_SCHEMA = {
     "spec_accepted_tokens": (int,),
     "spec_rollbacks": (int,),
     "spec_degraded": (int,),
+    # memory observability (PR 11): the page-state attribution rides
+    # every health snapshot (telemetry on or off — the sweep is
+    # heartbeat-cadence); byte figures derive from the topology
+    # snapshot's pool_bytes_per_device
+    "mem_telemetry": (bool,),
+    "mem_slot_pages": (int,),
+    "mem_prefix_shared_pages": (int,),
+    "mem_prefix_sole_pages": (int,),
+    "mem_handoff_pages": (int,),
+    "mem_draft_pages": (int,),
+    "mem_unattributed_pages": (int,),
+    "mem_free_pages": (int,),
+    "mem_free_frac": (float,),
+    "mem_page_seconds": (float,),
+    "mem_pressure_events": (int,),
+    "mem_pressure_episodes": (int,),
+    "mem_slot_bytes_per_device": (int, type(None)),
+    "mem_prefix_bytes_per_device": (int, type(None)),
+    "mem_handoff_bytes_per_device": (int, type(None)),
+    "mem_free_bytes_per_device": (int, type(None)),
     "inflight_horizons": (int,),
     "draining": (bool,),
     "handoffs": (int,),

@@ -1,0 +1,550 @@
+"""HBM capacity observability (serving/mem_telemetry.py).
+
+The acceptance pins:
+
+* **Zero-cost-when-off** — with memory telemetry disabled the scheduler
+  runs the byte-identical loop: same tokens, same compile counts,
+  nothing recorded (the shared NULL_MEM singleton — the NULL_TRACER
+  pattern).
+* **Conservation-exact attribution** — at every audited barrier the
+  page-state categories sum to ``num_pages``; the auditor passes over
+  the nastiest ownership-transfer paths (prefix donate→share→evict,
+  ``take_slot_pages``→``adopt_chain`` handoff, ``truncate_slot`` under
+  shared pages, replica die/restart over a shared disaggregated pool)
+  while a deliberately injected leak and double-share are each CAUGHT
+  (mutation tests).
+* **Pressure forensics** — a forced pressure episode (the hostage-page
+  pattern) produces a flight dump whose causal chain names the
+  trigger, the drained cache pages and the evicted victim's rid, and
+  the merged Chrome trace carries the pool counter track ("C" events)
+  alongside the PR-8 spans.
+* **Free/share hardening** — ``PagePool.free``/``share`` reject
+  unknown or already-free page ids with a clear ValueError (double
+  free, foreign id) instead of corrupting the free list.
+* **/metrics endpoint** — the stdlib HTTP exposition ds_serve's
+  ``--metrics-port`` serves is scrapable (``/metrics`` Prometheus
+  text, ``/healthz`` JSON).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import (AuditError, ClusterRouter,
+                                   FlightRecorder, MemTelemetry,
+                                   PagedKVManager, PagePool, PrefixCache,
+                                   ServingScheduler, SpanTracer,
+                                   audit_pool, classify,
+                                   make_disaggregated_group,
+                                   start_metrics_server)
+from deepspeed_tpu.serving.mem_telemetry import NULL_CHAIN, NULL_MEM
+
+CFG = dict(num_slots=3, num_pages=16, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+PS = CFG["page_size"]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+def _oracle(engine, prompts, max_new):
+    return [
+        [int(t) for t in
+         engine.generate(p[None], max_new_tokens=m, do_sample=False)[
+             0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+def _mem_state_sum(counts):
+    return sum(counts.get(k, 0) for k in
+               ("slot", "prefix_shared", "prefix_sole", "handoff",
+                "unattributed", "free"))
+
+
+# --------------------------------------- free/share hardening (satellite)
+
+
+def test_pool_free_share_reject_foreign_and_double_free():
+    """A double free or a foreign page id must raise a clear ValueError
+    and leave the books intact — a silent duplicate free-list entry
+    would hand one page to two owners on the next allocate."""
+    pool = PagePool(num_pages=4, page_size=8)
+    pages = pool.allocate(2)
+    pool.free([pages[0]])
+    with pytest.raises(ValueError, match="double free or foreign"):
+        pool.free([pages[0]])          # double free
+    with pytest.raises(ValueError, match="double free or foreign"):
+        pool.free([99])                # foreign id, way out of range
+    with pytest.raises(ValueError, match="double free or foreign"):
+        pool.free([3] if 3 != pages[1] else [2])   # valid id, not allocated
+    with pytest.raises(ValueError, match="cannot share"):
+        pool.share([pages[0]])         # sharing a free page
+    with pytest.raises(ValueError, match="cannot share"):
+        pool.share([99])
+    # a MIXED good/bad list rejects atomically: the good id keeps its
+    # holder (no half-applied free hiding behind the ValueError)
+    with pytest.raises(ValueError):
+        pool.free([pages[1], 99])
+    assert pool.ref_count(pages[1]) == 1, "atomic reject: ref survives"
+    with pytest.raises(ValueError):
+        pool.share([pages[1], 99])
+    assert pool.ref_count(pages[1]) == 1, "atomic reject: no phantom"
+    # freeing one page twice in ONE call needs two holders: rejected
+    # up front at refcount 1, legal at refcount 2
+    with pytest.raises(ValueError):
+        pool.free([pages[1], pages[1]])
+    assert pool.ref_count(pages[1]) == 1
+    pool.share([pages[1]])
+    pool.free([pages[1], pages[1]])
+    # the failed calls corrupted nothing: books still audit clean
+    assert pool.free_pages + pool.pages_in_use == pool.num_pages
+    assert len(set(pool._free)) == len(pool._free)
+    assert pool.pages_in_use == 0
+    assert sorted(pool._free) == [0, 1, 2, 3]
+
+
+# ------------------------------------------------- auditor (pure host)
+
+
+def _host_setup():
+    """pool + manager + cache holding a realistic mix: slot 0 shares a
+    cached chain and grew private pages; the cache holds one extra
+    sole page."""
+    pool = PagePool(num_pages=12, page_size=4)
+    kv = PagedKVManager(12, 4, num_slots=2, max_pages_per_slot=6,
+                        pool=pool)
+    cache = PrefixCache(pool)
+    donor = pool.allocate(3)
+    leftover = cache.insert(list(range(12)), donor)
+    assert not leftover
+    full, _, _ = cache.match(list(range(12)))
+    kv.attach_prefix(0, cache.acquire(full[:2]))   # share 2 cached pages
+    kv.ensure_capacity(0, 16)                      # + 2 private pages
+    return pool, kv, cache
+
+
+def test_audit_pool_passes_and_classifies_clean():
+    pool, kv, cache = _host_setup()
+    report = audit_pool(pool, managers=[kv], caches=[cache])
+    assert report["ok"] and report["holders"] == 4 + 3
+
+
+def test_audit_catches_injected_leak():
+    """Mutation test: a page allocated (or an extra reference taken)
+    with no holder recorded anywhere is a leak the audit must name."""
+    pool, kv, cache = _host_setup()
+    pool.allocate(1)                   # the leak: nobody owns it
+    with pytest.raises(AuditError, match="leak"):
+        audit_pool(pool, managers=[kv], caches=[cache])
+    # the same leak injected as a phantom EXTRA reference on a live page
+    pool2, kv2, cache2 = _host_setup()
+    pool2.share([kv2._slot_pages[0][0]])
+    with pytest.raises(AuditError, match="leak"):
+        audit_pool(pool2, managers=[kv2], caches=[cache2])
+
+
+def test_audit_catches_double_share_hazard():
+    """Mutation test: a page mapped into a second table WITHOUT a
+    pool.share is a double-free hazard (either holder's free recycles
+    it under the other) — the audit must catch the missing share."""
+    pool, kv, cache = _host_setup()
+    page = kv._slot_pages[0][0]
+    kv.table[1, 0] = page              # slot 1 maps it...
+    kv._slot_pages[1].append(page)     # ...but never took a reference
+    with pytest.raises(AuditError, match="double-free hazard"):
+        audit_pool(pool, managers=[kv], caches=[cache])
+
+
+def test_audit_catches_orphan_and_freelist_corruption():
+    pool, kv, cache = _host_setup()
+    # orphan: force-free a page a slot still references
+    page = kv._slot_pages[0][-1]       # private page, refcount 1
+    pool.free([page])
+    with pytest.raises(AuditError, match="orphan"):
+        audit_pool(pool, managers=[kv], caches=[cache])
+    # free-list corruption: a duplicate entry
+    pool2 = PagePool(num_pages=4, page_size=4)
+    pool2._free.append(pool2._free[-1])
+    with pytest.raises(AuditError, match="duplicate|num_pages"):
+        audit_pool(pool2)
+
+
+def test_audit_truncate_slot_under_shared_pages():
+    """truncate_slot over a chain whose head pages the cache shares:
+    the rollback drops only the slot's holds past the boundary — the
+    cache's references survive and the census stays exact."""
+    pool, kv, cache = _host_setup()
+    kv.truncate_slot(0, 5)             # keep 2 pages (ceil(5/4))
+    audit_pool(pool, managers=[kv], caches=[cache])
+    kv.truncate_slot(0, 0)             # drop everything incl. shared
+    audit_pool(pool, managers=[kv], caches=[cache])
+    # cached pages survived their readers letting go
+    assert cache.cached_pages == 3
+    assert all(pool.ref_count(p) == 1 for p in cache.iter_pages())
+    cache.evict(3)
+    audit_pool(pool, managers=[kv], caches=[cache])
+    assert pool.pages_in_use == 0
+
+
+def test_audit_take_slot_pages_handoff_chain():
+    """take_slot_pages -> (chain in flight) -> adopt_chain: the pages'
+    references travel with the detached chain; the audit accounts them
+    via ``chains=`` while in flight and via the adopter afterwards."""
+    pool = PagePool(8, 4)
+    a = PagedKVManager(8, 4, 1, 6, pool=pool)
+    b = PagedKVManager(8, 4, 1, 6, pool=pool)
+    a.ensure_capacity(0, 10)
+    chain = a.take_slot_pages(0)
+    audit_pool(pool, managers=[a, b], chains=[chain])
+    # losing track of the chain is exactly the leak the audit flags
+    with pytest.raises(AuditError, match="leak"):
+        audit_pool(pool, managers=[a, b])
+    b.adopt_chain(0, chain)
+    audit_pool(pool, managers=[a, b])
+    b.release_slot(0)
+    audit_pool(pool, managers=[a, b])
+    assert pool.pages_in_use == 0
+
+
+# ------------------------------------------------- zero cost when off
+
+
+def test_mem_off_is_zero_cost(engine):
+    """The pin: telemetry disabled leaves tokens AND compile signatures
+    byte-identical, shares the NULL_MEM singleton, and records
+    nothing."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, 7).astype(np.int32) for _ in range(4)]
+    max_new = [6, 5, 6, 5]
+    want = _oracle(engine, prompts, max_new)
+
+    def compiles():
+        return (engine.serving_decode_multi_compile_count(),
+                engine.serving_decode_compile_count(),
+                engine.serving_verify_compile_count(),
+                engine.serving_page_copy_compile_count())
+
+    def serve(**kw):
+        sched = ServingScheduler(engine, **CFG, **kw)
+        reqs = [sched.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, max_new)]
+        sched.run()
+        return sched, reqs
+
+    s_off, r_off = serve()
+    assert s_off.mem is NULL_MEM
+    s_off2, _ = serve()
+    assert s_off2.mem is NULL_MEM, "off must share ONE inert instance"
+    compiles_off = compiles()
+    assert NULL_MEM.pressure_events == 0 and not NULL_MEM.pressure_log
+    assert all(r.pages_hwm == 0 and r.page_seconds == 0.0
+               for r in r_off), "off must not account anything"
+
+    s_on, r_on = serve(mem_telemetry=True, audit_every=1)
+    compiles_on = compiles()
+    for a, b, w in zip(r_off, r_on, want):
+        assert a.out_tokens == w and b.out_tokens == w
+    # telemetry is host-only: not ONE new compiled signature
+    assert compiles_on == compiles_off
+    assert s_on.mem.page_seconds > 0
+    assert all(r.pages_hwm >= 1 for r in r_on)
+    # NULL_CHAIN is inert and shared
+    assert NULL_MEM.chain("grow") is NULL_CHAIN
+    NULL_CHAIN.add("x")
+    NULL_CHAIN.close("y")
+
+
+# ------------------------------- conservation over live serving paths
+
+
+def test_conservation_and_audit_across_serving_oracle(engine):
+    """Prefix cache (donate -> share -> COW) + ngram spec (rollback via
+    truncate_slot) + retirement, audited at EVERY barrier step
+    (audit_every=1 raises on any leak/double-free/orphan and asserts
+    the page states sum to num_pages).  Output stays token-exact, and
+    the per-request attribution lands in requests and summary()."""
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 256, 20).astype(np.int32)
+    motif = rng.integers(0, 256, 4).astype(np.int32)
+    prompts = [base,
+               np.concatenate([base[:16],
+                               rng.integers(0, 256, 4).astype(np.int32)]),
+               np.concatenate([np.tile(motif, 3),
+                               rng.integers(0, 256, 4).astype(np.int32)])]
+    max_new = [5, 4, 12]
+    want = _oracle(engine, prompts, max_new)
+    sched = ServingScheduler(engine, prefix_cache=True,
+                             spec_decode="ngram", spec_k=4,
+                             mem_telemetry=True, audit_every=1, **CFG)
+    reqs = []
+    for p, m in zip(prompts, max_new):
+        reqs.append(sched.submit(p, max_new_tokens=m))
+        sched.run()
+    for r, w in zip(reqs, want):
+        assert r.state == "finished" and r.out_tokens == w
+    report = sched.audit()
+    assert report["ok"]
+    counts = report["counts"]
+    assert _mem_state_sum(counts) == CFG["num_pages"]
+    assert counts["unattributed"] == 0
+    assert counts["prefix_sole"] + counts["prefix_shared"] == \
+        sched.prefix_cache.cached_pages
+    # per-request memory attribution: the billing unit is live
+    assert all(r.pages_hwm >= 1 for r in reqs)
+    assert all(r.page_seconds > 0 for r in reqs)
+    s = sched.summary()
+    assert s["page_seconds_total"] >= max(r.page_seconds for r in reqs)
+    assert s["pages_in_use_hwm"] >= 2
+    h = sched.health()
+    assert h["mem_telemetry"] is True
+    assert _mem_state_sum({k[len("mem_"):-len("_pages")]: v
+                           for k, v in h.items()
+                           if k.startswith("mem_") and
+                           k.endswith("_pages")}) + 0 == CFG["num_pages"]
+
+
+def test_disagg_shared_pool_audit_and_die_restart(engine):
+    """The PR-7 bug class, machine-checked: a disaggregated group (one
+    shared pool, prefill + decode workers, router-held handoff
+    packets) audits exactly via ClusterRouter.audit() — through live
+    handoffs, a replica death (whose reclaim must make the shared pool
+    whole), and a restart.  A deliberately injected double-share after
+    the run is CAUGHT."""
+    reps = make_disaggregated_group(
+        engine, num_prefill=1, num_decode=2, num_pages=CFG["num_pages"],
+        page_size=CFG["page_size"], num_slots=CFG["num_slots"],
+        max_pages_per_slot=CFG["max_pages_per_slot"],
+        prefill_chunk=CFG["prefill_chunk"], prefix_cache=True,
+        mem_telemetry=True, audit_every=2)
+    router = ClusterRouter(reps)
+    rng = np.random.default_rng(2)
+    # prompts long enough that decode-side retirement donates >= 1 FULL
+    # page into the prefix cache (seq > page_size + 1), so the shared
+    # pool really holds cache + slot + packet pages at once
+    prompts = [rng.integers(0, 256, 20).astype(np.int32)
+               for _ in range(5)]
+    max_new = [6, 5, 6, 5, 6]
+    want = _oracle(engine, prompts, max_new)
+    entries = [router.submit(p, max_new_tokens=m)
+               for p, m in zip(prompts, max_new)]
+    # audit the fleet mid-flight a few times (handoff packets included)
+    for _ in range(6):
+        router.step()
+        router.audit()
+    got = router.run()
+    router.audit()
+    for e, w in zip(entries, want):
+        assert e.state == "finished" and got[e.rid] == w
+
+    # kill the decode worker holding work and replay onto the survivor
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.replica_kill",
+                  match={"replica": f"{reps[1].id}"},
+                  step=router.step_idx + 3,
+                  exc=RuntimeError("chaos"))
+    with faults.injected(inj):
+        e2 = [router.submit(p, max_new_tokens=m, rid=f"r2-{i}")
+              for i, (p, m) in enumerate(zip(prompts, max_new))]
+        got2 = router.run()
+    assert plan.fired == 1
+    router.audit()        # death's reclaim left the shared pool whole
+    for e, w in zip(e2, want):
+        assert e.state == "finished" and got2[e.rid] == w
+    router.restart_replica(reps[1])
+    router.audit()
+    # mutation: one phantom holder on a cached page — the fleet census
+    # must flag the leak direction
+    pool = reps[0].sched.kv.pool
+    victim_sched = next(r.sched for r in reps
+                        if r.sched is not None and
+                        r.sched.prefix_cache is not None and
+                        r.sched.prefix_cache.cached_pages)
+    page = next(iter(victim_sched.prefix_cache.iter_pages()))
+    pool.share([page])
+    with pytest.raises(AuditError, match="leak"):
+        router.audit()
+    pool.free([page])     # undo for the shared module engine
+    router.audit()
+
+
+# ---------------------------------------------- pressure forensics
+
+
+def test_pressure_episode_flight_dump_and_counter_tracks(engine,
+                                                         tmp_path):
+    """The acceptance forensics oracle: hostage pages squeeze the pool
+    until a live request's growth must drain the warm prefix cache AND
+    evict a victim.  The sustained-pressure episode fires a flight
+    dump whose causal chain names the trigger ('grow'), the drained
+    cache pages and the evicted victim's rid; the merged Chrome trace
+    carries the pool counter track ('C' events, states summing to
+    num_pages) alongside the PR-8 spans — and everything stays
+    token-exact."""
+    tracer = SpanTracer(process="serve0")
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    mem = MemTelemetry(pressure_threshold=0.3, pressure_steps=2,
+                       flight=flight)
+    sched = ServingScheduler(engine, prefix_cache=True, tracer=tracer,
+                             mem_telemetry=mem, **CFG)
+    rng = np.random.default_rng(3)
+    warm_prompt = rng.integers(0, 256, 40).astype(np.int32)
+    pa = rng.integers(0, 256, 8).astype(np.int32)
+    pb = rng.integers(0, 256, 8).astype(np.int32)
+    want = _oracle(engine, [warm_prompt, pa, pb], [4, 56, 40])
+
+    w = sched.submit(warm_prompt, max_new_tokens=4)
+    sched.run()
+    assert w.out_tokens == want[0]
+    assert sched.prefix_cache.cached_pages == 2, "warm cache expected"
+    free = sched.kv.pool.free_pages
+    hostage = sched.kv.pool.allocate(free - 3)   # 3 free + 2 cached left
+    # combined demand (4 + 3 pages) exceeds free + drainable cache, so
+    # growth must BOTH drain the warm cache and evict a victim
+    a = sched.submit(pa, max_new_tokens=56)      # needs 4 pages total
+    b = sched.submit(pb, max_new_tokens=40)      # needs 3 pages total
+    sched.run()
+    assert a.out_tokens == want[1] and b.out_tokens == want[2]
+    h = sched.health()
+    assert h["preemptions"] >= 1, "the squeeze must have evicted"
+    assert sched.metrics.cache_evictions >= 1, "…and drained the cache"
+
+    # (a) the causal chain: trigger -> cache_drain -> evict(victim rid)
+    chains = list(mem.pressure_log)
+    assert chains, "pressure chains must have been recorded"
+    grow = [c for c in chains if c["trigger"] == "grow" and
+            any(act["act"] == "evict" for act in c["actions"])]
+    assert grow, f"no grow->evict chain in {chains}"
+    evict_acts = [act for c in grow for act in c["actions"]
+                  if act["act"] == "evict"]
+    assert any(act["victim_rid"] in (a.rid, b.rid)
+               for act in evict_acts), \
+        "the chain must name the evicted victim's rid"
+    assert any(act["act"] == "cache_drain" and act["pages"] >= 1
+               for c in chains for act in c["actions"]), \
+        "the chain must name the drained cache pages"
+
+    # (b) the sustained episode fired once and dumped
+    assert mem.pressure_episodes >= 1
+    assert flight.dumps, "the episode must trigger a flight dump"
+    rec = json.loads(open(flight.dumps[0]).read())
+    assert rec["reason"] == "mem_pressure"
+    assert rec["extra"]["free_frac"] < 0.3
+    assert rec["extra"]["pressure_log"], "chains ride the dump"
+    assert rec["extra"]["page_churn"].get("alloc", 0) > 0, \
+        "pool-observer churn counters ride the dump"
+    assert {a.rid, b.rid} & set(rec["extra"]["live_rids"]), \
+        "the dump must correlate to live request rids"
+    assert _mem_state_sum(rec["extra"]["pool"]) == CFG["num_pages"]
+
+    # (c) counter tracks merged next to the spans, Perfetto-loadable
+    trace = json.loads(json.dumps(tracer.to_chrome()))
+    evs = trace["traceEvents"]
+    for e in evs:
+        assert e["ph"] in ("X", "i", "s", "f", "M", "C")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    counters = [e for e in evs
+                if e["ph"] == "C" and e["name"] == "mem/pages"]
+    assert counters, "pool counter samples must be in the trace"
+    for c in counters:
+        assert _mem_state_sum(c["args"]) == CFG["num_pages"], \
+            "every counter sample is conservation-exact"
+    assert any(c["args"]["prefix_sole"] + c["args"]["prefix_shared"] > 0
+               for c in counters), "the warm cache shows in the track"
+    assert any(e["ph"] == "X" and e["name"] == "decode_burst"
+               for e in evs), "spans ride the same trace"
+    assert any(e["ph"] == "i" and e["name"] == "mem_pressure"
+               for e in evs), "pressure instants ride the same trace"
+
+    # cleanup: hostages back, retire-donated pages drained, audit clean
+    sched.kv.pool.free(hostage)
+    sched.prefix_cache.evict(CFG["num_pages"])
+    sched.audit()
+    assert sched.kv.pool.pages_in_use == 0
+
+
+def test_page_seconds_not_billed_across_idle_gaps(engine):
+    """Regression: a scheduler reused across run() calls idles between
+    them with the accounting clock parked — a request admitted AFTER
+    the gap must be billed from its own admission, not from the
+    previous run's last step (page-seconds is the tenant-billing
+    unit; a 60s idle gap must not bill a fresh request 60s/page)."""
+    import time as _time
+    sched = ServingScheduler(engine, mem_telemetry=True, **CFG)
+    r1 = sched.submit(np.zeros(6, np.int32), max_new_tokens=3)
+    sched.run()
+    gap = 0.4
+    _time.sleep(gap)
+    r2 = sched.submit(np.zeros(7, np.int32), max_new_tokens=3)
+    sched.run()
+    assert r2.page_seconds < gap, \
+        (r2.page_seconds, "idle gap billed to a fresh request")
+    assert r1.page_seconds >= 0 and r2.pages_hwm >= 1
+
+
+def test_shared_mem_instance_rejected(engine):
+    """Regression: ONE MemTelemetry instance bound to two schedulers
+    would cross-wire their gauges and page-seconds clocks — the second
+    constructor must reject it loudly."""
+    mem = MemTelemetry()
+    ServingScheduler(engine, mem_telemetry=mem, **CFG)
+    with pytest.raises(ValueError, match="already bound"):
+        ServingScheduler(engine, mem_telemetry=mem, **CFG)
+
+
+# ---------------------------------------------- /metrics endpoint
+
+
+def test_metrics_port_scrapes_health_and_summary():
+    """The --metrics-port satellite: /metrics serves the Prometheus
+    exposition of health()+summary(), /healthz the raw JSON; unknown
+    paths 404; a broken source answers 500 (never hangs)."""
+    health = {"free_pages": 7, "mem_telemetry": True,
+              "page_utilization": 0.44, "last_error": None}
+    calls = {"n": 0}
+
+    def health_fn():
+        calls["n"] += 1
+        return health
+
+    server = start_metrics_server(
+        health_fn, summary_fn=lambda: {"ttft_ms_p50": 12.5}, port=0,
+        prefix="ds_serving", labels={"replica": "r0"})
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=10).read().decode()
+        assert 'ds_serving_free_pages{replica="r0"} 7' in text
+        assert 'ds_serving_mem_telemetry{replica="r0"} 1' in text
+        assert 'ds_serving_summary_ttft_ms_p50{replica="r0"} 12.5' in text
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=10).read().decode())
+        assert hz == health
+        assert calls["n"] == 2, "each scrape reads a FRESH snapshot"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert ei.value.code == 404
+
+        def broken():
+            raise RuntimeError("boom")
+        server2 = start_metrics_server(broken, port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server2.server_port}/metrics",
+                    timeout=10)
+            assert ei.value.code == 500
+        finally:
+            server2.shutdown()
+    finally:
+        server.shutdown()

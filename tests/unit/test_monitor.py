@@ -139,6 +139,11 @@ def _drive_all_serving_events(m):
     m.record_spec_degrade(1, rid=1, reason="x")
     m.record_spec_wait(1, 0.001)
     m.record_handoff(1, 32)
+    m.record_mem(1, {"slot": 3, "prefix_shared": 2, "prefix_sole": 1,
+                     "handoff": 0, "draft": 0, "unattributed": 0,
+                     "free": 10}, 0.625, 1.25)
+    m.record_pressure(1, "grow")
+    m.record_pressure_episode(1)
     m.record_first_token(1, 0.05)
     m.record_token(1, 0.01)
     for state in ("failed", "shed", "cancelled"):

@@ -174,8 +174,11 @@ def test_cache_hits_token_exact_vs_generate(engine, hit_mix, horizon):
     prompts, max_new, want = hit_mix
     a, b, c, d = prompts
 
+    # audit_every=1: the PR-11 refcount invariant auditor sweeps every
+    # barrier step of this oracle — donate/share/COW/evict must stay
+    # leak- and double-free-clean, not just token-exact
     sched = ServingScheduler(engine, decode_horizon_steps=horizon,
-                             prefix_cache=True, **CFG)
+                             prefix_cache=True, audit_every=1, **CFG)
     ra = sched.submit(a, max_new_tokens=max_new[0])
     got1 = sched.run()
     assert got1[ra.rid] == want[0] and ra.cached_prefix_tokens == 0

@@ -250,6 +250,8 @@ def test_page_pool_exhausted_dead_end():
     sched.waiting = deque()
     sched.step_idx = 0
     sched.prefix_cache = None     # nothing cached -> nothing reclaimable
+    from deepspeed_tpu.serving.mem_telemetry import NULL_MEM
+    sched.mem = NULL_MEM          # telemetry off, like the constructor
     kv.pool.allocate(4)          # a foreign reservation drains the pool
     with pytest.raises(PagePoolExhausted, match="no evictable request"):
         sched._grow_or_evict(1, 8)

@@ -79,7 +79,9 @@ def test_horizon_oracle_token_exact_with_mid_horizon_eos(engine, horizon):
     want = _oracle(engine, prompts, max_new, eos=eos)
     assert want[0] == base[0][:k + 1]
 
-    sched = ServingScheduler(engine, decode_horizon_steps=horizon, **CFG)
+    # audit_every=1: the PR-11 refcount auditor rides the whole oracle
+    sched = ServingScheduler(engine, decode_horizon_steps=horizon,
+                             audit_every=1, **CFG)
     streamed = {}
     reqs = [sched.submit(p, max_new_tokens=m, eos_token_id=eos,
                          on_token=lambda r, t: streamed.setdefault(

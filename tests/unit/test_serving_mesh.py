@@ -103,7 +103,10 @@ def test_mesh_serving_token_exact(engines, workload, model_ax, data_ax):
     bucket."""
     prompts, max_new, want = workload
     eng = engines(model_ax, data_ax)
-    sched = ServingScheduler(eng, decode_horizon_steps=8, **CFG)
+    # audit_every=1: page bookkeeping is mesh-agnostic by contract, so
+    # the PR-11 refcount auditor must pass identically on-mesh
+    sched = ServingScheduler(eng, decode_horizon_steps=8, audit_every=1,
+                             **CFG)
     reqs = [sched.submit(p, max_new_tokens=m)
             for p, m in zip(prompts, max_new)]
     got = sched.run()

@@ -54,6 +54,10 @@ def _oracle(engine, prompts, max_new, eos=None):
 
 def _serve(engine, prompts, max_new, eos=None, **kw):
     kw.setdefault("decode_horizon_steps", 8)
+    # PR-11 refcount auditor on every barrier step: spec rollback
+    # (truncate_slot) and draft-pool sync must stay leak-free, audited
+    # live across every oracle in this module
+    kw.setdefault("audit_every", 1)
     sched = ServingScheduler(engine, **CFG, **kw)
     reqs = [sched.submit(p, max_new_tokens=m, eos_token_id=eos)
             for p, m in zip(prompts, max_new)]

@@ -58,8 +58,8 @@ def main():
     import jax.numpy as jnp
     from jax import lax
     from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.comm.telemetry import bench_row, write_ledger_json
     from deepspeed_tpu.parallel.topology import make_mesh
-    from deepspeed_tpu.utils.comms_logging import calc_bw_log
 
     if dist.get_mesh() is None:
         dist.set_mesh(make_mesh())
@@ -112,13 +112,17 @@ def main():
                 if t >= args.warmups:
                     times.append(dt)
             lat = float(np.median(times))
-            # calc_bw_log expects the per-rank message size
-            _, algbw, busbw = calc_bw_log(
+            # the canonical comm-ledger row schema (comm/telemetry.py)
+            # — bench_row expects the per-rank message size and applies
+            # the op's own bw scaling via calc_bw_log
+            row = bench_row(
                 "all_reduce" if op_name == "compressed_allreduce"
-                else op_name, size // max(n, 1), lat, n=n)
-            row = {"op": op_name, "bytes": size, "latency_ms":
-                   round(lat * 1e3, 4), "algbw_gbps": round(algbw, 3),
-                   "busbw_gbps": round(busbw, 3), "n": n}
+                else op_name, size // max(n, 1), lat, n, axis=ax)
+            # keep bench_row's canonical op-scaled bytes so offline
+            # rows join runtime ledger_rows exactly; only the op name
+            # is restored (compressed_allreduce rides all_reduce's
+            # bandwidth formulas)
+            row["op"] = op_name
             if op_name == "compressed_allreduce" and n > 1:
                 # bytes-on-wire per rank: each rank quantizes its LOCAL
                 # shard (eager_collective splits dim 0 over the axis) and
@@ -141,9 +145,9 @@ def main():
             print(json.dumps(row))
             size <<= 2
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump({"mesh": dict(mesh.shape), "axis": ax,
-                       "results": results}, f, indent=2)
+        # committed rounds survive re-runs under previous_committed
+        write_ledger_json(args.json, {"mesh": dict(mesh.shape),
+                                      "axis": ax, "results": results})
         print(f"# wrote {args.json}", file=sys.stderr)
 
 

@@ -66,6 +66,18 @@ CHECKS = [
     ("cluster hit-rate gain vs round-robin", "cluster.hit_rate_gain",
      "info", None),
     ("tracing overhead frac", "tracing.overhead_frac", "ceiling", None),
+    # comm-telemetry rows (PR 12): the per-dispatch capture + watchdog
+    # cost stays under the same near-free ceiling as span tracing (the
+    # ledger analysis compile runs off the timed path by design); the
+    # bytes-per-token figure is the comms scorecard ROADMAP items 4
+    # (shard_mapped kernels on real meshes) and 5 (cross-host KV
+    # transport) must land like-for-like against — info, never gating
+    ("comm-telemetry overhead frac", "comm.overhead_frac", "ceiling",
+     None),
+    ("comm wire bytes/token (decode)", "comm.bytes_per_token", "info",
+     None),
+    ("comm wire bytes/step (decode)", "comm.bytes_per_step", "info",
+     None),
     # memory-telemetry rows (PR 11): overhead stays informational like
     # the other telemetry numbers on shared CI runners; the steady-state
     # prefix-cache occupancy fraction is the capacity trend line the

@@ -123,8 +123,29 @@ def main():
                "latency_ms": round(t_ring, 2), "n_devices": n_dev,
                "chunk": L // n_dev, "max_err_vs_full": round(err, 5),
                "platform": jax.default_backend()}
+        # static HLO comm ledger of the compiled ring kernel: the k/v
+        # chunks really hopping the sequence axis, in the same
+        # (op, bytes, algbw/busbw) vocabulary as run_all.py and the
+        # runtime serving ledger — bench and telemetry numbers are
+        # directly comparable
+        from deepspeed_tpu.profiling.comm_ledger import ledger_for
+        led = ledger_for(ring, q, k, v, mesh=mesh)
+        t_s = max(t_ring * 1e-3, 1e-9)
+        row["comm"] = {"bytes": led["bytes"],
+                       "wire_bytes": led["wire_bytes"],
+                       "per_axis": led["per_axis"]}
         results.append(row)
         print(json.dumps(row))
+        for op, d in sorted(led["per_op"].items()):
+            crow = {"metric": "ring_comm", "op": op,
+                    "bytes": d["bytes"], "wire_bytes": d["wire_bytes"],
+                    "count": d["count"],
+                    "latency_ms": round(t_ring, 2),
+                    "algbw_gbps": round(d["bytes"] / t_s / 1e9, 3),
+                    "busbw_gbps": round(d["wire_bytes"] / t_s / 1e9, 3),
+                    "n": n_dev, "axis": "sequence"}
+            results.append(crow)
+            print(json.dumps(crow))
 
     # ---- block-sparse vs dense at long sequence (the measured speedup
     # backing BASELINE.md's sparse-attention row: the reference claims
@@ -186,8 +207,10 @@ def main():
                 print(json.dumps(row))
 
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump(results, f, indent=2)
+        # comm-ledger schema envelope; committed rounds survive re-runs
+        # under previous_committed
+        from deepspeed_tpu.comm.telemetry import write_ledger_json
+        write_ledger_json(args.json, {"results": results})
 
 
 if __name__ == "__main__":

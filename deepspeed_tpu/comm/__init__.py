@@ -1,6 +1,7 @@
 from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tensor,
                                      all_reduce, all_to_all, all_to_all_single,
-                                     axis_index, axis_size, barrier, barrier_eager,
+                                     attach_monitor, axis_index, axis_size,
+                                     barrier, barrier_eager,
                                      broadcast, comms_logger, configure,
                                      destroy_process_group, eager_collective,
                                      get_local_rank, get_mesh, get_rank,
@@ -12,7 +13,8 @@ from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tenso
 
 __all__ = [
     "ReduceOp", "all_gather", "all_gather_into_tensor", "all_reduce",
-    "all_to_all", "all_to_all_single", "axis_index", "axis_size", "barrier",
+    "all_to_all", "all_to_all_single", "attach_monitor", "axis_index",
+    "axis_size", "barrier",
     "barrier_eager", "broadcast", "comms_logger", "configure",
     "destroy_process_group", "eager_collective", "get_local_rank", "get_mesh",
     "get_rank", "get_world_size", "init_distributed", "is_initialized",

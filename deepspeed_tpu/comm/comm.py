@@ -33,8 +33,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deepspeed_tpu.utils import comms_logging
 from deepspeed_tpu.utils.comms_logging import CommsLogger
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.tracing import current_tracer
 
 comms_logger = CommsLogger()
+
+# optional monitor sink for the periodic comms report: with one
+# attached (and enabled) log_summary routes per-op aggregates through
+# the monitor event stream — the ThroughputTimer pattern — and the
+# legacy print is preserved byte-for-byte when the sink is absent or
+# disabled.  Held as a WEAK reference: a discarded engine's monitor
+# must not outlive it here and silently swallow the legacy print.
+import weakref
+
+_MONITOR = None
+
+
+def attach_monitor(monitor):
+    """Route ``log_summary``'s periodic report through this monitor's
+    ``write_events`` (None detaches; the last attach wins — one live
+    comms report sink per process).  Weakly referenced: the attachment
+    dissolves when the monitor is garbage-collected."""
+    global _MONITOR
+    _MONITOR = None if monitor is None else weakref.ref(monitor)
+
+
+def _attached_monitor():
+    if _MONITOR is None:
+        return None
+    m = _MONITOR()
+    return m if m is not None and getattr(m, "enabled", True) else None
+
+
+def _record(op, x, axes, suffix=None):
+    """Per-collective tracing (comm/telemetry.py): reads the
+    dynamically-scoped tracer so call signatures never grow a tracer
+    parameter.  Zero-cost-when-off: one contextvar read + one attribute
+    check against the shared NULL_TRACER — and for traced collectives
+    this runs at TRACE time (once per compiled signature), never per
+    executed step."""
+    tr = current_tracer()
+    if not tr.enabled:
+        return
+    from deepspeed_tpu.comm.telemetry import record_traced
+    record_traced(tr, op, x, axes, op_suffix=suffix)
 
 # Active global mesh (the "process group world").
 _WORLD_MESH = None
@@ -197,6 +238,7 @@ def axis_size(group=None):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False):
     axes = _axes(group)
+    _record("all_reduce", tensor, axes, suffix=op.name.lower())
     if op == ReduceOp.SUM:
         return lax.psum(tensor, axes)
     if op == ReduceOp.AVG:
@@ -223,6 +265,7 @@ def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None):
 def all_gather(tensor, group=None, axis=0, tiled=True):
     """Gather shards along `axis` (reference all_gather_into_tensor)."""
     axes = _axes(group)
+    _record("all_gather", tensor, axes)
     name = axes if len(axes) > 1 else axes[0]
     return lax.all_gather(tensor, name, axis=axis, tiled=tiled)
 
@@ -233,6 +276,7 @@ all_gather_into_tensor = all_gather
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, scatter_dim=0):
     """Reduce + scatter along scatter_dim (reference reduce_scatter_tensor)."""
     axes = _axes(group)
+    _record("reduce_scatter", tensor, axes)
     name = axes if len(axes) > 1 else axes[0]
     if op == ReduceOp.AVG:
         return lax.psum_scatter(tensor, name, scatter_dimension=scatter_dim,
@@ -248,6 +292,7 @@ def all_to_all_single(tensor, group=None, split_axis=0, concat_axis=0):
     """Exchange equal splits along split_axis (reference all_to_all_single
     :324; the MoE dispatch primitive, ``moe/sharded_moe.py:90``)."""
     axes = _axes(group)
+    _record("all_to_all", tensor, axes)
     name = axes if len(axes) > 1 else axes[0]
     return lax.all_to_all(tensor, name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
@@ -259,6 +304,7 @@ all_to_all = all_to_all_single
 def broadcast(tensor, src=0, group=None):
     """Every member gets the value held by group-index `src`."""
     axes = _axes(group)
+    _record("broadcast", tensor, axes)
     # select src's value: mask + psum
     idx = axis_index(group)
     masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
@@ -274,6 +320,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None):
 def ppermute(tensor, perm, group=None):
     """Point-to-point ring permute (pipeline p2p send/recv both at once)."""
     axes = _axes(group)
+    _record("ppermute", tensor, axes)
     name = axes[0] if len(axes) == 1 else axes
     return lax.ppermute(tensor, name, perm)
 
@@ -293,7 +340,10 @@ def send_recv_prev(tensor, group=None):
 
 def barrier(group=None):
     """Traced: data-dependence barrier via a tiny psum."""
-    return lax.psum(jnp.ones((), jnp.int32), _axes(group))
+    axes = _axes(group)
+    one = jnp.ones((), jnp.int32)
+    _record("barrier", one, axes)
+    return lax.psum(one, axes)
 
 
 # --------------------------------------------------------------------------
@@ -340,13 +390,17 @@ def eager_collective(fn, tensor, group=None, in_spec=None, out_spec=None,
     t0 = time.time()
     out = shard_fn(tensor)
     jax.block_until_ready(out)
-    dt = time.time() - t0
-    if comms_logger.enabled:
+    t1 = time.time()
+    tr = current_tracer()
+    if comms_logger.enabled or tr.enabled:
+        from deepspeed_tpu.comm.telemetry import record_eager
         n = get_world_size(group)
         # per-member message size (what each shard contributes), matching the
         # per-rank tensors torch passes — calc_bw_log scales by n itself
         size = tensor.size * tensor.dtype.itemsize // max(n, 1)
-        comms_logger.append(op_name, op_name, dt, size, n=n)
+        # the ONE recording funnel: legacy accumulator + tracer span
+        record_eager(tr, comms_logger, op_name, size, tensor.dtype,
+                     axes, n, t0, t1)
     return out
 
 
@@ -360,11 +414,30 @@ def barrier_eager():
                                   mesh=mesh, in_specs=P(), out_specs=P(),
                                   check_vma=False))
         _EAGER_CACHE[key] = f
+    t0 = time.time()
     jax.block_until_ready(f(one))
+    tr = current_tracer()
+    if tr.enabled:
+        from deepspeed_tpu.comm.telemetry import record_eager
+        record_eager(tr, None, "barrier", 4, jnp.int32,
+                     tuple(mesh.axis_names), mesh.size, t0, time.time())
 
 
-def log_summary(show_straggler=False, print_log=True):
-    return comms_logger.log_all(print_log=print_log, show_straggler=show_straggler)
+def log_summary(show_straggler=False, print_log=True, step=None):
+    """The comms logger's periodic report.  With a monitor attached
+    (:func:`attach_monitor`) and enabled, the per-op aggregates ride
+    the monitor event stream (``comm/<op>/{calls,bytes,busbw_gbps}``,
+    the ThroughputTimer pattern) and the table is only *returned*;
+    without one the legacy print is preserved byte-for-byte."""
+    monitor = _attached_monitor()
+    out = comms_logger.log_all(print_log=print_log and monitor is None,
+                               show_straggler=show_straggler)
+    if monitor is not None:
+        step = 1 if step is None else max(int(step), 1)
+        monitor.write_events(
+            [(tag, val, step)
+             for tag, val in comms_logger.aggregate_events()])
+    return out
 
 
 def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None,

@@ -1,0 +1,180 @@
+"""Shared communication-telemetry vocabulary: ONE recording funnel and
+ONE row schema for every surface that talks about collectives.
+
+Three producers feed it:
+
+* **comm.py's collectives** — every traced op (``all_reduce`` …
+  ``barrier``) records a trace-time instant through
+  :func:`record_traced`; the eager helpers record timed spans through
+  :func:`record_eager`.  Both read the dynamically-scoped tracer
+  (``tracing.current_tracer()``) so layers never grow a tracer
+  parameter — and both are zero-cost-when-off: one contextvar read and
+  one attribute check against the shared ``NULL_TRACER``.
+* **the legacy comms logger** — ``comm.log_summary``'s accumulator
+  (``utils/comms_logging.CommsLogger``) is fed exclusively through
+  :func:`record_eager` now, not a private ``append`` call site, so the
+  printed table, the tracer spans and the exported rows always agree.
+* **the benches** — ``benchmarks/communication/run_all.py`` and
+  ``ring_bench.py`` emit :func:`bench_row` dicts, so offline bandwidth
+  sweeps and runtime telemetry share one vocabulary (``op`` / ``bytes``
+  / ``algbw_gbps`` / ``busbw_gbps``), comparable side by side.
+
+The static counterpart — bytes counted from compiled HLO rather than
+recorded at runtime — lives in ``profiling/comm_ledger.py`` and uses
+the same :func:`wire_bytes` formulas, documented in
+``docs/observability.md``.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from deepspeed_tpu.utils.comms_logging import calc_bw_log
+
+#: schema tag stamped on every comm-ledger JSON artifact (benches, the
+#: per-signature serving ledger, CI uploads)
+COMM_LEDGER_SCHEMA = "comm-ledger/v1"
+
+
+def wire_bytes(op, bytes_in, bytes_out, n):
+    """Per-device bytes on the wire for one collective — the busbw
+    numerator of the standard ring algorithms (NCCL-tests convention,
+    the same factors ``calc_bw_log`` uses):
+
+    ==================  =============================================
+    op                  wire bytes per device
+    ==================  =============================================
+    all_reduce          ``2 * (n-1)/n * bytes_in``
+    all_gather          ``(n-1)/n * bytes_out``  (operand is the shard)
+    reduce_scatter      ``(n-1)/n * bytes_in``   (operand is the full
+                        pre-scatter buffer)
+    all_to_all          ``(n-1)/n * bytes_in``
+    permute/broadcast   ``bytes_in`` (one hop)
+    ==================  =============================================
+    """
+    n = max(int(n), 1)
+    if n == 1:
+        return 0
+    op = op.replace("-", "_")
+    if op in ("all_reduce", "psum", "all_reduce_start"):
+        return int(2 * (n - 1) / n * bytes_in)
+    if op in ("all_gather", "all_gather_into_tensor", "all_gather_start"):
+        return int((n - 1) / n * bytes_out)
+    if op in ("reduce_scatter", "reduce_scatter_tensor", "all_to_all",
+              "all_to_all_single"):
+        return int((n - 1) / n * bytes_in)
+    return int(bytes_in)
+
+
+def bench_row(op, payload_bytes, seconds, n, axis=None, extra=None):
+    """One canonical comm-ledger result row.  ``payload_bytes`` is the
+    PER-MEMBER message size (the size each rank contributes — what
+    ``calc_bw_log`` expects; it applies the op's own scaling itself).
+    Benches and ``CommsLogger.ledger_rows`` both emit exactly this
+    shape, so ``perf_floor``-style tooling and dashboards parse one
+    schema."""
+    _, algbw, busbw = calc_bw_log(op, int(payload_bytes), seconds,
+                                  n=max(int(n), 1))
+    row = {"op": op, "bytes": int(payload_bytes) * max(int(n), 1)
+           if op in ("all_gather", "all_gather_into_tensor",
+                     "reduce_scatter", "reduce_scatter_tensor")
+           else int(payload_bytes),
+           "latency_ms": round(seconds * 1e3, 4),
+           "algbw_gbps": round(algbw, 3),
+           "busbw_gbps": round(busbw, 3),
+           "n": max(int(n), 1)}
+    if axis is not None:
+        row["axis"] = axis if isinstance(axis, str) else "+".join(axis)
+    if extra:
+        row.update(extra)
+    return row
+
+
+def write_ledger_json(path, payload):
+    """Write a comm-ledger JSON artifact, preserving whatever was
+    committed at ``path`` before under ``previous_committed`` (one
+    level deep — re-running a bench keeps the last committed round, not
+    an unbounded history).  Stamps :data:`COMM_LEDGER_SCHEMA`."""
+    payload = dict(payload, schema=COMM_LEDGER_SCHEMA)
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = None
+        if old is not None:
+            old.pop("previous_committed", None) if isinstance(old, dict) \
+                else None
+            payload["previous_committed"] = old
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+# ------------------------------------------------------ recording funnel
+
+def _nbytes(x):
+    """Payload bytes of a (possibly abstract) array — works on jax
+    tracers at trace time: shape and dtype are static."""
+    try:
+        return int(np.prod(np.shape(x))) * np.dtype(x.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _group_size(axes):
+    """Static size of the group axes at trace time; None outside an
+    axis context (a collective traced without shard_map would fail in
+    lax anyway — telemetry must never be the thing that raises)."""
+    from jax import lax
+    try:
+        n = 1
+        for a in axes:
+            n *= int(lax.axis_size(a))
+        return n
+    except Exception:
+        return None
+
+
+def record_traced(tracer, op, x, axes, op_suffix=None):
+    """Record one traced collective (called from ``comm.py`` at TRACE
+    time — once per compiled signature, never per executed step).  The
+    instant carries the op, per-device payload bytes, dtype, the mesh
+    axes it rides, group size and the wire-byte estimate; the executed
+    per-step truth is the static HLO ledger's job
+    (``profiling/comm_ledger.py``)."""
+    nbytes = _nbytes(x)
+    n = _group_size(axes)
+    name = op if op_suffix is None else f"{op}:{op_suffix}"
+    tracer.instant(
+        f"comm.{name}", cat="comm", track="comm",
+        args={"op": op, "bytes": nbytes,
+              "dtype": str(np.dtype(getattr(x, "dtype", np.float32))),
+              "axes": "+".join(str(a) for a in axes),
+              "n": n,
+              "wire_bytes": None if n is None
+              else wire_bytes(op, nbytes, nbytes * n, n),
+              "traced": True})
+
+
+def record_eager(tracer, comms_logger, op, per_member_bytes, dtype, axes,
+                 n, t0, t1):
+    """Record one timed eager collective: a complete span (with
+    algbw/busbw computed from the measured wall time) AND the legacy
+    comms-logger accumulator — the ONE funnel both surfaces share, so
+    ``log_summary``'s table and the trace always describe the same
+    events."""
+    dt = max(t1 - t0, 1e-9)
+    if comms_logger is not None and comms_logger.enabled:
+        comms_logger.append(op, op, dt, per_member_bytes, n=n)
+    if tracer is not None and tracer.enabled:
+        _, algbw, busbw = calc_bw_log(op, per_member_bytes, dt, n=n)
+        tracer.complete(
+            f"comm.{op}", t0, t1, cat="comm", track="comm",
+            args={"op": op, "bytes": per_member_bytes,
+                  "dtype": str(dtype),
+                  "axes": "+".join(str(a) for a in axes), "n": n,
+                  "algbw_gbps": round(algbw, 3),
+                  "busbw_gbps": round(busbw, 3)})

@@ -32,10 +32,20 @@ from deepspeed_tpu.parallel.topology import make_mesh
 from deepspeed_tpu.serving.sharding import (ServingShardingConfig,
                                             config_scope,
                                             pool_bytes_per_device)
+from deepspeed_tpu.tracing import jit_cache_size
 from deepspeed_tpu.utils.logging import log_dist
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
           "float16": jnp.float16}
+
+
+def _sampling_label(do_sample, temperature, top_k, top_p):
+    """Comm-ledger signature suffix for the sampling statics: greedy
+    is the bare label, a sampled combo is its OWN compiled executable
+    (the statics are jit static args) and must ledger separately."""
+    if not do_sample or not temperature:
+        return ""
+    return f"[sampled T={temperature:g},k={int(top_k)},p={top_p:g}]"
 
 
 def _sample_tokens(logits, rng, do_sample, temperature, top_k, top_p):
@@ -111,6 +121,13 @@ class InferenceEngine:
         self._decode_fn = None
         self._prefill_fn = None
         self._fwd = None
+        # comm/compile observability (PR 12): both default OFF — the
+        # zero-cost path is one attribute load + a None check per
+        # dispatch, and neither can ever change tokens or compile
+        # counts (pinned by tests/unit/test_comm_telemetry.py)
+        self._compile_watchdog = None     # tracing.CompileWatchdog
+        self._comm_capture = None         # (name,label) -> arg specs
+        self._comm_ledger_cache = {}
 
         # "kernel injection": route attention to the Pallas path via a fresh
         # config (never mutate the caller's model — it may be live in a
@@ -784,15 +801,144 @@ class InferenceEngine:
 
             self._copy_page_fn = jax.jit(copy, donate_argnums=(0,),
                                          out_shardings=pool_sh)
+        args = (pools, jnp.int32(src_page), jnp.int32(dst_page))
+        if self._comm_capture is not None:
+            self._capture_comm_sig("copy_page", "copy_page",
+                                   "_copy_page_fn", args)
         with dist.mesh_scope(self.mesh):
-            return self._copy_page_fn(pools, jnp.int32(src_page),
-                                      jnp.int32(dst_page))
+            return self._dispatch("copy_page", self._copy_page_fn, *args)
 
     def serving_page_copy_compile_count(self):
         """Compiled signatures behind copy_page (stays <= 1 per serving
-        config: cache hits/misses must never grow the compile set)."""
-        fn = getattr(self, "_copy_page_fn", None)
-        return 0 if fn is None else fn._cache_size()
+        config: cache hits/misses must never grow the compile set).
+        Reads ``tracing.jit_cache_size`` — the ONE compile-count
+        definition shared with the train engine, the goodput ledger and
+        the recompile watchdog."""
+        return jit_cache_size(getattr(self, "_copy_page_fn", None))
+
+    # -------------------------------------- comm/compile observability
+    def set_compile_watchdog(self, watchdog):
+        """Install a :class:`tracing.CompileWatchdog` (None removes
+        it): every serving dispatch whose jit signature cache grows
+        records a ``compile`` span, and steady-state growth fires the
+        watchdog's recompile detection.  Pure host bookkeeping around
+        the dispatch — it never changes what compiles."""
+        self._compile_watchdog = watchdog
+
+    def _dispatch(self, name, fn, *args, detail=None):
+        """Run one serving-primitive dispatch, feeding the compile
+        watchdog when the callable's signature cache grew across the
+        call (jit compiles synchronously at dispatch, so this call's
+        wall time IS compile + dispatch)."""
+        wd = self._compile_watchdog
+        if wd is None:
+            return fn(*args)
+        n0 = jit_cache_size(fn)
+        t0 = time.monotonic()
+        out = fn(*args)
+        n1 = jit_cache_size(fn)
+        if n1 > n0:
+            wd.on_compile(name, n1 - n0, t0, time.monotonic(),
+                          detail=detail)
+        return out
+
+    def enable_comm_telemetry(self, enabled=True):
+        """Arm (or disarm) HLO comm-ledger capture: each serving
+        primitive records the arg specs (shapes/dtypes/shardings +
+        statics) of every distinct signature it dispatches, so
+        :meth:`comm_ledger` can later re-lower and statically count the
+        collective bytes of exactly the executables serving runs.  The
+        capture itself is a dict lookup per dispatch; the analysis
+        compile happens only inside :meth:`comm_ledger`."""
+        if enabled:
+            # re-arming keeps both the capture and the analyzed-ledger
+            # cache: signatures are (name, label)-keyed and stable, so
+            # a fleet of schedulers sharing one engine (each __init__
+            # re-arms) must not force a re-compile sweep per replica
+            if self._comm_capture is None:
+                self._comm_capture = {}
+        else:
+            self._comm_capture = None
+            self._comm_ledger_cache = {}
+
+    def _capture_comm_sig(self, name, label, fn_attr, args, statics=()):
+        cap = self._comm_capture
+        if cap is None:
+            return
+        # geometry rides the ARRAY arg shapes (slots/pages/chunk): two
+        # schedulers sharing one engine with different geometry are
+        # distinct executables and must ledger separately even under
+        # the same display label
+        geom = tuple(np.shape(a) for a in args
+                     if isinstance(a, (np.ndarray, jax.Array)))
+        if (name, label, geom) in cap:
+            return
+        # ShapeDtypeStructs with committed shardings: enough for
+        # .lower() to reproduce the exact partitioned executable
+        # without holding (donated!) buffers alive.  An UNCOMMITTED
+        # single-device array (the rng key from jax.random.split) is
+        # normalized to replicated-on-mesh — that is what jit does
+        # with it at real dispatch, and a literal single-device spec
+        # would make the analysis lowering reject the mesh-sharded
+        # co-arguments
+        mesh_devs = frozenset(
+            d.id for d in np.asarray(self.mesh.devices).flat)
+
+        def spec(x):
+            sh = getattr(x, "sharding", None)
+            if sh is not None:
+                try:
+                    if frozenset(d.id for d in sh.device_set) != \
+                            mesh_devs:
+                        sh = NamedSharding(self.mesh, P())
+                except Exception:
+                    sh = None
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                        sharding=sh)
+
+        cap[(name, label, geom)] = (fn_attr, jax.tree.map(spec, args),
+                                    statics)
+
+    def comm_ledger(self, refresh=False):
+        """Static HLO comm ledger per captured serving signature
+        (``profiling/comm_ledger.py``): ``{label: ledger}`` where the
+        label carries the primitive and its statics (e.g.
+        ``decode_multi[h=8]``).  First call per signature pays one
+        analysis re-compile (lower -> compile -> parse); results are
+        cached until ``refresh=True`` or :meth:`enable_comm_telemetry`
+        is toggled.  Empty dict when capture is off or nothing
+        dispatched yet."""
+        if self._comm_capture is None:
+            return {}
+        from deepspeed_tpu.profiling import comm_ledger as _cl
+        out = {}
+        for key, (fn_attr, specs, statics) in \
+                list(self._comm_capture.items()):
+            name, label = key[0], key[1]
+            # two geometries under one display label (engine shared by
+            # differently-sized schedulers) stay distinct entries
+            disp = label
+            n = 2
+            while disp in out:
+                disp = f"{label}@{n}"
+                n += 1
+            cached = self._comm_ledger_cache.get(key)
+            if cached is not None and not refresh:
+                out[disp] = cached
+                continue
+            fn = getattr(self, fn_attr, None)
+            if fn is None:
+                # the serving fns were rebuilt (slot-family resharding)
+                self._build_serving_fns()
+                fn = getattr(self, fn_attr, None)
+                if fn is None:
+                    continue
+            with self._serving_scope():
+                led = _cl.ledger_for(fn, *specs, *statics,
+                                     mesh=self.mesh)
+            self._comm_ledger_cache[key] = led
+            out[disp] = led
+        return out
 
     def prefill_into_slots(self, ids_chunk, slot, n_valid, page_table,
                            lengths, pools):
@@ -817,10 +963,15 @@ class InferenceEngine:
                 (ids_chunk, np.int32, rep), (slot, np.int32, rep),
                 (n_valid, np.int32, rep), (page_table, np.int32, blk),
                 (lengths, np.int32, slot_sh)])
-        with self._serving_scope():
-            return self._paged_prefill_fn(
-                self.params, ids_chunk, slot, n_valid, page_table,
+        args = (self.params, ids_chunk, slot, n_valid, page_table,
                 lengths, pools)
+        if self._comm_capture is not None:   # label cost only when armed
+            self._capture_comm_sig(
+                "prefill", f"prefill[chunk={np.shape(ids_chunk)[1]}]",
+                "_paged_prefill_fn", args)
+        with self._serving_scope():
+            return self._dispatch("prefill", self._paged_prefill_fn,
+                                  *args)
 
     def decode_step(self, toks, active, page_table, lengths, pools,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
@@ -837,11 +988,17 @@ class InferenceEngine:
             (toks, np.int32, shd.slot), (active, bool, shd.slot),
             (page_table, np.int32, shd.block),
             (lengths, np.int32, shd.slot)])
+        args = (self.params, toks, active, page_table, lengths, pools,
+                rng)
+        statics = (bool(do_sample), float(temperature), int(top_k),
+                   float(top_p))
+        if self._comm_capture is not None:
+            self._capture_comm_sig(
+                "decode", "decode" + _sampling_label(*statics),
+                "_paged_decode_fn", args, statics)
         with self._serving_scope():
-            return self._paged_decode_fn(
-                self.params, toks, active, page_table, lengths, pools,
-                rng, bool(do_sample), float(temperature), int(top_k),
-                float(top_p))
+            return self._dispatch("decode", self._paged_decode_fn,
+                                  *args, *statics)
 
     def _stage_host_inputs(self, triples):
         """Move the per-dispatch host arrays to their committed serving
@@ -893,12 +1050,22 @@ class InferenceEngine:
                 (page_table, np.int32, blk), (lengths, np.int32, slot),
                 (emitted, np.int32, slot), (budgets, np.int32, slot),
                 (eos_ids, np.int32, slot)])
+        args = (self.params, toks, active, page_table, lengths, pools,
+                emitted, budgets, eos_ids, rng)
+        statics = (int(horizon), bool(do_sample), float(temperature),
+                   int(top_k), float(top_p))
+        if self._comm_capture is not None:
+            self._capture_comm_sig(
+                "decode_multi",
+                f"decode_multi[h={int(horizon)}]"
+                + _sampling_label(*statics[1:]),
+                "_paged_decode_multi_fn", args, statics)
         with self._serving_scope():
-            return self._paged_decode_multi_fn(
-                self.params, toks, active, page_table, lengths,
-                pools, emitted, budgets, eos_ids, rng, int(horizon),
-                bool(do_sample), float(temperature), int(top_k),
-                float(top_p))
+            return self._dispatch(
+                "decode_multi", self._paged_decode_multi_fn,
+                *args, *statics,
+                detail=None if self._compile_watchdog is None
+                else {"horizon": int(horizon)})
 
     def verify_multi(self, toks, drafts, active, page_table, lengths,
                      pools, *, widths, budgets, eos_ids, emitted=None):
@@ -936,17 +1103,23 @@ class InferenceEngine:
              (page_table, np.int32, blk), (lengths, np.int32, slot),
              (emitted, np.int32, slot), (budgets, np.int32, slot),
              (eos_ids, np.int32, slot)])
-        with self._serving_scope():
-            return self._paged_verify_fn(
-                self.params, toks, drafts, widths, active, page_table,
+        args = (self.params, toks, drafts, widths, active, page_table,
                 lengths, pools, emitted, budgets, eos_ids)
+        k = int(np.shape(drafts)[1])
+        if self._comm_capture is not None:
+            self._capture_comm_sig("verify", f"verify[k={k}]",
+                                   "_paged_verify_fn", args)
+        with self._serving_scope():
+            return self._dispatch("verify", self._paged_verify_fn,
+                                  *args,
+                                  detail=None if self._compile_watchdog
+                                  is None else {"k": k})
 
     def serving_verify_compile_count(self):
         """Compiled signatures behind verify_multi — bounded by the
         scheduler's spec-K bucket set (one per draft width K), never by
         request churn or acceptance outcomes."""
-        fn = getattr(self, "_paged_verify_fn", None)
-        return 0 if fn is None else fn._cache_size()
+        return jit_cache_size(getattr(self, "_paged_verify_fn", None))
 
     def sample_from_logits(self, logits, do_sample=False, temperature=1.0,
                            top_k=0, top_p=1.0):
@@ -979,15 +1152,14 @@ class InferenceEngine:
     def serving_decode_compile_count(self):
         """Number of compiled signatures behind decode_step (the
         no-per-step-recompilation guarantee: stays 1 across churn)."""
-        fn = getattr(self, "_paged_decode_fn", None)
-        return 0 if fn is None else fn._cache_size()
+        return jit_cache_size(getattr(self, "_paged_decode_fn", None))
 
     def serving_decode_multi_compile_count(self):
         """Compiled signatures behind decode_multi — bounded by the
         scheduler's horizon bucket set (one per distinct horizon, per
         sampling combo), never by request churn."""
-        fn = getattr(self, "_paged_decode_multi_fn", None)
-        return 0 if fn is None else fn._cache_size()
+        return jit_cache_size(getattr(self, "_paged_decode_multi_fn",
+                                      None))
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,

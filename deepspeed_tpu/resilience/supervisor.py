@@ -256,7 +256,7 @@ class ResilientTrainer:
                  tracer=None, trace_dir=None, flight_recorder=None,
                  stall_timeout_s=None, straggler_factor=3.0,
                  gauge_interval=8, mfu_gauge=True,
-                 peak_flops_per_device=None):
+                 peak_flops_per_device=None, compile_watchdog=None):
         if nan_policy not in ("restore", "skip", "halt"):
             raise ValueError(f"unknown nan_policy {nan_policy!r}")
         self.engine = engine
@@ -314,6 +314,25 @@ class ResilientTrainer:
             engine.set_tracer(self.tracer)
 
         # ------------------------------------------ watchdogs and gauges
+        # recompile watchdog (tracing.CompileWatchdog, shared with the
+        # serving tier): train-step compile deltas — the same
+        # train_compile_count() probe the goodput ledger's
+        # compile_warmup category keys on — become `compile` spans, and
+        # steady-state signature churn fires a tracer instant + flight
+        # dump.  Pass an instance or True (defaults); None keeps the
+        # pre-PR-12 behavior exactly.
+        from deepspeed_tpu.tracing import CompileWatchdog
+        if isinstance(compile_watchdog, CompileWatchdog):
+            self.compile_watchdog = compile_watchdog.bind(
+                tracer=self.tracer if compile_watchdog.tracer
+                is NULL_TRACER else None,
+                flight_recorder=self.flight_recorder)
+        elif compile_watchdog:
+            self.compile_watchdog = CompileWatchdog(
+                tracer=self.tracer,
+                flight_recorder=self.flight_recorder)
+        else:
+            self.compile_watchdog = None
         self.stall_timeout_s = stall_timeout_s
         self.straggler_factor = float(straggler_factor)
         self.gauge_interval = int(gauge_interval)
@@ -730,11 +749,18 @@ class ResilientTrainer:
         post_cc = self._compile_count()
         if pre_cc is not None and post_cc is not None and post_cc > pre_cc:
             category = "compile_warmup"
+            if self.compile_watchdog is not None:
+                self.compile_watchdog.on_compile(
+                    "train_step", post_cc - pre_cc, t0, t1,
+                    detail={"step": fstep})
         elif fstep < self._max_step_reached:
             category = "recompute"
         else:
             category = "productive"
         self.ledger.add(category, dt)
+        if self.compile_watchdog is not None and \
+                category != "compile_warmup":
+            self.compile_watchdog.step()   # auto-steady quiet ticker
         self.tracer.complete("train_step", t0, t1, cat="train",
                              track="steps",
                              args={"step": fstep, "category": category,

@@ -56,7 +56,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, has_overflow
                                                     update_scale)
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule
 from deepspeed_tpu.runtime.optimizers import build_optimizer
-from deepspeed_tpu.tracing import NULL_TRACER
+from deepspeed_tpu.tracing import NULL_TRACER, jit_cache_size
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,
                                        FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
@@ -334,6 +334,13 @@ class DeepSpeedEngine:
         self.tracer = NULL_TRACER
 
         dist.configure(self._config)
+        # comm.log_summary's periodic report rides the same monitor
+        # stream as ThroughputTimer when the engine's sinks are
+        # enabled (comm/<op>/* gauges); without one the legacy print
+        # is preserved byte-for-byte.  Last engine wins (weakly held —
+        # a discarded engine's monitor detaches with it)
+        dist.attach_monitor(self.monitor if self.monitor.enabled
+                            else None)
 
         self.training_dataloader = self.deepspeed_io(training_data, collate_fn) \
             if training_data is not None else None
@@ -1362,6 +1369,62 @@ class DeepSpeedEngine:
         self._flops_profile_cache = out   # shapes are fixed per engine
         return out
 
+    def comm_profile(self, batch=None):
+        """Static HLO communication ledger of one optimizer step — the
+        comm twin of :meth:`flops_profile`, reading the same compiled
+        executables through the same lower->compile seam
+        (``profiling/comm_ledger.py``): collective counts and
+        per-device bytes per mesh axis, ICI vs DCN tier split, loop
+        trip counts accounted.  gas>1 sums the micro dispatches exactly
+        like the flops accounting.  Analysis-only (one extra compile
+        per executable, cached per engine); it can never change tokens,
+        losses or compile counts — pinned by
+        ``tests/unit/test_comm_telemetry.py``."""
+        from deepspeed_tpu.profiling import comm_ledger as _cl
+        if batch is None:
+            batch = getattr(self, "_last_batch", None)
+        if batch is None:
+            batch = self._example_batch
+        assert batch is not None, "comm_profile needs a batch before init"
+        cached = getattr(self, "_comm_profile_cache", None)
+        if cached is not None:
+            return cached
+        self._ensure_initialized(batch)
+        dev_batch = self._put_batch(batch)
+        rng = jax.random.PRNGKey(0)
+        lr = float(self.get_lr()[0])
+        state = self._live_state()
+        rest = state.replace(params=None, opt_state=None)
+        mesh = self.mesh
+        if self._offload is not None:
+            micro = _cl.ledger_for(
+                self._micro_offload,
+                self._materialize_params(state.params),
+                jnp.float32(1.0), dev_batch, rng, mesh=mesh)
+            out = _cl.scale_ledger(micro, self.gas)
+        elif self.gas == 1:
+            out = _cl.ledger_for(self._step_gas1, state.params,
+                                 state.opt_state, rest, dev_batch, rng,
+                                 lr, mesh=mesh)
+        else:
+            first = _cl.ledger_for(self._micro_first, state.params,
+                                   state.scaler.loss_scale, dev_batch,
+                                   rng, mesh=mesh)
+            grads_sds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                state.params)
+            last = _cl.ledger_for(self._step_last, state.params,
+                                  state.opt_state, rest, grads_sds,
+                                  dev_batch, rng, lr, mesh=mesh)
+            nxt = _cl.ledger_for(self._micro_next, state.params,
+                                 state.scaler.loss_scale, grads_sds,
+                                 dev_batch, rng, mesh=mesh)
+            out = _cl.merge_ledgers(
+                [first, _cl.scale_ledger(nxt, max(self.gas - 2, 0)),
+                 last])
+        self._comm_profile_cache = out
+        return out
+
     def set_tracer(self, tracer):
         """Install a host-side span tracer (None restores the shared
         no-op singleton).  Tracing is host bookkeeping only — it can
@@ -1379,13 +1442,15 @@ class DeepSpeedEngine:
 
     def train_compile_counts(self):
         """Compiled-signature counts per jitted train callable (only
-        the ones this configuration has built)."""
+        the ones this configuration has built).  Counts come from
+        ``tracing.jit_cache_size`` — the ONE compile-count definition
+        the serving engine, the goodput ledger's ``compile_warmup``
+        detector and the recompile watchdog all share."""
         out = {}
         for name in self._TRAIN_JIT_FNS:
             fn = getattr(self, name, None)
-            cache_size = getattr(fn, "_cache_size", None)
-            if cache_size is not None:
-                out[name.lstrip("_")] = cache_size()
+            if fn is not None and hasattr(fn, "_cache_size"):
+                out[name.lstrip("_")] = jit_cache_size(fn)
         return out
 
     def train_compile_count(self):

@@ -85,6 +85,18 @@ class LocalReplica:
         if self.sched is not None and self.sched.mem.enabled:
             self.sched.mem.flight = flight
 
+    def attach_comm_flight(self, flight):
+        """Router wiring, the compile twin of :meth:`attach_mem_flight`:
+        a scheduler running the recompile watchdog dumps steady-state
+        signature churn into the FLEET recorder.  The watchdog is
+        engine-lifetime (schedulers reuse it), so the wiring survives
+        die/restart; re-wired anyway for custom per-scheduler
+        instances."""
+        self._comm_flight = flight
+        wd = None if self.sched is None else self.sched.compile_watchdog
+        if wd is not None:
+            wd.flight_recorder = flight
+
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
@@ -250,6 +262,10 @@ class LocalReplica:
         if getattr(self, "_mem_flight", None) is not None and \
                 self.sched.mem.enabled:
             self.sched.mem.flight = self._mem_flight
+        if getattr(self, "_comm_flight", None) is not None and \
+                self.sched.compile_watchdog is not None:
+            self.sched.compile_watchdog.flight_recorder = \
+                self._comm_flight
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
@@ -293,7 +309,7 @@ class ProcessReplica:
                  num_pages=32, page_size=16, max_pages_per_slot=8,
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
                  hb_timeout_s=60.0, env=None, trace=False,
-                 mem_telemetry=False):
+                 mem_telemetry=False, comm_telemetry=False):
         self.id = replica_id
         self.state = UP
         self.death_reason = None
@@ -307,7 +323,8 @@ class ProcessReplica:
                          max_pages_per_slot=max_pages_per_slot,
                          prefill_chunk=prefill_chunk,
                          prefix_cache=prefix_cache, trace=bool(trace),
-                         mem_telemetry=bool(mem_telemetry))
+                         mem_telemetry=bool(mem_telemetry),
+                         comm_telemetry=bool(comm_telemetry))
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
@@ -347,6 +364,8 @@ class ProcessReplica:
             cmd.append("--prefix-cache")
         if cfg["mem_telemetry"]:
             cmd.append("--mem-telemetry")
+        if cfg.get("comm_telemetry"):
+            cmd.append("--comm-telemetry")
         if cfg["trace"]:
             cmd += ["--trace", "--trace-label", str(self.id)]
         try:

@@ -138,6 +138,10 @@ class ClusterRouter:
                     # sustained-pressure episodes into the FLEET
                     # recorder (journal-correlatable rids ride along)
                     rep.attach_mem_flight(self.flight)
+                if hasattr(rep, "attach_comm_flight"):
+                    # and the recompile watchdog's steady-state churn
+                    # dumps land in the same fleet recorder
+                    rep.attach_comm_flight(self.flight)
         for rep in self.replicas:
             if rep.role == "prefill" and hasattr(rep, "set_handoff_sink"):
                 rep.set_handoff_sink(self._make_handoff_sink(rep))
@@ -685,6 +689,23 @@ class ClusterRouter:
                                       **ent))
         return {"ok": all(r["ok"] for r in reports), "reports": reports}
 
+    # ------------------------------------------------------- comm ledger
+    def comm_ledger(self, refresh=False):
+        """Fleet comm-ledger pass: run every live local replica's
+        ``ServingScheduler.comm_ledger()`` (populating its ``comm_*``
+        health fields and gauges) and return ``{replica_id: {label:
+        ledger}}`` — the per-signature JSON artifact CI uploads.
+        Process replicas contribute through their heartbeat health
+        instead (their worker computes the ledger in-process)."""
+        out = {}
+        for rep in self.replicas:
+            sched = getattr(rep, "sched", None)
+            if sched is None or not getattr(sched, "comm_telemetry",
+                                            False):
+                continue
+            out[rep.id] = sched.comm_ledger(refresh=refresh)
+        return out
+
     # ------------------------------------------------------------ health
     def health(self):
         """Fleet snapshot: per-replica state + aggregate counters the
@@ -702,11 +723,40 @@ class ClusterRouter:
         # heartbeat figure (they never share a pool cross-process).
         # Pressure counters are per-scheduler detections and sum as-is.
         mem_free = mem_episodes = mem_events = 0
+        comm_bytes = steady_recompiles = 0
+        comm_known = False
         seen_pools = set()
+        seen_watchdogs = set()
         for rep in self.replicas:
             lh = rep.last_health or {}
             mem_episodes += lh.get("mem_pressure_episodes") or 0
             mem_events += lh.get("mem_pressure_events") or 0
+            # comm/compile aggregation: local replicas read live, dead/
+            # process replicas contribute their last heartbeat figure
+            # (the per-scheduler ledger is static analysis — it does
+            # not go stale the way load figures do)
+            sched_live = getattr(rep, "sched", None) \
+                if rep.state != DEAD else None
+            ch = sched_live.comm_health_fields() if sched_live is not None \
+                and hasattr(sched_live, "comm_health_fields") else lh
+            if ch.get("comm_bytes_per_step") is not None:
+                comm_known = True
+                comm_bytes += ch["comm_bytes_per_step"]
+            # local replicas share the ENGINE-lifetime watchdog, so
+            # recompile counts are deduped by watchdog identity (like
+            # free pages by pool); process replicas are separate
+            # processes and sum as-is
+            wd = None if sched_live is None else \
+                getattr(sched_live, "compile_watchdog", None)
+            if wd is not None:
+                if id(wd) not in seen_watchdogs:
+                    seen_watchdogs.add(id(wd))
+                    steady_recompiles += wd.steady_recompiles
+            elif getattr(rep, "sched", None) is None:
+                # true process replicas only: a DEAD local replica's
+                # heartbeat snapshots the shared engine watchdog a
+                # live sibling already contributed through
+                steady_recompiles += ch.get("steady_recompiles") or 0
             if rep.state == DEAD:
                 continue   # stale heartbeat, no live pool to report
             sched = getattr(rep, "sched", None)
@@ -745,6 +795,9 @@ class ClusterRouter:
             "aggregate_mem_free_pages": mem_free,
             "aggregate_mem_pressure_events": mem_events,
             "aggregate_mem_pressure_episodes": mem_episodes,
+            "aggregate_comm_bytes_per_step":
+                comm_bytes if comm_known else None,
+            "aggregate_steady_recompiles": steady_recompiles,
             **self.metrics.summary(),
         }
 

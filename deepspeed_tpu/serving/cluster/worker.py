@@ -78,6 +78,11 @@ def main(argv=None):
                    help="page-state attribution + per-request "
                         "page-seconds + pressure forensics; the mem_* "
                         "health fields ride the heartbeat to the router")
+    p.add_argument("--comm-telemetry", action="store_true",
+                   help="HLO comm-ledger capture + recompile watchdog; "
+                        "the comm_* health fields ride the heartbeat "
+                        "to the router (the in-process ledger analysis "
+                        "runs once, after warmup)")
     p.add_argument("--trace", action="store_true",
                    help="record serving spans and flush them over the "
                         "protocol with each heartbeat")
@@ -106,7 +111,8 @@ def main(argv=None):
         page_size=args.page_size,
         max_pages_per_slot=args.max_pages_per_slot,
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
-        mem_telemetry=args.mem_telemetry)
+        mem_telemetry=args.mem_telemetry,
+        comm_telemetry=args.comm_telemetry)
 
     tracer = {"t": None}
 
@@ -214,6 +220,13 @@ def main(argv=None):
             report(live.pop(rid))
         now = time.monotonic()
         if now - last_hb >= args.hb_interval_s:
+            if sched.comm_telemetry and sched._comm_summary is None \
+                    and sched.step_idx >= 2 and not sched.requests:
+                # one-time static analysis (an XLA re-compile per
+                # signature), gated on an IDLE heartbeat so no live
+                # request's latency pays it; the comm_* fields ride
+                # every subsequent heartbeat to the router
+                sched.comm_ledger()
             flush_spans()
             _emit({"ev": "hb", "health": sched.health()})
             last_hb = now

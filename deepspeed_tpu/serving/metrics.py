@@ -241,6 +241,42 @@ class ServingMetrics:
         self.mem_pressure_episodes += 1
         self._write([("serving/mem/pressure_episode", 1, step)])
 
+    # the serving/comm/axis/* gauge set is closed over MeshConfig's
+    # known axes (like serving/mesh/*): scalar sinks get one gauge per
+    # axis, joint-axis groups ("data+model") ride health()'s JSON dict
+    _COMM_AXES = ("data", "model", "pipe", "expert", "sequence")
+
+    def record_comm(self, step, summary):
+        """The HLO comm-ledger summary of the steady-state decode
+        dispatch (``ServingScheduler.comm_ledger``): per-device wire
+        bytes per step/token, collective count, the per-mesh-axis split
+        and the ICI/DCN tier attribution — static-analysis gauges, so
+        they re-emit only when the ledger is (re)computed."""
+        events = [
+            ("serving/comm/bytes_per_step",
+             summary["bytes_per_step"], step),
+            ("serving/comm/bytes_per_token",
+             summary["bytes_per_token"], step),
+            ("serving/comm/collectives_per_step",
+             summary["collectives_per_step"], step),
+            ("serving/comm/ici_bytes_per_step",
+             summary["ici_bytes"], step),
+            ("serving/comm/dcn_bytes_per_step",
+             summary["dcn_bytes"], step),
+        ]
+        for ax in self._COMM_AXES:
+            if ax in summary["per_axis"]:
+                events.append(
+                    (f"serving/comm/axis/{ax}",
+                     summary["per_axis"][ax], step))
+        self._write(events)
+
+    def record_recompile(self, step, cumulative):
+        """The recompile watchdog detected steady-state jit signature
+        churn (the compile-storm class); value = cumulative steady
+        recompiles."""
+        self._write([("serving/comm/recompile", cumulative, step)])
+
     def record_handoff(self, step, tokens):
         """One prefill->decode KV handoff: ``tokens`` prefilled
         positions changed owners without a byte of KV copied."""

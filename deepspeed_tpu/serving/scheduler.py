@@ -108,6 +108,7 @@ All latency accounting uses ``time.monotonic()``: an NTP clock step
 must never produce negative or wild TTFT/ITL samples.
 """
 
+import re
 import time
 from collections import deque
 
@@ -220,7 +221,8 @@ class ServingScheduler:
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
                  spec_decode=None, spec_k=8, spec_drafter=None,
                  shared_pool=None, pools_ref=None, on_handoff=None,
-                 tracer=None, mem_telemetry=False, audit_every=None):
+                 tracer=None, mem_telemetry=False, audit_every=None,
+                 comm_telemetry=False, compile_watchdog=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
@@ -312,6 +314,59 @@ class ServingScheduler:
         # runs fleet-side via ClusterRouter.audit().
         self.audit_every = None if not audit_every else int(audit_every)
         self._pool_shared = shared_pool is not None
+        # COMMS+COMPILE observability (the third telemetry axis after
+        # time [PR 8/9] and memory [PR 11]).  comm_telemetry=True arms
+        # (a) the engine's HLO comm-ledger capture — the static bytes-
+        # per-axis analysis comm_ledger() computes on demand — and (b)
+        # a recompile watchdog: every jit cache miss becomes a
+        # `compile` span, and signature churn after warmup fires a
+        # tracer instant + flight dump (compile-storm detection).  Off
+        # is a None check per dispatch; tokens and compile counts are
+        # byte-identical (pinned by tests/unit/test_comm_telemetry.py).
+        # Pass a tracing.CompileWatchdog instance for custom warmup /
+        # an attached FlightRecorder.
+        from deepspeed_tpu.tracing import CompileWatchdog
+        self.comm_telemetry = bool(comm_telemetry)
+        if isinstance(compile_watchdog, CompileWatchdog):
+            wd = compile_watchdog
+            if wd.tracer is NULL_TRACER:
+                wd.tracer = self.tracer
+            if wd.metrics is None:
+                wd.metrics = self.metrics
+        elif compile_watchdog or comm_telemetry:
+            # REUSE the engine's existing watchdog when one is armed:
+            # compile counters, steady state and the flight-recorder
+            # wiring are ENGINE-lifetime facts — a replica fleet (or a
+            # rolling restart) sharing one engine must not reset storm
+            # detection or orphan the counts with every fresh
+            # scheduler.  The tracer/metrics funnels rebind to the
+            # newest scheduler (last-wins, like the capture itself).
+            wd = getattr(engine, "_compile_watchdog", None)
+            if wd is None:
+                wd = CompileWatchdog(tracer=self.tracer,
+                                     metrics=self.metrics)
+            else:
+                wd.bind(tracer=self.tracer
+                        if self.tracer is not NULL_TRACER else None,
+                        metrics=self.metrics)
+        else:
+            wd = None
+        self.compile_watchdog = wd
+        # the watchdog/capture live on the (possibly shared) ENGINE:
+        # last scheduler wins, and a telemetry-OFF scheduler DISARMS
+        # stale state a dropped telemetry-on scheduler left behind —
+        # otherwise its dispatches would keep paying the probes and
+        # feeding a dead scheduler's watchdog (zero-cost-off contract)
+        if hasattr(engine, "set_compile_watchdog"):
+            if wd is not None or \
+                    getattr(engine, "_compile_watchdog", None) is not None:
+                engine.set_compile_watchdog(wd)
+        if hasattr(engine, "enable_comm_telemetry"):
+            if self.comm_telemetry:
+                engine.enable_comm_telemetry()
+            elif getattr(engine, "_comm_capture", None) is not None:
+                engine.enable_comm_telemetry(False)
+        self._comm_summary = None       # comm_ledger()'s health cache
         if self.mesh_info:
             self.metrics.record_mesh(self.mesh_info)
         self.step_idx = 0
@@ -779,6 +834,12 @@ class ServingScheduler:
             # to keep audit cadence aligned with host-authoritative
             # bookkeeping (and off the overlap hot path)
             self.audit()
+        if self.compile_watchdog is not None:
+            # auto-steady ticker: after steady_after_steps quiet steps
+            # the watchdog arms and further signature churn is a
+            # detection, not warmup (owner-gated: on a shared engine
+            # only the current owner's steps advance the counter)
+            self.compile_watchdog.step(owner=self.metrics)
         return bool(self.waiting) or n_running > 0 or \
             bool(self._inflight) or bool(self._pending_attach)
 
@@ -1834,6 +1895,81 @@ class ServingScheduler:
         return {"ok": all(r.get("ok", True) for r in reports),
                 "reports": reports, "counts": counts}
 
+    # ------------------------------------------------- comm ledger
+    def comm_ledger(self, refresh=False):
+        """Compute (and cache) the static HLO comm ledger of every
+        serving signature this scheduler's engine has dispatched
+        (``profiling/comm_ledger.py``), emit the ``serving/comm/*``
+        gauges, and populate the ``comm_*`` health fields.
+
+        The steady-state unit the gauges describe is the *largest
+        captured decode_multi horizon* — the dispatch shape a warm
+        server settles into; per-signature detail is the return value
+        (``{label: ledger}``) and the CI artifact.  First call pays one
+        analysis re-compile per signature (lower -> compile -> parse),
+        so callers run it off the hot path: at drain/summary time, or
+        the first health heartbeat (``ds_serve`` does the latter).
+        Empty dict when ``comm_telemetry`` is off."""
+        if not self.comm_telemetry or \
+                not hasattr(self.engine, "comm_ledger"):
+            return {}
+        ledgers = self.engine.comm_ledger(refresh=refresh)
+        best_h, decode_led = 0, None
+        for label, led in ledgers.items():
+            m = re.match(r"decode_multi\[h=(\d+)\]", label)
+            if m:
+                h = int(m.group(1))
+                if h > best_h:
+                    best_h, decode_led = h, led
+        if decode_led is None and "decode" in ledgers:
+            best_h, decode_led = 1, ledgers["decode"]
+        if decode_led is not None:
+            # a decode_multi dispatch serves ALL slots for `horizon`
+            # steps, so the per-token unit divides by both — wire
+            # bytes per emitted token at full slot occupancy (the
+            # like-for-like scorecard unit; partial occupancy moves
+            # the realized cost up, never down)
+            self._comm_summary = {
+                "horizon": best_h,
+                "bytes_per_step": int(decode_led["wire_bytes"]),
+                "bytes_per_token":
+                    round(decode_led["wire_bytes"]
+                          / max(best_h * self.num_slots, 1), 1),
+                "collectives_per_step": int(decode_led["collectives"]),
+                "per_axis": dict(decode_led["per_axis"]),
+                "ici_bytes": int(decode_led["per_tier"]["ici"]),
+                "dcn_bytes": int(decode_led["per_tier"]["dcn"]),
+            }
+            self.metrics.record_comm(self.step_idx, self._comm_summary)
+        return ledgers
+
+    def comm_health_fields(self):
+        """The ``comm_*`` slice of :meth:`health` (the router's fleet
+        aggregation reads this directly).  Byte figures are None until
+        :meth:`comm_ledger` has analyzed a decode signature — health
+        itself never compiles."""
+        s = self._comm_summary
+        wd = self.compile_watchdog
+        return {
+            "comm_telemetry": self.comm_telemetry,
+            "comm_bytes_per_step":
+                None if s is None else s["bytes_per_step"],
+            "comm_bytes_per_token":
+                None if s is None else s["bytes_per_token"],
+            "comm_collectives_per_step":
+                None if s is None else s["collectives_per_step"],
+            "comm_axis_bytes": None if s is None else s["per_axis"],
+            "comm_ici_bytes_per_step":
+                None if s is None else s["ici_bytes"],
+            "comm_dcn_bytes_per_step":
+                None if s is None else s["dcn_bytes"],
+            "compile_watchdog": wd is not None,
+            "compiles": 0 if wd is None
+            else int(sum(wd.counts.values())),
+            "steady_recompiles": 0 if wd is None
+            else wd.steady_recompiles,
+        }
+
     # ------------------------------------------------------------- health
     def health(self):
         """Liveness/saturation snapshot for operators (exposed by
@@ -1912,6 +2048,11 @@ class ServingScheduler:
             "mem_handoff_bytes_per_device": _bytes(
                 mem_counts["handoff"]),
             "mem_free_bytes_per_device": _bytes(mem_counts["free"]),
+            # communication & compile observability (PR 12): the HLO
+            # comm-ledger summary (None until comm_ledger() ran — a
+            # health probe must never pay an analysis compile) and the
+            # recompile-watchdog counters
+            **self.comm_health_fields(),
             "inflight_horizons": len(self._inflight),
             "draining": self.draining,
             "handoffs": m.handoffs,

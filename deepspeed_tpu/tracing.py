@@ -168,7 +168,52 @@ EVENT_TAXONOMY = {
     "resilience/resumed": "an intact tag was restored (value = step)",
     "resilience/preempted": "preemption checkpoint landed; run exiting",
     "resilience/nan_loss": "the divergence watchdog saw a non-finite loss",
+    # ------------------------------------- communication (HLO ledger)
+    # per-signature static-analysis gauges emitted when the serving
+    # comm ledger is computed (ServingScheduler.comm_ledger): bytes are
+    # per-device wire bytes of ONE steady-state decode dispatch, per
+    # the formulas in docs/observability.md
+    "serving/comm/bytes_per_step":
+        "wire bytes one steady-state decode dispatch moves per device",
+    "serving/comm/bytes_per_token":
+        "wire bytes per emitted token at full slot occupancy "
+        "(bytes_per_step / (horizon x num_slots))",
+    "serving/comm/collectives_per_step":
+        "collective executions per decode dispatch (trip-weighted)",
+    "serving/comm/ici_bytes_per_step":
+        "wire bytes riding intra-slice (ICI-tier) groups per dispatch",
+    "serving/comm/dcn_bytes_per_step":
+        "wire bytes riding cross-process (DCN-tier) groups per dispatch",
+    # per-mesh-axis wire-byte split (axis set = MeshConfig's known axes)
+    "serving/comm/axis/data": "wire bytes per dispatch on the data axis",
+    "serving/comm/axis/model": "wire bytes per dispatch on the model axis",
+    "serving/comm/axis/pipe": "wire bytes per dispatch on the pipe axis",
+    "serving/comm/axis/expert":
+        "wire bytes per dispatch on the expert axis",
+    "serving/comm/axis/sequence":
+        "wire bytes per dispatch on the sequence axis",
+    # recompile watchdog
+    "serving/comm/recompile":
+        "steady-state recompile detected (value = cumulative count)",
 }
+
+# the eager comms logger's periodic report (comm.log_summary) routes
+# per-op aggregates through the monitor stream under comm/<op>/<field>
+# — the canonical op set below is taxonomy-pinned (custom op_name
+# strings still emit, under their own sanitized names)
+for _op in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+            "ppermute", "broadcast", "barrier"):
+    EVENT_TAXONOMY[f"comm/{_op}/calls"] = \
+        f"eager {_op} invocations accumulated by the comms logger"
+    EVENT_TAXONOMY[f"comm/{_op}/bytes"] = (
+        f"cumulative message bytes of eager {_op} calls, op-scaled "
+        "exactly like the printed log_summary table (calc_bw_log: "
+        "gather/scatter count the full buffer, others per member)")
+    EVENT_TAXONOMY[f"comm/{_op}/busbw_gbps"] = (
+        f"mean bus bandwidth of eager {_op} calls — the raw "
+        "calc_bw_log figure, same unit as the comm-ledger row schema "
+        "(the printed table shows bits, x8)")
+del _op
 
 
 # ---------------------------------------------------------------- spans
@@ -355,6 +400,139 @@ class _NullTracer(SpanTracer):
 
 
 NULL_TRACER = _NullTracer()
+
+
+# ------------------------------------------------ compile observability
+
+def jit_cache_size(fn):
+    """THE compile-count probe: compiled-signature count of a jitted
+    callable (0 for ``None`` or a not-yet-jitted callable).  Every
+    consumer — ``InferenceEngine.serving_*_compile_count``,
+    ``DeepSpeedEngine.train_compile_counts``, the goodput ledger's
+    ``compile_warmup`` detector, the recompile watchdog and the test
+    pins — reads THIS helper, so "what counts as a compile" has exactly
+    one definition."""
+    if fn is None:
+        return 0
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return 0
+    try:
+        return int(probe())
+    except Exception:       # a torn-down backend must read as 0, not raise
+        return 0
+
+
+class CompileWatchdog:
+    """Recompile detection: jit cache-miss events become ``compile``
+    spans, and a *steady-state* recompile — signature churn after
+    warmup — fires a tracer instant plus a :class:`FlightRecorder`
+    dump (the compile-storm failure class, machine-detected instead of
+    test-pinned only).
+
+    Lifecycle: the dispatch layer calls :meth:`on_compile` whenever a
+    watched callable's :func:`jit_cache_size` grew across a call
+    (``wall_s`` is that call's wall time — jit compiles synchronously
+    at dispatch, so the first call's wall IS compile + dispatch).  The
+    owner ticks :meth:`step` once per scheduler/train step; after
+    ``steady_after_steps`` consecutive ticks without a compile the
+    watchdog arms itself (or arm explicitly with :meth:`mark_steady` —
+    deterministic for tests and drain boundaries).  Once steady, every
+    further compile is a detection: ``recompile_storm`` instant,
+    ``serving/comm/recompile`` monitor event (when a metrics funnel is
+    bound) and one flight dump naming the recompiled function.
+
+    Host bookkeeping only — it never changes what compiles (pinned by
+    ``tests/unit/test_comm_telemetry.py``: watchdog on/off runs are
+    token-exact with identical compile counts)."""
+
+    def __init__(self, tracer=None, flight_recorder=None,
+                 steady_after_steps=64, metrics=None):
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.flight_recorder = flight_recorder
+        self.metrics = metrics          # ServingMetrics-compatible or None
+        self.steady = False
+        self.steady_after_steps = None if not steady_after_steps \
+            else int(steady_after_steps)
+        self._quiet_steps = 0
+        self.counts = {}                # fn name -> cumulative compiles
+        self.compile_wall_s = 0.0       # cumulative compile-attributed wall
+        self.steady_recompiles = 0
+        # bounded like every other recorder here (SpanTracer ring,
+        # FlightRecorder limit): a persistent compile storm — the very
+        # scenario this watchdog detects — must not leak memory
+        self.events = deque(maxlen=256)  # (name, n, wall_s, steady)
+        self._step_idx = 0
+
+    def bind(self, tracer=None, flight_recorder=None, metrics=None):
+        if tracer is not None:
+            self.tracer = tracer
+        if flight_recorder is not None:
+            self.flight_recorder = flight_recorder
+        if metrics is not None:
+            self.metrics = metrics
+        return self
+
+    def mark_steady(self):
+        """Warmup is over: from here every new jit signature is churn."""
+        self.steady = True
+
+    def step(self, owner=None):
+        """One scheduler/train step completed (auto-steady ticker).
+        With a shared engine-lifetime watchdog, several schedulers
+        tick it — pass ``owner`` (the caller's metrics funnel) so only
+        the CURRENT owner's steps advance the quiet counter; N
+        co-ticking schedulers would otherwise arm steady state in
+        1/N-th of the intended warmup window."""
+        if owner is not None and self.metrics is not None and \
+                owner is not self.metrics:
+            return
+        self._step_idx += 1
+        if self.steady or self.steady_after_steps is None:
+            return
+        self._quiet_steps += 1
+        if self._quiet_steps >= self.steady_after_steps:
+            self.steady = True
+
+    def on_compile(self, name, n, t0, t1, detail=None):
+        """``n`` new signature(s) of ``name`` compiled during the call
+        spanning ``t0``→``t1`` (monotonic seconds)."""
+        total = self.counts.get(name, 0) + int(n)
+        self.counts[name] = total
+        wall = max(t1 - t0, 0.0)
+        self.compile_wall_s += wall
+        self._quiet_steps = 0
+        self.events.append((name, int(n), wall, self.steady))
+        args = {"fn": name, "new_signatures": int(n),
+                "cumulative": total, "ms": round(wall * 1e3, 3),
+                "steady_state": self.steady}
+        if detail:
+            args.update(detail)
+        self.tracer.complete("compile", t0, t1, cat="compile",
+                             track="compile", args=args)
+        if not self.steady:
+            return
+        self.steady_recompiles += 1
+        self.tracer.instant("recompile_storm", cat="compile",
+                            track="compile", args=args)
+        if self.metrics is not None:
+            rec = getattr(self.metrics, "record_recompile", None)
+            if rec is not None:
+                rec(self._step_idx, self.steady_recompiles)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                f"recompile:{name}",
+                extra={"fn": name, "new_signatures": int(n),
+                       "cumulative_compiles": total,
+                       "compile_wall_s": round(wall, 4),
+                       **({k: v for k, v in (detail or {}).items()})})
+
+    def summary(self):
+        return {"compiles": int(sum(self.counts.values())),
+                "compile_wall_s": round(self.compile_wall_s, 4),
+                "steady": self.steady,
+                "steady_recompiles": self.steady_recompiles,
+                "per_fn": dict(self.counts)}
 
 
 # --------------------------------------------------- scoped tracer

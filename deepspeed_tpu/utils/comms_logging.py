@@ -78,23 +78,75 @@ class CommsLogger:
         self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
 
     def append(self, raw_name, record_name, latency, msg_size, n=1):
-        algbw_gb = 0.0
         msg_size, algbw, busbw = calc_bw_log(raw_name, msg_size, latency, n)
         if record_name in self.comms_dict:
             if msg_size in self.comms_dict[record_name]:
-                self.comms_dict[record_name][msg_size][0] += 1
-                self.comms_dict[record_name][msg_size][1].append(latency)
-                self.comms_dict[record_name][msg_size][2].append(algbw)
-                self.comms_dict[record_name][msg_size][3].append(busbw)
+                vals = self.comms_dict[record_name][msg_size]
+                vals[0] += 1
+                vals[1].append(latency)
+                vals[2].append(algbw)
+                vals[3].append(busbw)
+                if len(vals) > 4:
+                    vals[4] = n     # ledger_rows reports the LAST-seen
+                                    # group size (same op+size over a
+                                    # different axis updates it)
             else:
-                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+                self.comms_dict[record_name][msg_size] = \
+                    [1, [latency], [algbw], [busbw], n]
         else:
-            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+            self.comms_dict[record_name] = \
+                {msg_size: [1, [latency], [algbw], [busbw], n]}
         if self.verbose:
             log_dist(
                 f"rank=? | comm op: {record_name} | time (ms): {latency * 1000:.2f} | "
                 f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw * 8:.2f} | "
                 f"busbw (Gbps): {busbw * 8:.2f}", ranks=[0])
+
+    def aggregate_events(self):
+        """Per-op aggregate ``(tag, value)`` rows for the monitor
+        stream (``comm.log_summary`` routing): cumulative call count,
+        cumulative message bytes (op-scaled exactly like the printed
+        table — ``calc_bw_log`` stores gather/scatter as the full
+        buffer), and the mean bus bandwidth, under
+        ``comm/<op>/{calls,bytes,busbw_gbps}``."""
+        from numpy import mean
+        out = []
+        for op in self.comms_dict:
+            calls = bytes_ = 0
+            busbw = []
+            for msg_size, vals in self.comms_dict[op].items():
+                calls += vals[0]
+                bytes_ += msg_size * vals[0]
+                busbw.extend(vals[3])
+            out.append((f"comm/{op}/calls", calls))
+            out.append((f"comm/{op}/bytes", bytes_))
+            # same unit as ledger_rows/bench_row (the raw calc_bw_log
+            # GB/s figure under the schema's historic field name) so
+            # every comm-ledger surface reports one number; only the
+            # printed table shows bits (x8)
+            out.append((f"comm/{op}/busbw_gbps",
+                        round(float(mean(busbw)), 3) if busbw
+                        else 0.0))
+        return out
+
+    def ledger_rows(self):
+        """The accumulator re-expressed as canonical comm-ledger rows
+        (comm/telemetry.bench_row schema) — what the benches emit, so
+        runtime and offline numbers parse identically."""
+        from numpy import mean
+        rows = []
+        for op in self.comms_dict:
+            for msg_size, vals in sorted(self.comms_dict[op].items()):
+                # msg_size is already op-scaled by calc_bw_log (gather/
+                # scatter record the full buffer), so no re-scaling here
+                rows.append({
+                    "op": op, "bytes": int(msg_size),
+                    "latency_ms": round(float(mean(vals[1])) * 1e3, 4),
+                    "algbw_gbps": round(float(mean(vals[2])), 3),
+                    "busbw_gbps": round(float(mean(vals[3])), 3),
+                    "n": vals[4] if len(vals) > 4 else 1,
+                    "calls": vals[0]})
+        return rows
 
     def log_all(self, print_log=True, show_straggler=False):
         from numpy import mean

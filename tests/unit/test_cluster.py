@@ -383,6 +383,19 @@ HEALTH_SCHEMA = {
     "mem_prefix_bytes_per_device": (int, type(None)),
     "mem_handoff_bytes_per_device": (int, type(None)),
     "mem_free_bytes_per_device": (int, type(None)),
+    # communication & compile observability (PR 12): the HLO comm-
+    # ledger summary (None until comm_ledger() ran — health itself
+    # never pays an analysis compile) and the recompile watchdog
+    "comm_telemetry": (bool,),
+    "comm_bytes_per_step": (int, type(None)),
+    "comm_bytes_per_token": (float, int, type(None)),
+    "comm_collectives_per_step": (int, type(None)),
+    "comm_axis_bytes": (dict, type(None)),
+    "comm_ici_bytes_per_step": (int, type(None)),
+    "comm_dcn_bytes_per_step": (int, type(None)),
+    "compile_watchdog": (bool,),
+    "compiles": (int,),
+    "steady_recompiles": (int,),
     "inflight_horizons": (int,),
     "draining": (bool,),
     "handoffs": (int,),

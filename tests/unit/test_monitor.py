@@ -144,6 +144,13 @@ def _drive_all_serving_events(m):
                      "free": 10}, 0.625, 1.25)
     m.record_pressure(1, "grow")
     m.record_pressure_episode(1)
+    m.record_comm(1, {"bytes_per_step": 4096, "bytes_per_token": 512.0,
+                      "collectives_per_step": 12, "ici_bytes": 4096,
+                      "dcn_bytes": 0,
+                      "per_axis": {"data": 1024, "model": 3072,
+                                   "pipe": 1, "expert": 1,
+                                   "sequence": 1, "data+model": 7}})
+    m.record_recompile(1, 1)
     m.record_first_token(1, 0.05)
     m.record_token(1, 0.01)
     for state in ("failed", "shed", "cancelled"):

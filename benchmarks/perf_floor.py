@@ -89,6 +89,19 @@ CHECKS = [
      "memory.occupancy_frac", "info", None),
     ("mem page-seconds (shared workload)",
      "memory.mem_on.page_seconds_total", "info", None),
+    # serving-autotuner rows (PR 13): the tuned config must at least
+    # match the default on the committed prefix-share mix (a ratio
+    # around the committed ~4x — the tuner rediscovering the prefix
+    # cache + best horizon), and the cost model's predicted-vs-measured
+    # rank correlation is its honesty trend line.  Info for now —
+    # search measurements on shared CI runners carry horizon-sweep
+    # noise; the acceptance test pins the >= 1 and > 0 directions
+    ("tuned vs default tokens/s (prefix mix)", "tuning.tuned_vs_default",
+     "info", None),
+    ("tuned-config tokens/s", "tuning.tuned.tokens_per_sec",
+     "info", None),
+    ("cost-model rank correlation", "tuning.search.rank_correlation",
+     "info", None),
     ("continuous tokens/s (best H)", "continuous.tokens_per_sec",
      "info", None),
     ("tracing tokens/s (on)", "tracing.trace_on.tokens_per_sec",

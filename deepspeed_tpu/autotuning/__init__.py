@@ -1,3 +1,10 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401
 from deepspeed_tpu.autotuning.cost_model import FirstOrderCostModel  # noqa: F401
 from deepspeed_tpu.autotuning.scheduler import ExperimentScheduler  # noqa: F401
+from deepspeed_tpu.autotuning.serving import (MIX_PRESETS,  # noqa: F401
+                                              OnlineTuner,
+                                              ServingAutotuner,
+                                              ServingCostModel,
+                                              TrafficMix,
+                                              ds_serve_args,
+                                              load_mix)

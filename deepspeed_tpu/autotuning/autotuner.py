@@ -81,15 +81,16 @@ class Autotuner:
                 engine.backward(loss)
                 engine.step()
             # fence: warmup dispatches are async; they must drain before
-            # the measured window opens
+            # the measured window opens.  monotonic(): a wall-clock step
+            # (NTP) mid-trial must never corrupt a throughput sample
             float(jax.device_get(loss))
-            t0 = time.time()
+            t0 = time.monotonic()
             for _ in range(self.measure_steps):
                 loss = engine.forward(batch)
                 engine.backward(loss)
                 engine.step()
             float(jax.device_get(loss))
-            dt = time.time() - t0
+            dt = time.monotonic() - t0
             samples = engine.train_batch_size() * self.measure_steps
             return samples / dt
 
@@ -113,7 +114,10 @@ class Autotuner:
                                f"{self.max_trials}")
                 break
             try:
-                t0 = time.time()
+                # monotonic(): trial durations must survive an NTP
+                # clock step (time.time() jumps; a negative or wild
+                # trial_seconds poisons the persisted record)
+                t0 = time.monotonic()
                 value = run_fn(cfg)
             except Exception as e:  # OOM / invalid combo: record and skip
                 logger.warning(f"autotuner: candidate {overrides} failed: "
@@ -121,8 +125,8 @@ class Autotuner:
                 self.results.append({"overrides": overrides, "error": str(e)})
                 continue
             self.results.append({"overrides": overrides, "metric": value,
-                                 "trial_seconds": round(time.time() - t0,
-                                                        3)})
+                                 "trial_seconds":
+                                 round(time.monotonic() - t0, 3)})
             logger.info(f"autotuner: {overrides} -> {value:.1f}")
             if value > best[2]:
                 best = (overrides, cfg, value)
@@ -138,8 +142,23 @@ class Autotuner:
         import os
         os.makedirs(os.path.dirname(self.results_path) or ".",
                     exist_ok=True)
+        # MERGE into an existing results file instead of clobbering it
+        # (the serving-bench --json-out pattern): this tuner's sections
+        # replace their own keys, every foreign key another run wrote —
+        # other tuners' trials, bench sections, notes — survives.  An
+        # unreadable/partial file falls back to a fresh write.
+        out = {}
+        if os.path.exists(self.results_path):
+            try:
+                with open(self.results_path) as f:
+                    prev = json.load(f)
+                if isinstance(prev, dict):
+                    out = prev
+            except (OSError, ValueError):
+                out = {}
+        out["space"] = {k: list(v) for k, v in self.space.items()}
+        out["trials"] = self.results
         with open(self.results_path, "w") as f:
-            json.dump({"space": {k: list(v) for k, v in self.space.items()},
-                       "trials": self.results}, f, indent=2, default=str)
+            json.dump(out, f, indent=2, default=str)
         logger.info(f"autotuner: wrote {len(self.results)} trial records "
                     f"to {self.results_path}")

@@ -1,0 +1,19 @@
+"""Serving autotuner: profile-guided search + online control over the
+serving knob space (ROADMAP item 3 — the decision layer the PR-8..12
+observability stack feeds).
+
+* :class:`TrafficMix` — the declared workload (traffic.py)
+* :class:`ServingCostModel` — analytic pruning + ranking fit to the
+  committed bench JSON and live telemetry (cost_model.py)
+* :class:`ServingAutotuner` — the measured search (search.py)
+* :class:`OnlineTuner` — bounded live nudges (online.py)
+"""
+
+from deepspeed_tpu.autotuning.serving.traffic import (  # noqa: F401
+    MIX_PRESETS, TrafficMix, load_mix)
+from deepspeed_tpu.autotuning.serving.cost_model import (  # noqa: F401
+    DEFAULT_KNOBS, ServingCostModel)
+from deepspeed_tpu.autotuning.serving.search import (  # noqa: F401
+    DEFAULT_SERVING_SPACE, ServingAutotuner, ds_serve_args,
+    rank_correlation)
+from deepspeed_tpu.autotuning.serving.online import OnlineTuner  # noqa: F401

@@ -1,0 +1,241 @@
+"""Online serving tuner: bounded nudges from the live gauge stream.
+
+The measured search (``search.py``) picks a config for a DECLARED mix;
+the :class:`OnlineTuner` handles the traffic the declaration missed.
+It watches the gauges the scheduler already maintains — pool free
+fraction, preemptions, prefix-cache drains, tokens/s — and nudges ONLY
+the knobs the scheduler already re-resolves safely mid-run:
+
+* ``decode_horizon_steps`` — one bucket down under pool pressure (the
+  same ladder ``_reserve`` shrinks along, applied proactively), one
+  bucket back up after sustained health.  Values stay inside the
+  bucket set compiled at construction, so a nudge can never add a jit
+  signature.
+* ``spec_k`` — the speculation budget ceiling, same bucket ladder
+  (the per-request adaptive K already converges under it).
+* ``prefix_cache_pages`` — the retention split: under pressure the cap
+  steps down and the surplus refcount-free pages drain back to the
+  free list NOW (the scheduler's own reclaim path); after sustained
+  health the cap steps back toward its configured value.
+
+Safety contract: every nudged knob rides an existing re-resolve path
+whose token-exactness the oracle suites already pin — greedy output is
+invariant to horizon and spec-K choices (``test_serving_horizon`` /
+``test_spec_decode``), and cache retention only changes WHERE KV comes
+from, never what it spells.  So an online-nudged run is token-exact vs
+``generate()`` by construction; ``tests/unit/test_serving_autotune.py``
+re-proves it under forced churn with ``audit_every=1``.
+
+Every decision is observable: a ``serving/tune/nudge`` monitor event
+plus the per-knob gauge (``serving/tune/<knob>``), a ``tune_nudge``
+tracer instant, and a bounded host-side log — nothing moves silently.
+
+Hysteresis: shrinks fire immediately on a pressured window (capacity
+incidents are expensive); grows wait for ``grow_patience`` consecutive
+healthy windows, and any nudge starts a ``hold``-window cooldown on
+its knob so the controller cannot oscillate at window cadence.
+"""
+
+import time
+from collections import deque
+
+__all__ = ["OnlineTuner"]
+
+
+class OnlineTuner:
+    """Bounded-step online controller over a live ``ServingScheduler``.
+
+    Constructed standalone and handed to
+    ``ServingScheduler(online_tuner=...)``; the scheduler calls
+    :meth:`on_step` at barrier steps (host-authoritative state only —
+    a chained overlap step's view is stale by design).  One instance
+    per scheduler, enforced at bind like ``MemTelemetry``.
+    """
+
+    def __init__(self, interval=8, low_free_frac=0.125,
+                 high_free_frac=0.5, grow_patience=3, hold=2,
+                 cache_step_frac=0.125, min_cache_pages=1,
+                 max_nudge_log=256):
+        self.interval = max(1, int(interval))
+        self.low_free_frac = float(low_free_frac)
+        self.high_free_frac = float(high_free_frac)
+        self.grow_patience = max(1, int(grow_patience))
+        self.hold = max(0, int(hold))
+        self.cache_step_frac = float(cache_step_frac)
+        self.min_cache_pages = int(min_cache_pages)
+        self.nudges = deque(maxlen=int(max_nudge_log))
+        self.nudge_count = 0
+        self._sched = None
+        # bind-time ceilings: a grow never exceeds the configured
+        # config (and never leaves the compiled bucket sets)
+        self._max_horizon = None
+        self._max_spec_k = None
+        self._max_cache_pages = None
+        self._steps = 0
+        self._healthy_windows = 0
+        self._cooldown = {}          # knob -> windows remaining
+        self._last = None            # previous window's counters
+        self._tokens_per_s = None    # EWMA over windows
+
+    @property
+    def enabled(self):
+        return True
+
+    # ---------------------------------------------------------- binding
+    def bind(self, sched):
+        if self._sched is not None:
+            raise ValueError(
+                "this OnlineTuner instance is already bound to another "
+                "scheduler; pass online_tuner=True (or a fresh "
+                "instance) per scheduler")
+        self._sched = sched
+        self._max_horizon = sched.decode_horizon_steps
+        self._max_spec_k = sched.spec_k
+        pc = sched.prefix_cache
+        self._max_cache_pages = None if pc is None else pc.max_pages
+        self._last = self._counters(sched)
+
+    def _counters(self, sched):
+        m = sched.metrics
+        return {"t": time.monotonic(),
+                "tokens": m.tokens_emitted,
+                "preemptions": m.preemptions,
+                "cache_evictions": m.cache_evictions,
+                "pressure": m.mem_pressure_events}
+
+    # ----------------------------------------------------------- nudging
+    def _record(self, sched, knob, value, reason):
+        self.nudge_count += 1
+        self.nudges.append((sched.step_idx, knob, value, reason))
+        sched.metrics.record_tune(sched.step_idx, knob, value)
+        if sched.tracer.enabled:
+            sched.tracer.instant("tune_nudge", cat="tune",
+                                 args={"knob": knob, "value": value,
+                                       "reason": reason})
+        self._cooldown[knob] = self.hold
+
+    def _bucket_down(self, buckets, cur):
+        below = [b for b in buckets if b < cur]
+        return below[-1] if below else cur
+
+    def _bucket_up(self, buckets, cur, cap):
+        above = [b for b in buckets if cur < b <= cap]
+        return above[0] if above else cur
+
+    def _shrink(self, sched, reason):
+        """One bounded shrink on the first non-held knob of the ladder:
+        cache retention first (reclaimable capacity, zero service
+        impact), then speculation budget, then horizon."""
+        pc = sched.prefix_cache
+        if pc is not None and not self._cooldown.get(
+                "prefix_cache_pages"):
+            step = max(1, int(self.cache_step_frac *
+                              sched.kv.pool.num_pages))
+            target = max(self.min_cache_pages, pc.max_pages - step)
+            if target < pc.max_pages:
+                pc.max_pages = target
+                surplus = pc.cached_pages - target
+                if surplus > 0:
+                    # drain the surplus NOW through the scheduler's own
+                    # reclaim path (refcount-free pages only — a shared
+                    # page survives under its readers)
+                    sched._reclaim_cached(surplus)
+                self._record(sched, "prefix_cache_pages", target, reason)
+                return True
+        if sched._spec is not None and sched.spec_k > 1 and \
+                not self._cooldown.get("spec_k"):
+            new_k = self._bucket_down(sched.spec_k_buckets, sched.spec_k)
+            if new_k < sched.spec_k:
+                sched.spec_k = new_k
+                self._record(sched, "spec_k", new_k, reason)
+                return True
+        if sched.decode_horizon_steps > 1 and \
+                not self._cooldown.get("decode_horizon"):
+            new_h = self._bucket_down(sched.horizon_buckets,
+                                      sched.decode_horizon_steps)
+            if new_h < sched.decode_horizon_steps:
+                sched.decode_horizon_steps = new_h
+                self._record(sched, "decode_horizon", new_h, reason)
+                return True
+        return False
+
+    def _grow(self, sched):
+        """One bounded grow back toward the configured config, reverse
+        ladder order (horizon first — it carries the throughput)."""
+        if sched.decode_horizon_steps < self._max_horizon and \
+                not self._cooldown.get("decode_horizon"):
+            new_h = self._bucket_up(sched.horizon_buckets,
+                                    sched.decode_horizon_steps,
+                                    self._max_horizon)
+            if new_h > sched.decode_horizon_steps:
+                sched.decode_horizon_steps = new_h
+                self._record(sched, "decode_horizon", new_h, "recovered")
+                return True
+        if sched._spec is not None and \
+                sched.spec_k < self._max_spec_k and \
+                not self._cooldown.get("spec_k"):
+            new_k = self._bucket_up(sched.spec_k_buckets, sched.spec_k,
+                                    self._max_spec_k)
+            if new_k > sched.spec_k:
+                sched.spec_k = new_k
+                self._record(sched, "spec_k", new_k, "recovered")
+                return True
+        pc = sched.prefix_cache
+        if pc is not None and self._max_cache_pages is not None and \
+                pc.max_pages < self._max_cache_pages and \
+                not self._cooldown.get("prefix_cache_pages"):
+            step = max(1, int(self.cache_step_frac *
+                              sched.kv.pool.num_pages))
+            target = min(self._max_cache_pages, pc.max_pages + step)
+            pc.max_pages = target
+            self._record(sched, "prefix_cache_pages", target, "recovered")
+            return True
+        return False
+
+    # ------------------------------------------------------------- hook
+    def on_step(self, sched):
+        """Barrier-step hook (the scheduler calls this; chained overlap
+        steps never do).  Every ``interval`` barrier steps: read the
+        window's gauges, classify it pressured/healthy, apply at most
+        ONE bounded nudge."""
+        self._steps += 1
+        if self._steps % self.interval:
+            return
+        for knob in list(self._cooldown):
+            if self._cooldown[knob] > 0:
+                self._cooldown[knob] -= 1
+        cur = self._counters(sched)
+        last, self._last = self._last, cur
+        dt = max(1e-9, cur["t"] - last["t"])
+        rate = (cur["tokens"] - last["tokens"]) / dt
+        self._tokens_per_s = rate if self._tokens_per_s is None \
+            else 0.5 * self._tokens_per_s + 0.5 * rate
+        free_frac = sched.kv.pool.free_pages / sched.kv.pool.num_pages
+        pressured = (
+            free_frac < self.low_free_frac or
+            cur["preemptions"] > last["preemptions"] or
+            cur["pressure"] > last["pressure"])
+        if pressured:
+            self._healthy_windows = 0
+            self._shrink(sched,
+                         "pressure" if free_frac >= self.low_free_frac
+                         else f"free_frac={free_frac:.3f}")
+            return
+        if free_frac >= self.high_free_frac and \
+                cur["cache_evictions"] == last["cache_evictions"]:
+            self._healthy_windows += 1
+            if self._healthy_windows >= self.grow_patience:
+                if self._grow(sched):
+                    self._healthy_windows = 0
+        else:
+            self._healthy_windows = 0
+
+    # ------------------------------------------------------------ export
+    def summary(self):
+        return {
+            "nudges": self.nudge_count,
+            "tokens_per_s_ewma": None if self._tokens_per_s is None
+            else round(self._tokens_per_s, 2),
+            "recent": [{"step": s, "knob": k, "value": v, "reason": r}
+                       for s, k, v, r in list(self.nudges)[-16:]],
+        }

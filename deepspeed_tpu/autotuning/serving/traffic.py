@@ -1,0 +1,231 @@
+"""Traffic-mix specification for the serving autotuner.
+
+A :class:`TrafficMix` is the DECLARED workload a tuned config is tuned
+*for*: request rate, prompt/decode length distributions, the
+shared-prefix structure (what the radix cache can reuse), the
+repetition structure (what the n-gram drafter can exploit), and the
+temperature mix (sampled traffic disables speculation).  It is pure
+data — serializable to JSON, hashable into a search provenance line —
+and it DERIVES a deterministic load (prompts, decode budgets, Poisson
+arrival offsets) from its seed, so two searches over the same mix
+measure candidate configs against byte-identical request streams.
+
+The presets mirror the committed bench workloads in
+``benchmarks/serving_results_cpu.json`` exactly (same generator shapes
+and seeds as ``benchmarks/serving_bench.py``): a cost model fit to the
+committed sections is only honest about a mix the sections actually
+measured, so the presets are the calibration anchors and custom mixes
+interpolate from there.
+"""
+
+import json
+
+import numpy as np
+
+__all__ = ["TrafficMix", "MIX_PRESETS", "load_mix"]
+
+
+class TrafficMix:
+    """Declarative serving workload: what the tuner optimizes FOR.
+
+    Parameters mirror the bench generators:
+
+    * ``request_rate`` — Poisson arrival rate (req/s); the committed
+      bench sections use 1000 (server-bound: arrivals never starve the
+      batch, so tokens/s measures the serving loop, not the client).
+    * ``prompt_len`` / ``decode_len`` — inclusive ``(lo, hi)`` bounds;
+      per-request lengths draw uniformly (the bench convention).
+    * ``shared_prefix_len`` / ``tail_len`` — when ``shared_prefix_len >
+      0``, ``shared_fraction`` of the requests spell one common system
+      prompt plus a distinct tail (the radix cache's target traffic)
+      and ``prompt_len`` is ignored for those requests.
+    * ``motif_len`` / ``motif_repeats`` — when ``motif_len > 0``,
+      prompts are a repeated per-request motif (the n-gram drafter's
+      target traffic; composes with neither sharing nor plain prompts —
+      one structure per mix, like the bench workloads).
+    * ``greedy_fraction`` — fraction of traffic decoded greedily
+      (temperature 0).  Speculation only pays off on the greedy share;
+      the stock mixes are fully greedy like the committed benches.
+    """
+
+    _FIELDS = ("name", "requests", "request_rate", "prompt_len",
+               "decode_len", "shared_prefix_len", "tail_len",
+               "shared_fraction", "motif_len", "motif_repeats",
+               "greedy_fraction", "seed")
+
+    def __init__(self, name="custom", requests=64, request_rate=1000.0,
+                 prompt_len=(4, 24), decode_len=(4, 16),
+                 shared_prefix_len=0, tail_len=8, shared_fraction=0.0,
+                 motif_len=0, motif_repeats=3, greedy_fraction=1.0,
+                 seed=0):
+        self.name = str(name)
+        self.requests = int(requests)
+        self.request_rate = float(request_rate)
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.decode_len = (int(decode_len[0]), int(decode_len[1]))
+        self.shared_prefix_len = int(shared_prefix_len)
+        self.tail_len = int(tail_len)
+        self.shared_fraction = float(shared_fraction)
+        self.motif_len = int(motif_len)
+        self.motif_repeats = int(motif_repeats)
+        self.greedy_fraction = float(greedy_fraction)
+        self.seed = int(seed)
+        if self.requests <= 0 or self.request_rate <= 0:
+            raise ValueError("requests and request_rate must be positive")
+        if not 0.0 <= self.shared_fraction <= 1.0:
+            raise ValueError("shared_fraction must be in [0, 1]")
+        if not 0.0 <= self.greedy_fraction <= 1.0:
+            raise ValueError("greedy_fraction must be in [0, 1]")
+        if self.shared_fraction > 0 and self.shared_prefix_len <= 0:
+            raise ValueError("shared_fraction > 0 needs "
+                             "shared_prefix_len > 0")
+        if self.motif_len > 0 and self.shared_fraction > 0:
+            raise ValueError("a mix is shared-prefix OR motif traffic, "
+                             "not both (one structure per mix, like the "
+                             "committed bench workloads)")
+
+    # ------------------------------------------------------ serialization
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+    @classmethod
+    def from_dict(cls, d):
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            raise ValueError(f"unknown TrafficMix fields: {sorted(unknown)}")
+        return cls(**d)
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            d = json.load(f)
+        # tuple fields round-trip through JSON as lists
+        for k in ("prompt_len", "decode_len"):
+            if k in d:
+                d[k] = tuple(d[k])
+        return cls.from_dict(d)
+
+    def __repr__(self):
+        return (f"TrafficMix({self.name!r}, requests={self.requests}, "
+                f"rate={self.request_rate}, shared={self.shared_fraction}"
+                f"@{self.shared_prefix_len}, motif={self.motif_len}x"
+                f"{self.motif_repeats}, greedy={self.greedy_fraction}, "
+                f"seed={self.seed})")
+
+    # -------------------------------------------------- derived bounds
+    @property
+    def max_prompt_tokens(self):
+        if self.motif_len > 0:
+            return self.motif_len * self.motif_repeats + self.tail_len
+        plain = self.prompt_len[1]
+        if self.shared_fraction > 0:
+            shared = self.shared_prefix_len + self.tail_len
+            return shared if self.shared_fraction >= 1.0 \
+                else max(plain, shared)
+        return plain
+
+    @property
+    def max_request_tokens(self):
+        """Worst-case tokens one request needs resident (prompt + every
+        decoded token) — the figure the cost model's analytic
+        feasibility check prices against the page arithmetic."""
+        return self.max_prompt_tokens + self.decode_len[1]
+
+    # --------------------------------------------------- load generation
+    def generate(self, vocab):
+        """Derive the deterministic load: ``(prompts, max_new, arrivals,
+        sampled)`` — int32 prompt arrays, per-request decode budgets,
+        cumulative Poisson arrival offsets (seconds), and a per-request
+        bool marking the sampled (non-greedy) share.  Same mix + same
+        seed => byte-identical stream; the generator shapes match the
+        bench workload builders so the presets reproduce the committed
+        workloads exactly."""
+        rng = np.random.default_rng(self.seed)
+        prompts, max_new = [], []
+        if self.motif_len > 0:
+            # serving_bench.make_spec_workload shape
+            for _ in range(self.requests):
+                motif = rng.integers(0, vocab, self.motif_len).astype("i4")
+                tail = rng.integers(0, vocab, self.tail_len).astype("i4")
+                prompts.append(np.concatenate(
+                    [np.tile(motif, self.motif_repeats), tail]))
+                max_new.append(int(rng.integers(self.decode_len[0],
+                                                self.decode_len[1] + 1)))
+        elif self.shared_fraction > 0:
+            # serving_bench.make_prefix_workload shape (share=True when
+            # every request shares; a partial fraction mixes in plain
+            # prompts of the same total length — the control shape)
+            sys_prompt = rng.integers(0, vocab,
+                                      self.shared_prefix_len).astype("i4")
+            total = self.shared_prefix_len + self.tail_len
+            for i in range(self.requests):
+                if i < round(self.shared_fraction * self.requests):
+                    tail = rng.integers(0, vocab, self.tail_len)
+                    prompts.append(np.concatenate(
+                        [sys_prompt, tail.astype("i4")]))
+                else:
+                    prompts.append(rng.integers(0, vocab,
+                                                total).astype("i4"))
+            # budgets draw AFTER all prompts — the bench generator's
+            # stream order, kept so the preset replays it exactly
+            max_new = [int(rng.integers(self.decode_len[0],
+                                        self.decode_len[1] + 1))
+                       for _ in range(self.requests)]
+        else:
+            # serving_bench.make_workload shape (mixed lengths).  NOTE:
+            # the bench draws length and budget from the same stream in
+            # this order — kept identical so preset "mixed" replays the
+            # committed workload byte-for-byte.
+            prompts = [rng.integers(
+                0, vocab,
+                int(rng.integers(self.prompt_len[0],
+                                 self.prompt_len[1] + 1))).astype("i4")
+                for _ in range(self.requests)]
+            max_new = [int(rng.integers(self.decode_len[0],
+                                        self.decode_len[1] + 1))
+                       for _ in range(self.requests)]
+        arrivals = np.cumsum(rng.exponential(1.0 / self.request_rate,
+                                             self.requests))
+        n_sampled = round((1.0 - self.greedy_fraction) * self.requests)
+        sampled = np.zeros(self.requests, bool)
+        if n_sampled:
+            sampled[rng.choice(self.requests, n_sampled,
+                               replace=False)] = True
+        return prompts, max_new, arrivals, sampled
+
+
+# The calibration anchors: each preset reproduces one committed bench
+# workload (generator shape, lengths, rate, seed) so the cost model's
+# fitted terms and the search's measured trials share a domain.
+MIX_PRESETS = {
+    # serving_results_cpu.json horizon_sweep/continuous workload
+    "mixed": dict(name="mixed", requests=64, request_rate=1000.0,
+                  prompt_len=(4, 23), decode_len=(4, 15), seed=0),
+    # serving_results_cpu.json prefix_share.shared workload (92% shared
+    # fraction by tokens; every request shares the 96-token system
+    # prompt)
+    "prefix_share": dict(name="prefix_share", requests=64,
+                         request_rate=1000.0, decode_len=(4, 15),
+                         shared_prefix_len=96, tail_len=8,
+                         shared_fraction=1.0, seed=0),
+    # serving_results_cpu.json spec_decode workload (repetition-friendly
+    # motifs, long decode budgets)
+    "spec": dict(name="spec", requests=64, request_rate=1000.0,
+                 decode_len=(72, 96), motif_len=8, motif_repeats=3,
+                 tail_len=4, seed=0),
+}
+
+
+def load_mix(spec):
+    """Resolve a mix argument: a preset name, a JSON file path, or an
+    already-built :class:`TrafficMix` (pass-through)."""
+    if isinstance(spec, TrafficMix):
+        return spec
+    if spec in MIX_PRESETS:
+        return TrafficMix(**MIX_PRESETS[spec])
+    return TrafficMix.load(spec)

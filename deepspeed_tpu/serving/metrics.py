@@ -60,6 +60,9 @@ class ServingMetrics:
         # memory telemetry (MemTelemetry drives these; all 0 when off)
         self.mem_pressure_events = 0   # capacity causal chains recorded
         self.mem_pressure_episodes = 0  # sustained episodes fired
+        # online autotuner (OnlineTuner drives these; all 0 when off)
+        self.tune_nudges = 0           # knob nudges applied
+        self.tune_log = deque(maxlen=64)   # (step, knob, value)
         self.mesh_info = {}            # serving topology (record_mesh)
         self._events = []
 
@@ -241,6 +244,26 @@ class ServingMetrics:
         self.mem_pressure_episodes += 1
         self._write([("serving/mem/pressure_episode", 1, step)])
 
+    # the per-knob gauge set is closed over the online tuner's three
+    # safely-re-resolvable knobs (docs/autotuning.md knob table)
+    _TUNE_KNOBS = ("decode_horizon", "spec_k", "prefix_cache_pages")
+
+    def record_tune(self, step, knob, value):
+        """One online-tuner nudge was applied: ``knob`` moved to
+        ``value`` (the new live setting, not a delta).  The which/why
+        detail (reason string) lives in the tuner's bounded nudge log
+        and the ``tune_nudge`` tracer instant; monitor sinks get the
+        counter plus the per-knob gauge."""
+        if knob not in self._TUNE_KNOBS:
+            raise ValueError(f"unknown tuned knob {knob!r}; the gauge "
+                             f"set is closed over {self._TUNE_KNOBS}")
+        self.tune_nudges += 1
+        self.tune_log.append((step, knob, value))
+        self._write([
+                ("serving/tune/nudge", 1, step),
+                (f"serving/tune/{knob}", value, step),
+            ])
+
     # the serving/comm/axis/* gauge set is closed over MeshConfig's
     # known axes (like serving/mesh/*): scalar sinks get one gauge per
     # axis, joint-axis groups ("data+model") ride health()'s JSON dict
@@ -360,6 +383,7 @@ class ServingMetrics:
             "spec_degraded": self.spec_degraded,
             "handoffs": self.handoffs,
             "handoff_tokens": self.handoff_tokens,
+            "tune_nudges": self.tune_nudges,
         }
         if wall_s:
             out["tokens_per_sec"] = round(self.tokens_emitted / wall_s, 2)

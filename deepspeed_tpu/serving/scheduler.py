@@ -222,7 +222,8 @@ class ServingScheduler:
                  spec_decode=None, spec_k=8, spec_drafter=None,
                  shared_pool=None, pools_ref=None, on_handoff=None,
                  tracer=None, mem_telemetry=False, audit_every=None,
-                 comm_telemetry=False, compile_watchdog=None):
+                 comm_telemetry=False, compile_watchdog=None,
+                 online_tuner=None, tuned_from=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
@@ -435,6 +436,25 @@ class ServingScheduler:
         if self._spec is not None and not greedy:
             self._spec = None
             self.spec_mode = "off (sampled mode)"
+        # online autotuner (autotuning/serving/online.py): bounded
+        # nudges of the safely-re-resolvable knobs (decode horizon,
+        # spec-K ceiling, prefix-cache retention split) from the live
+        # gauges, applied at BARRIER steps only.  Off is None — one
+        # falsy check per step, tokens and compile counts byte-identical
+        # (pinned by tests/unit/test_serving_autotune.py).  Pass True
+        # for defaults or an OnlineTuner instance for custom
+        # thresholds; an instance already bound elsewhere is rejected
+        # at bind (the MemTelemetry sharing rule).
+        if online_tuner is True:
+            from deepspeed_tpu.autotuning.serving.online import OnlineTuner
+            online_tuner = OnlineTuner()
+        self.online = online_tuner if online_tuner else None
+        if self.online is not None:
+            self.online.bind(self)
+        # provenance of a tuner-emitted config (ds_serve --tuned-config
+        # PATH): echoed through health() so an operator can tell a
+        # hand-set config from a searched one
+        self.tuned_from = tuned_from
 
     @property
     def pools(self):
@@ -840,6 +860,13 @@ class ServingScheduler:
             # detection, not warmup (owner-gated: on a shared engine
             # only the current owner's steps advance the counter)
             self.compile_watchdog.step(owner=self.metrics)
+        if self.online is not None and not chained:
+            # online tuner nudges ride BARRIER steps only: knob changes
+            # must land on host-authoritative state, never while a
+            # chained horizon's stale snapshot is in flight.  Every
+            # nudge stays inside the construction-time bucket sets, so
+            # the compiled-signature story is untouched.
+            self.online.on_step(self)
         return bool(self.waiting) or n_running > 0 or \
             bool(self._inflight) or bool(self._pending_attach)
 
@@ -2053,6 +2080,12 @@ class ServingScheduler:
             # health probe must never pay an analysis compile) and the
             # recompile-watchdog counters
             **self.comm_health_fields(),
+            # serving autotuner (ROADMAP item 3): online-controller
+            # presence + nudge count, and the searched-config
+            # provenance (--tuned-config PATH; None = hand-set)
+            "online_tuner": self.online is not None,
+            "tune_nudges": m.tune_nudges,
+            "tuned_from": self.tuned_from,
             "inflight_horizons": len(self._inflight),
             "draining": self.draining,
             "handoffs": m.handoffs,

@@ -116,6 +116,14 @@ EVENT_TAXONOMY = {
         "one capacity-decision causal chain recorded (value = 1)",
     "serving/mem/pressure_episode":
         "sustained-pressure episode fired (free_frac under threshold)",
+    # online serving autotuner (OnlineTuner; bounded nudges of the
+    # safely-re-resolvable knobs from the live gauge stream)
+    "serving/tune/nudge": "one online-tuner knob nudge applied",
+    "serving/tune/decode_horizon":
+        "live fused-decode horizon cap after a nudge",
+    "serving/tune/spec_k": "live speculation-K ceiling after a nudge",
+    "serving/tune/prefix_cache_pages":
+        "live prefix-cache retention cap after a nudge",
     # serving topology (construction-time gauges; axis set =
     # MeshConfig's known axes)
     "serving/mesh/data": "mesh data-axis size",

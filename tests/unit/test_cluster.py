@@ -396,6 +396,11 @@ HEALTH_SCHEMA = {
     "compile_watchdog": (bool,),
     "compiles": (int,),
     "steady_recompiles": (int,),
+    # serving autotuner (PR 13): online-controller presence + nudge
+    # count, and the searched-config provenance (--tuned-config)
+    "online_tuner": (bool,),
+    "tune_nudges": (int,),
+    "tuned_from": (str, type(None)),
     "inflight_horizons": (int,),
     "draining": (bool,),
     "handoffs": (int,),

@@ -144,6 +144,9 @@ def _drive_all_serving_events(m):
                      "free": 10}, 0.625, 1.25)
     m.record_pressure(1, "grow")
     m.record_pressure_episode(1)
+    for knob, value in (("decode_horizon", 4), ("spec_k", 4),
+                        ("prefix_cache_pages", 16)):
+        m.record_tune(1, knob, value)
     m.record_comm(1, {"bytes_per_step": 4096, "bytes_per_token": 512.0,
                       "collectives_per_step": 12, "ici_bytes": 4096,
                       "dcn_bytes": 0,

@@ -1,0 +1,505 @@
+"""Serving autotuner (deepspeed_tpu/autotuning/serving): cost-model
+pruning/monotonicity, search determinism + the measured acceptance
+oracle, online-controller token-exactness under knob churn with
+``audit_every=1``, zero-cost-when-off, and the seed-autotuner fixes
+(monotonic trial timing, merge-on-persist).
+
+Every scheduler here uses the same small (slots, pages, page_size)
+constants unless a test is specifically about capacity, so jit
+signatures stay within the usual bucket sets."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.autotuning.serving import (DEFAULT_KNOBS, MIX_PRESETS,
+                                              OnlineTuner,
+                                              ServingAutotuner,
+                                              ServingCostModel,
+                                              TrafficMix, ds_serve_args,
+                                              load_mix, rank_correlation)
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.monitor.monitor import RingBufferMonitor
+from deepspeed_tpu.serving import (PagePool, PagePoolExhausted,
+                                   ServingScheduler, SpanTracer)
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = GPT2(gpt2_tiny())
+    eng = deepspeed_tpu.init_inference(
+        model=model, dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+def _oracle(engine, prompts, max_new):
+    return [[int(t) for t in engine.generate(
+        p[None], max_new_tokens=m, do_sample=False)[0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+# ------------------------------------------------------------ TrafficMix
+
+def test_mix_presets_reproduce_committed_bench_workloads():
+    """Each preset derives the SAME deterministic load as the bench
+    generator the committed section measured — the cost model's
+    calibration anchors are real, not approximate."""
+    from benchmarks import serving_bench as sb
+    mix = load_mix("mixed")
+    p1, m1, a1, _ = mix.generate(256)
+    p2, m2, a2 = sb.make_workload(256, 64, 1000.0, 0)
+    assert all((x == y).all() for x, y in zip(p1, p2))
+    assert m1 == m2 and np.allclose(a1, a2)
+
+    mix = load_mix("prefix_share")
+    p1, m1, a1, _ = mix.generate(256)
+    p2, m2, a2 = sb.make_prefix_workload(256, 64, 1000.0, 0, 96, 8,
+                                         share=True)
+    assert all((x == y).all() for x, y in zip(p1, p2))
+    assert m1 == m2 and np.allclose(a1, a2)
+
+    mix = load_mix("spec")
+    p1, m1, a1, _ = mix.generate(256)
+    p2, m2, a2 = sb.make_spec_workload(256, 64, 1000.0, 0, motif_len=8,
+                                       motif_repeats=3, tail_len=4)
+    assert all((x == y).all() for x, y in zip(p1, p2))
+    assert m1 == m2 and np.allclose(a1, a2)
+
+
+def test_mix_roundtrip_and_validation(tmp_path):
+    mix = TrafficMix(**MIX_PRESETS["prefix_share"])
+    path = tmp_path / "mix.json"
+    mix.save(path)
+    again = TrafficMix.load(path)
+    assert again.to_dict() == mix.to_dict()
+    # same mix + same seed => byte-identical stream
+    a, b = mix.generate(128), again.generate(128)
+    assert all((x == y).all() for x, y in zip(a[0], b[0]))
+    assert a[1] == b[1]
+    with pytest.raises(ValueError, match="unknown TrafficMix"):
+        TrafficMix.from_dict({"bogus": 1})
+    with pytest.raises(ValueError, match="shared_prefix_len"):
+        TrafficMix(shared_fraction=0.5)
+    with pytest.raises(ValueError, match="one structure per mix"):
+        TrafficMix(shared_fraction=1.0, shared_prefix_len=32,
+                   motif_len=8)
+
+
+# ------------------------------------------------------------ cost model
+
+def test_cost_model_horizon_curve_is_monotone():
+    """The fitted family is the amortization law R_inf*h/(h+a) —
+    monotone nondecreasing in h by construction, even though the raw
+    committed sweep points are rig-noisy (the committed h=8 measured
+    below h=4; the LAW, not the noise, is what ranks candidates)."""
+    cm = ServingCostModel(load_mix("mixed"))
+    prev = 0.0
+    for h in (1, 2, 3, 4, 8, 16, 32, 64):
+        cur = cm.predict({"decode_horizon_steps": h})["tokens_per_sec"]
+        assert cur >= prev, f"h={h}: {cur} < {prev}"
+        prev = cur
+    # and it actually separates the committed regime: h=8 predicts
+    # well above h=1 (the committed sweep spans ~2x)
+    lo = cm.predict({"decode_horizon_steps": 1})["tokens_per_sec"]
+    hi = cm.predict({"decode_horizon_steps": 8})["tokens_per_sec"]
+    assert hi / lo > 1.3
+
+
+def test_cost_model_pruning_matches_pool_arithmetic(engine):
+    """Analytic infeasibility is the EXACT ``PagePool.pages_for_tokens``
+    / scheduler-submit arithmetic: over a grid of (num_pages,
+    page_size, max_pages_per_slot) the model's verdict equals the ceil
+    computation, and every PRUNED candidate is proven infeasible by
+    construction — a real scheduler built from it rejects the mix's
+    worst-case request."""
+    mix = TrafficMix(name="t", requests=4, prompt_len=(8, 40),
+                     decode_len=(8, 24), seed=3)
+    need = mix.max_request_tokens
+    assert need == 64
+    cm = ServingCostModel(mix)
+    grid = [
+        {"num_pages": np_, "page_size": ps, "max_pages_per_slot": mpps}
+        for np_ in (4, 8, 64) for ps in (8, 16) for mpps in (2, 4, None)
+    ]
+    pruned = feasible = 0
+    for knobs in grid:
+        k = ServingCostModel.complete(knobs)
+        pool = PagePool(k["num_pages"], k["page_size"])
+        exact = pool.pages_for_tokens(need) > min(k["max_pages_per_slot"],
+                                                  k["num_pages"])
+        reason = cm.infeasible_reason(knobs)
+        assert (reason is not None) == exact, (knobs, reason)
+        est = cm.predict(knobs)
+        assert est["fits"] == (not exact)
+        # the proof: a pruned candidate is unconstructible for this mix
+        sched = ServingScheduler(
+            engine, num_slots=2, num_pages=k["num_pages"],
+            page_size=k["page_size"],
+            max_pages_per_slot=knobs["max_pages_per_slot"],
+            prefill_chunk=8)
+        prompt = np.zeros(mix.max_prompt_tokens, np.int32)
+        if exact:
+            pruned += 1
+            with pytest.raises((ValueError, PagePoolExhausted)):
+                sched.submit(prompt, max_new_tokens=mix.decode_len[1])
+        else:
+            feasible += 1
+            sched.submit(prompt, max_new_tokens=mix.decode_len[1])
+    assert pruned and feasible, "the grid must exercise both verdicts"
+
+
+def test_cost_model_prefix_and_cap_terms():
+    """The prefix term only fires when the cache is on, the mix shares
+    structure, AND the retention cap can hold the shared chain."""
+    cm = ServingCostModel(load_mix("prefix_share"))
+    base = cm.predict({"prefix_cache": False})["tokens_per_sec"]
+    on = cm.predict({"prefix_cache": True})["tokens_per_sec"]
+    assert on > 1.5 * base
+    # a cap below the shared prefix's page chain kills the term
+    starved = cm.predict({"prefix_cache": True,
+                          "prefix_cache_pages": 2})["tokens_per_sec"]
+    assert starved == base
+    # no shared structure in the mix -> no term either
+    cm2 = ServingCostModel(load_mix("mixed"))
+    assert cm2.predict({"prefix_cache": True})["tokens_per_sec"] == \
+        cm2.predict({"prefix_cache": False})["tokens_per_sec"]
+    with pytest.raises(ValueError, match="unknown serving knobs"):
+        cm.predict({"bogus_knob": 1})
+
+
+# ---------------------------------------------------------------- search
+
+def _fake_measure(order_log=None):
+    """Deterministic stand-in for a measured trial: a pure function of
+    the knobs (no wall clock), logging measurement order."""
+    def measure(engine, knobs):
+        k = ServingCostModel.complete(knobs)
+        v = (100.0 * k["decode_horizon_steps"] +
+             500.0 * bool(k["prefix_cache"]) + k["num_pages"] / 64.0)
+        if order_log is not None:
+            order_log.append(dict(knobs))
+        return v
+    return measure
+
+
+def test_search_determinism_same_mix_same_seed():
+    """Same mix + same space => identical candidate ranking, identical
+    measurement order, identical winner — the search is a function of
+    its inputs (measurement noise only perturbs the metric values,
+    stubbed out here)."""
+    runs = []
+    for _ in range(2):
+        mix = TrafficMix(name="d", requests=8, seed=7)
+        log = []
+        tuner = ServingAutotuner(
+            mix, tuning_space={"decode_horizon_steps": [1, 4, 8],
+                               "prefix_cache": [False, True]},
+            measure_top_k=4, repeats=2, warmup=1,
+            measure_fn=_fake_measure(log))
+        tuned = tuner.search(engine=None)
+        runs.append((log, tuned["overrides"],
+                     [r["overrides"] for r in tuned["table"]]))
+    assert runs[0] == runs[1]
+    # and the winner is the best-by-metric of the measured set
+    assert runs[0][1] == {"decode_horizon_steps": 8, "prefix_cache": True}
+
+
+def test_search_acceptance_oracle(engine, tmp_path):
+    """The acceptance direction on a real (small) prefix-share mix:
+    the winner's measured tokens/s >= the untuned baseline's (h=1,
+    cache off — measured in the same interleaved pass), and the cost
+    model's ranking correlates positively with the measured ranking.
+    Tolerance: corr > 0 is the pinned direction (documented in
+    docs/autotuning.md — the 4-candidate space separates by 2-4x, far
+    above rig noise), the committed bench section carries the full-size
+    figure."""
+    mix = TrafficMix(name="accept", requests=16, request_rate=1000.0,
+                     decode_len=(4, 10), shared_prefix_len=48,
+                     tail_len=8, shared_fraction=1.0, seed=5)
+    tuner = ServingAutotuner(
+        mix, tuning_space={"decode_horizon_steps": [1, 8],
+                           "prefix_cache": [False, True]},
+        measure_top_k=4, repeats=2, warmup=1,
+        results_path=str(tmp_path / "trials.json"))
+    tuned = tuner.search(engine)
+    table = {tuple(sorted(r["overrides"].items())): r["metric"]
+             for r in tuned["table"]}
+    baseline = table[tuple(sorted(
+        {"decode_horizon_steps": 1, "prefix_cache": False}.items()))]
+    assert tuned["measured_tokens_per_sec"] >= baseline
+    assert tuned["rank_correlation"] is not None
+    assert tuned["rank_correlation"] > 0
+    # trial records persisted: measured + ranked-out/infeasible rows
+    rec = json.load(open(tmp_path / "trials.json"))
+    assert len(rec["trials"]) == 4 and all(
+        "metric" in t or "pruned" in t for t in rec["trials"])
+    # the tuned dict is what ds_serve --tuned-config consumes
+    assert set(DEFAULT_KNOBS) <= set(tuned["knobs"])
+    assert "--decode-horizon" in tuned["ds_serve_args"]
+
+
+def test_rank_correlation_unit():
+    assert rank_correlation([1, 2, 3], [10, 20, 30]) == pytest.approx(1.0)
+    assert rank_correlation([1, 2, 3], [30, 20, 10]) == \
+        pytest.approx(-1.0)
+    assert rank_correlation([1.0, 1.0], [1.0, 2.0]) is None
+    assert rank_correlation([1.0], [1.0]) is None
+    with pytest.raises(ValueError):
+        rank_correlation([1], [1, 2])
+    # TIES AVERAGE (true Spearman): two identically-predicted
+    # candidates must not flip the figure on which of them measured
+    # higher — ordinal argsort ranks would return 1.0 vs 0.8 here
+    a = rank_correlation([100, 100, 200, 300], [90, 110, 200, 300])
+    b = rank_correlation([100, 100, 200, 300], [110, 90, 200, 300])
+    assert a == pytest.approx(b)
+
+
+def test_search_warmup_failure_is_contained():
+    """A candidate that passes the analytic feasibility check but
+    fails at RUNTIME is recorded and dropped (the seed tuner's
+    record-and-skip contract) — one bad candidate must not abort the
+    search for the measurable rest."""
+    def measure(engine, knobs):
+        k = ServingCostModel.complete(knobs)
+        if k["decode_horizon_steps"] == 4:
+            raise RuntimeError("synthetic runtime failure")
+        return 100.0 * k["decode_horizon_steps"]
+    mix = TrafficMix(name="w", requests=8, seed=1)
+    tuner = ServingAutotuner(
+        mix, tuning_space={"decode_horizon_steps": [1, 4, 8]},
+        measure_top_k=3, repeats=1, warmup=1, measure_fn=measure)
+    tuned = tuner.search(engine=None)
+    assert tuned["overrides"] == {"decode_horizon_steps": 8}
+    assert len(tuned["table"]) == 2
+    errors = [r for r in tuner.results if "error" in r]
+    assert len(errors) == 1 and \
+        errors[0]["overrides"] == {"decode_horizon_steps": 4}
+
+
+def test_search_base_knobs_override():
+    """base_knobs pins the unsearched knobs (a bench comparing default
+    vs tuned from a fixed max_pages_per_slot must search FROM it)."""
+    mix = TrafficMix(name="b", requests=8, seed=1)
+    tuner = ServingAutotuner(
+        mix, tuning_space={"decode_horizon_steps": [1, 8]},
+        measure_top_k=2, repeats=1, warmup=0,
+        measure_fn=_fake_measure(),
+        base_knobs={"max_pages_per_slot": 8, "num_pages": 32})
+    tuned = tuner.search(engine=None)
+    assert tuned["knobs"]["max_pages_per_slot"] == 8
+    assert tuned["knobs"]["num_pages"] == 32
+    # the emitted flag line describes the SAME config as "knobs" — not
+    # overrides completed against the library defaults (which would
+    # contradict the base on every unsearched knob)
+    assert "--max-pages-per-slot 8" in tuned["ds_serve_args"]
+    assert "--num-pages 32" in tuned["ds_serve_args"]
+    with pytest.raises(ValueError, match="unknown base knobs"):
+        ServingAutotuner(mix, base_knobs={"bogus": 1})
+
+
+# -------------------------------------------------------- online tuner
+
+def test_online_nudges_token_exact_and_observable(engine):
+    """An online-nudged serving run under real pool pressure is
+    token-exact vs generate() with audit_every=1 (no refcount drift
+    from cache-cap churn), and EVERY nudge is visible: one
+    serving/tune/nudge monitor event + one per-knob gauge + one
+    tune_nudge tracer instant each."""
+    rb = RingBufferMonitor(maxlen=8192)
+    tracer = SpanTracer(process="test")
+    tuner = OnlineTuner(interval=2, low_free_frac=0.6,
+                        high_free_frac=0.9, grow_patience=2, hold=0)
+    sched = ServingScheduler(
+        engine, num_slots=3, num_pages=12, page_size=16,
+        max_pages_per_slot=8, prefill_chunk=8, monitor=rb,
+        prefix_cache=True, online_tuner=tuner, audit_every=1,
+        tracer=tracer)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256,
+                            int(rng.integers(5, 20))).astype(np.int32)
+               for _ in range(8)]
+    max_new = [int(rng.integers(6, 14)) for _ in range(8)]
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    sched.run()
+    want = _oracle(engine, prompts, max_new)
+    for r, w in zip(reqs, want):
+        assert r.state == "finished" and r.out_tokens == w
+    assert tuner.nudge_count >= 1, "the tiny pool must force nudges"
+    nudge_events = [e for e in rb.events
+                    if e[0] == "serving/tune/nudge"]
+    knob_events = [e for e in rb.events
+                   if e[0].startswith("serving/tune/") and
+                   e[0] != "serving/tune/nudge"]
+    assert len(nudge_events) == tuner.nudge_count
+    assert len(knob_events) == tuner.nudge_count
+    instants = [e for e in tracer.events
+                if e[0] == "i" and e[1] == "tune_nudge"]
+    assert len(instants) == tuner.nudge_count
+    assert sched.health()["tune_nudges"] == tuner.nudge_count
+    assert sched.health()["online_tuner"] is True
+    assert sched.metrics.summary()["tune_nudges"] == tuner.nudge_count
+
+
+def test_online_horizon_ladder_shrinks_and_recovers(engine):
+    """Without a cache or spec, pressure walks the horizon bucket
+    ladder down (never outside the construction-time bucket set), and
+    sustained health grows it back to the configured maximum."""
+    tuner = OnlineTuner(interval=1, low_free_frac=0.5,
+                        high_free_frac=0.75, grow_patience=2, hold=0)
+    sched = ServingScheduler(
+        engine, num_slots=3, num_pages=8, page_size=16,
+        max_pages_per_slot=8, prefill_chunk=8,
+        decode_horizon_steps=8, online_tuner=tuner)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 256, 18).astype(np.int32)
+               for _ in range(4)]
+    reqs = [sched.submit(p, max_new_tokens=16) for p in prompts]
+    seen = set()
+    for _ in range(200):
+        seen.add(sched.decode_horizon_steps)
+        if not sched.step():
+            break
+    assert all(r.state == "finished" for r in reqs)
+    assert min(seen) < 8, "pressure must shrink the horizon"
+    assert seen <= set(sched.horizon_buckets) | {8}
+    # idle = healthy: the ladder climbs back to the configured max
+    for _ in range(32):
+        sched.step()
+        if sched.decode_horizon_steps == 8:
+            break
+    assert sched.decode_horizon_steps == 8
+    # shrink + grow nudges both recorded
+    knobs = {k for _, k, _, _ in tuner.nudges}
+    assert "decode_horizon" in knobs
+
+
+def test_online_zero_cost_when_off(engine):
+    """No OnlineTuner => no serving/tune events and compile counts
+    identical across repeat runs; with the tuner on, output tokens are
+    byte-identical and every signature stays inside the
+    construction-time bucket sets (nudges can never add one)."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 256,
+                            int(rng.integers(5, 16))).astype(np.int32)
+               for _ in range(6)]
+    max_new = [int(rng.integers(4, 10)) for _ in range(6)]
+    cfg = dict(num_slots=3, num_pages=12, page_size=16,
+               max_pages_per_slot=8, prefill_chunk=8)
+
+    def run(online, monitor=None, horizon=8):
+        sched = ServingScheduler(engine, monitor=monitor,
+                                 prefix_cache=True, online_tuner=online,
+                                 decode_horizon_steps=horizon, **cfg)
+        reqs = [sched.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, max_new)]
+        sched.run()
+        return sched, [r.out_tokens for r in reqs]
+
+    # warm every horizon bucket this config can dispatch, so compile
+    # counts below measure the TUNER's effect, not first-touch compiles
+    for h in (1, 2, 4, 8):
+        run(False, horizon=h)
+    rb = RingBufferMonitor(maxlen=8192)
+    sched_off, toks_off = run(False, rb)
+    assert not any(t.startswith("serving/tune/")
+                   for t, _, _ in rb.events), \
+        "tuner off must emit no tune events"
+    assert sched_off.health()["online_tuner"] is False
+    counts0 = (engine.serving_decode_multi_compile_count(),
+               engine.serving_decode_compile_count())
+    _, toks_off2 = run(False)
+    counts1 = (engine.serving_decode_multi_compile_count(),
+               engine.serving_decode_compile_count())
+    assert counts0 == counts1, "an off run must not add signatures"
+    tuner = OnlineTuner(interval=1, low_free_frac=0.6, hold=0)
+    sched_on, toks_on = run(tuner)
+    counts2 = (engine.serving_decode_multi_compile_count(),
+               engine.serving_decode_compile_count())
+    assert toks_on == toks_off == toks_off2
+    assert counts2 == counts1, \
+        "nudges stay inside the compiled bucket set — never a new " \
+        "signature"
+
+
+def test_online_tuner_rejects_double_bind(engine):
+    tuner = OnlineTuner()
+    ServingScheduler(engine, num_slots=2, num_pages=8, page_size=16,
+                     max_pages_per_slot=4, online_tuner=tuner)
+    with pytest.raises(ValueError, match="already bound"):
+        ServingScheduler(engine, num_slots=2, num_pages=8, page_size=16,
+                         max_pages_per_slot=4, online_tuner=tuner)
+
+
+def test_scheduler_tuned_from_provenance(engine):
+    sched = ServingScheduler(engine, num_slots=2, num_pages=8,
+                             page_size=16, max_pages_per_slot=4,
+                             tuned_from="tuned_config.json")
+    h = sched.health()
+    assert h["tuned_from"] == "tuned_config.json"
+    assert h["online_tuner"] is False and h["tune_nudges"] == 0
+
+
+def test_ds_serve_args_line():
+    line = ds_serve_args({"decode_horizon_steps": 4, "prefix_cache": True,
+                          "prefix_cache_pages": 24, "spec_decode": "ngram",
+                          "spec_k": 16, "overlap": False})
+    assert "--decode-horizon 4" in line
+    assert "--prefix-cache " in line + " "
+    assert "--prefix-cache-pages 24" in line
+    assert "--spec-decode ngram" in line and "--spec-k 16" in line
+    assert "--no-overlap" in line
+    off = ds_serve_args({"prefix_cache": False})
+    assert "--no-prefix-cache" in off and "--spec-decode off" in off
+
+
+# --------------------------------------------- seed autotuner fixes
+
+def test_seed_autotuner_persist_merges_existing_file(tmp_path):
+    """_persist merges into an existing results file (the PR-4
+    --json-out pattern): foreign top-level keys another run wrote
+    survive a tuner write; only space/trials are replaced."""
+    path = tmp_path / "results.json"
+    with open(path, "w") as f:
+        json.dump({"foreign_section": {"keep": "me"},
+                   "trials": [{"overrides": {"old": 1}, "metric": 1.0}]},
+                  f)
+    tuner = Autotuner({}, tuning_space={"k": [1, 2]},
+                      results_path=str(path))
+    tuner.tune(lambda cfg: float(cfg["k"]))
+    out = json.load(open(path))
+    assert out["foreign_section"] == {"keep": "me"}
+    assert len(out["trials"]) == 2
+    assert out["space"] == {"k": [1, 2]}
+    # a corrupt existing file degrades to a fresh write, not a crash
+    with open(path, "w") as f:
+        f.write("{not json")
+    tuner2 = Autotuner({}, tuning_space={"k": [3]},
+                       results_path=str(path))
+    tuner2.tune(lambda cfg: 1.0)
+    assert json.load(open(path))["space"] == {"k": [3]}
+
+
+def test_seed_autotuner_timing_survives_wall_clock_jump(monkeypatch):
+    """Trial timing rides time.monotonic() (the PR-2 policy): an NTP
+    wall-clock step mid-trial must not produce negative or wild
+    trial_seconds."""
+    import time as time_mod
+    wild = iter([1e9, 1e9 - 3600.0, 1e9 + 7200.0, 1e9 - 86400.0] * 10)
+    monkeypatch.setattr(time_mod, "time", lambda: next(wild))
+    tuner = Autotuner({}, tuning_space={"k": [1, 2]})
+    _, _, best = tuner.tune(lambda cfg: float(cfg["k"]))
+    assert best == 2.0
+    for rec in tuner.results:
+        assert 0.0 <= rec["trial_seconds"] < 60.0, rec

@@ -106,6 +106,20 @@ CHECKS = [
      "info", None),
     ("tracing tokens/s (on)", "tracing.trace_on.tokens_per_sec",
      "info", None),
+    # quantized-serving-memory rows (PR 14): the capacity ratio is pure
+    # page arithmetic over committed byte figures (deterministic — a
+    # gate CANDIDATE once a couple of CI rounds confirm it never moves
+    # off its 3.2x), the equal-byte capacity speedup and the same-slots
+    # int8-vs-fp32 tokens/s ratio are CPU-rig dequant prices that a TPU
+    # kernel run will re-anchor — info first, per the PR-8/11 pattern
+    ("kv-quant capacity ratio (pages @ equal bytes)",
+     "kv_quant.capacity.capacity_ratio", "info", None),
+    ("kv-quant capacity speedup (equal bytes)",
+     "kv_quant.capacity.speedup_tokens_per_sec", "info", None),
+    ("kv-quant same-slots int8 vs fp32 tokens/s",
+     "kv_quant.same_slots.speedup_tokens_per_sec", "info", None),
+    ("kv-quant int8 tokens/s (equal bytes)",
+     "kv_quant.capacity.int8.tokens_per_sec", "info", None),
 ]
 
 TRACING_OVERHEAD_CEILING = 0.05   # the committed <5% contract

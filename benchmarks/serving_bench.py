@@ -114,7 +114,7 @@ def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
                    prefix_cache_pages=None, spec_decode=None,
                    spec_k=8, retry_max=6, retry_backoff_s=0.05,
                    tracer=None, mem_telemetry=False, comm_telemetry=False,
-                   sched_out=None):
+                   kv_dtype=None, sched_out=None):
     from deepspeed_tpu.serving import QueueFull, ServingScheduler
     sched = ServingScheduler(
         engine, num_slots=cfg["num_slots"], num_pages=cfg["num_pages"],
@@ -126,7 +126,7 @@ def run_continuous(engine, prompts, max_new, arrivals, cfg, horizon=8,
         prefix_cache_pages=prefix_cache_pages,
         spec_decode=spec_decode, spec_k=spec_k,
         tracer=tracer, mem_telemetry=mem_telemetry,
-        comm_telemetry=comm_telemetry)
+        comm_telemetry=comm_telemetry, kv_dtype=kv_dtype)
     if sched_out is not None:
         sched_out.append(sched)
     t0 = time.time()
@@ -618,6 +618,114 @@ def run_mem_overhead(engine, vocab, cfg, args, horizon, overlap):
     return section
 
 
+_KVQ_KEYS = ("tokens_per_sec", "wall_s", "tokens", "ttft_ms_p50",
+             "ttft_ms_p99", "tbt_ms_p50", "tpot_ms_p50", "preemptions",
+             "page_util_peak", "queue_full_retries")
+
+
+def run_kv_quant(engine, vocab, cfg, args, horizon, overlap):
+    """``--kv-quant``: the quantized-serving-memory scorecard.
+
+    Two legs, both against the fp32 baseline at identical settings:
+
+    * **same_slots** — the standard mixed workload with pool geometry
+      UNCHANGED, fp32 vs int8 (vs fp8 where the runtime has it),
+      INTERLEAVED best-of repeats (PR-8 methodology).  On the CPU rig
+      this prices the dequant work honestly (quantization is a
+      capacity lever here, not a speed claim — the TPU kernel path is
+      where the bandwidth win cashes out).
+    * **capacity** — pool BYTES held constant at the fp32 config's
+      footprint while pages and slots grow to what each dtype's
+      bytes-per-page affords, served against a high-concurrency
+      workload.  The committed ``capacity_ratio`` (pages per byte
+      budget, from the same kv_page_bytes arithmetic the allocator
+      bills) and the per-dtype preemption/tokens-per-sec rows are what
+      perf_floor.py checks; the acceptance test re-proves the ratio
+      against live device pools.
+    """
+    from deepspeed_tpu.ops.quant.kv import fp8_supported
+    dtypes = ["float32", "int8"] + (["fp8"] if fp8_supported() else [])
+    bpp = {d: engine.kv_page_bytes(cfg["page_size"], kv_dtype=d)
+           for d in dtypes}
+    budget = cfg["num_pages"] * bpp["float32"]
+    section = {
+        "model": args.model, "requests": args.requests, "rate": args.rate,
+        "serving_config": cfg, "overlap": overlap, "horizon": horizon,
+        "page_bytes": bpp, "pool_bytes_budget": budget,
+    }
+
+    # ---- same-slots throughput A/B (geometry fixed, dtype varies)
+    prompts, max_new, arrivals = make_workload(
+        vocab, args.requests, args.rate, args.seed)
+    for d in dtypes:                          # warmup: compiles untimed
+        run_continuous(engine, prompts, max_new, arrivals, cfg,
+                       horizon=horizon, overlap=overlap, kv_dtype=d)
+    results = {}
+    for _ in range(max(1, args.repeats)):
+        for d in dtypes:
+            cand = run_continuous(engine, prompts, max_new, arrivals,
+                                  cfg, horizon=horizon, overlap=overlap,
+                                  kv_dtype=d)
+            best = results.get(d)
+            if best is None or cand["tokens_per_sec"] > \
+                    best["tokens_per_sec"]:
+                results[d] = cand
+    same = {d: {k: r[k] for k in _KVQ_KEYS if k in r}
+            for d, r in results.items()}
+    f32 = results["float32"]["tokens_per_sec"]
+    same["speedup_tokens_per_sec"] = round(
+        results["int8"]["tokens_per_sec"] / f32, 3) if f32 else None
+    section["same_slots"] = same
+
+    # ---- equal-byte capacity sweep (bytes pinned, pages/slots grow)
+    # ONE workload for every dtype — higher concurrency than the pool
+    # baseline can hold (capacity is only visible under load that
+    # wants it), and byte-identical across dtypes by construction
+    cprompts, cmax_new, carrivals = make_workload(
+        vocab, args.requests, args.rate * 4, args.seed + 1)
+    cap = {}
+    for d in dtypes:
+        pages_d = int(budget // bpp[d])
+        scale = pages_d / cfg["num_pages"]
+        cfg_d = dict(cfg, num_pages=pages_d,
+                     num_slots=max(cfg["num_slots"],
+                                   int(cfg["num_slots"] * scale)))
+        run_continuous(engine, cprompts, cmax_new, carrivals, cfg_d,
+                       horizon=horizon, overlap=overlap, kv_dtype=d)
+        best = None
+        for _ in range(max(1, args.repeats)):
+            cand = run_continuous(engine, cprompts, cmax_new, carrivals,
+                                  cfg_d, horizon=horizon,
+                                  overlap=overlap, kv_dtype=d)
+            if best is None or cand["tokens_per_sec"] > \
+                    best["tokens_per_sec"]:
+                best = cand
+        cap[d] = {"num_pages": pages_d, "num_slots": cfg_d["num_slots"],
+                  "pool_bytes": pages_d * bpp[d],
+                  **{k: best[k] for k in _KVQ_KEYS if k in best}}
+    cap["capacity_ratio"] = round(
+        cap["int8"]["num_pages"] / cap["float32"]["num_pages"], 3)
+    cap["speedup_tokens_per_sec"] = round(
+        cap["int8"]["tokens_per_sec"] / cap["float32"]["tokens_per_sec"],
+        3) if cap["float32"]["tokens_per_sec"] else None
+    section["capacity"] = cap
+
+    print(json.dumps({
+        "metric": "serving_kv_quant_capacity_ratio",
+        "value": cap["capacity_ratio"], "unit": "x",
+        "extra": {"same_slots_speedup": same["speedup_tokens_per_sec"],
+                  "capacity_speedup": cap["speedup_tokens_per_sec"],
+                  "page_bytes": bpp, "budget": budget},
+    }))
+    if args.json_out:
+        _write_json_out(
+            args.json_out, "kv_quant", section,
+            {"model": args.model, "requests": args.requests,
+             "rate": args.rate, "serving_config": cfg,
+             "overlap": overlap, "kv_quant": section})
+    return section
+
+
 # the comm off/on sections report the same per-run schema as tracing
 _COMM_KEYS = _TRACE_KEYS
 
@@ -1076,6 +1184,13 @@ def main():
                    help="counter-track Chrome trace destination for "
                         "--mem (empty string disables the extra traced "
                         "pass)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="quantized paged-KV scorecard: same-slots "
+                        "fp32-vs-int8(-vs-fp8) throughput A/B with "
+                        "interleaved best-of repeats, plus the "
+                        "equal-pool-bytes capacity sweep (pages/slots "
+                        "grow to what each dtype's bytes-per-page "
+                        "affords); committed as the kv_quant section")
     p.add_argument("--comm", action="store_true",
                    help="run the comm-telemetry workload instead: the "
                         "standard mixed workload with the HLO comm "
@@ -1161,6 +1276,10 @@ def main():
     if args.comm:
         run_comm_overhead(engine, vocab, cfg, args, max(horizons),
                           overlap)
+        return
+
+    if args.kv_quant:
+        run_kv_quant(engine, vocab, cfg, args, max(horizons), overlap)
         return
 
     # warmup: compile every signature both systems will hit (the serving

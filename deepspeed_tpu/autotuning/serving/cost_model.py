@@ -72,6 +72,12 @@ DEFAULT_KNOBS = {
     "prefix_cache_pages": None,        # cache default: whole pool
     "spec_decode": None,
     "spec_k": 8,
+    # quantized serving memory (PR 14): the paged-KV pool dtype (a
+    # SCHEDULER knob — measurable per trial on one engine) and the
+    # weight storage dtype (an ENGINE knob — priced and emitted as a
+    # ds_serve flag, never varied inside a measured search)
+    "kv_dtype": "float32",
+    "weight_dtype": None,              # None = follow the engine dtype
 }
 
 # nominal interconnect bandwidth for the comm term (bytes/s per
@@ -98,7 +104,8 @@ class ServingCostModel:
     and prune/rank candidate knob dicts for the measured search."""
 
     def __init__(self, mix, bench=None, bench_path=None,
-                 live_signals=None):
+                 live_signals=None, geometry=None,
+                 pool_bytes_budget=None):
         self.mix = mix
         if bench is None:
             bench_path = bench_path or committed_bench_path()
@@ -106,8 +113,44 @@ class ServingCostModel:
                 bench = json.load(f)
         self.bench = bench
         self.live = dict(live_signals or {})
+        # quantized-memory page arithmetic: with the model's KV
+        # geometry ({"num_layers", "kv_heads", "head_dim"}) the model
+        # prices candidates in BYTES per page — dtype-dependent — and,
+        # given a pool byte budget (the HBM the operator is willing to
+        # spend), prunes any candidate whose num_pages x
+        # bytes_per_page(kv_dtype) exceeds it.  int8/fp8 candidates
+        # therefore fit ~2-4x the pages of fp32 in the same budget,
+        # which the pressure term then converts into throughput.
+        self.geometry = dict(geometry) if geometry else None
+        self.pool_bytes_budget = None if pool_bytes_budget is None \
+            else int(pool_bytes_budget)
         self._fit_horizon_curve()
         self._fit_reference_terms()
+
+    def page_bytes(self, knobs):
+        """Bytes one KV page costs under this candidate's kv_dtype
+        (None without geometry) — the exact ops/quant/kv.kv_page_bytes
+        arithmetic, so pruning agrees with allocation to the byte."""
+        if self.geometry is None:
+            return None
+        from deepspeed_tpu.ops.quant.kv import kv_page_bytes
+        k = self.complete(knobs) if "kv_dtype" not in knobs or \
+            "page_size" not in knobs else knobs
+        dtype = k.get("kv_dtype") or "float32"
+        if dtype not in ("int8", "fp8"):
+            import jax.numpy as jnp
+            floats = dict(float32=jnp.float32, bfloat16=jnp.bfloat16,
+                          float16=jnp.float16)
+            if dtype not in floats:
+                # pricing an unknown name as fp32 would silently skew
+                # every byte figure built on it — reject like the
+                # allocator would
+                raise ValueError(f"unknown kv_dtype {dtype!r}")
+            dtype = floats[dtype]
+        return kv_page_bytes(self.geometry["num_layers"],
+                             self.geometry["kv_heads"],
+                             self.geometry["head_dim"],
+                             k["page_size"], dtype)
 
     # ------------------------------------------------------------ fitting
     def _fit_horizon_curve(self):
@@ -161,6 +204,13 @@ class ServingCostModel:
         self._comm_bytes_per_token = float(
             self.live.get("comm_bytes_per_token",
                           comm.get("bytes_per_token") or 0.0))
+        # quantized-KV throughput factor at EQUAL slots, from the
+        # committed kv_quant same-slots A/B (1.0 when the section is
+        # absent — capacity, not speed, is the quantization claim on
+        # the CPU rig; a real-TPU bench refit sharpens this)
+        kvq = bench.get("kv_quant", {}).get("same_slots", {})
+        self._kv_quant_speed_ref = float(
+            kvq.get("speedup_tokens_per_sec") or 1.0)
 
     # ------------------------------------------------------- feasibility
     @staticmethod
@@ -194,6 +244,21 @@ class ServingCostModel:
                     f"{pages_needed} pages > min(max_pages_per_slot="
                     f"{k['max_pages_per_slot']}, num_pages="
                     f"{k['num_pages']}) = {slot_cap}")
+        if k["kv_dtype"] not in (None, "float32", "bfloat16", "float16",
+                                 "int8", "fp8"):
+            return f"unknown kv_dtype {k['kv_dtype']!r}"
+        # bytes-per-page is dtype-dependent now: with a pool byte
+        # budget, a candidate's page count must FIT it under its own
+        # kv_dtype's page bytes (the same arithmetic the allocator
+        # bills — a pruned candidate provably over-allocates)
+        if self.pool_bytes_budget is not None:
+            bpp = self.page_bytes(k)
+            if bpp is not None and k["num_pages"] * bpp > \
+                    self.pool_bytes_budget:
+                return (f"{k['num_pages']} pages x {bpp} B/page "
+                        f"(kv_dtype={k['kv_dtype']}) = "
+                        f"{k['num_pages'] * bpp} B exceeds the pool "
+                        f"budget of {self.pool_bytes_budget} B")
         return None
 
     # -------------------------------------------------------- prediction
@@ -274,12 +339,18 @@ class ServingCostModel:
         # committed CPU rig and unfitted — a mild documented prior, the
         # same for every candidate pair that differs only here
         overlap = 1.0 if k["overlap"] else 0.95
+        # quantized KV at equal slots: the committed same-slots A/B
+        # anchors the factor (1.0 with no committed section — on the
+        # CPU rig quantization is a CAPACITY lever, priced through the
+        # pressure term below, not a speed claim)
+        kvq = self._kv_quant_speed_ref \
+            if k["kv_dtype"] in ("int8", "fp8") else 1.0
         demand, pages_per_req = self._page_demand(k)
         pressure = min(1.0, k["num_pages"] / demand) if demand else 1.0
         # under demand > capacity the scheduler shrinks horizons and
         # evicts: discount toward the measured H=1 regime floor
         pressure = max(pressure, 0.25)
-        rate = base * prefix * spec * overlap * pressure
+        rate = base * prefix * spec * overlap * pressure * kvq
         comm = 1.0
         if self._comm_bytes_per_token > 0:
             comm = 1.0 / (1.0 + self._comm_bytes_per_token * rate
@@ -313,6 +384,8 @@ class ServingCostModel:
                       "overlap_factor": overlap,
                       "pressure_factor": round(pressure, 3),
                       "comm_factor": round(comm, 4),
+                      "kv_quant_factor": round(kvq, 3),
+                      "page_bytes": self.page_bytes(k),
                       "page_demand": demand},
         }
 

@@ -46,6 +46,12 @@ DEFAULT_SERVING_SPACE = {
     "decode_horizon_steps": [1, 4, 8],
     "prefix_cache": [False, True],
     "num_pages": [64, 128],
+    # the paged-pool dtype is a per-scheduler knob, so trials vary it
+    # on the one engine; the analytic pruner prices its bytes-per-page
+    # (an int8 candidate fits ~2-4x the pages in a byte budget).
+    # weight_dtype is deliberately NOT searched — it is engine state,
+    # priced + emitted as a ds_serve flag instead.
+    "kv_dtype": ["float32", "int8"],
 }
 
 
@@ -108,6 +114,10 @@ def ds_serve_args(knobs):
     parts.append(f"--spec-decode {mode if mode not in (None, False) else 'off'}")
     if mode not in (None, False, "off"):
         parts.append(f"--spec-k {k['spec_k']}")
+    if k["kv_dtype"] not in (None, "float32"):
+        parts.append(f"--kv-dtype {k['kv_dtype']}")
+    if k["weight_dtype"] is not None:
+        parts.append(f"--weight-dtype {k['weight_dtype']}")
     return " ".join(parts)
 
 
@@ -168,6 +178,7 @@ class ServingAutotuner(Autotuner):
             overlap=k["overlap"], prefix_cache=k["prefix_cache"],
             prefix_cache_pages=k["prefix_cache_pages"],
             spec_decode=k["spec_decode"], spec_k=k["spec_k"],
+            kv_dtype=k["kv_dtype"],
             # a mixed-temperature mix serves sampled (the scheduler's
             # sampling is loop-level; spec disables itself there)
             do_sample=sampled_mode, temperature=0.7 if sampled_mode

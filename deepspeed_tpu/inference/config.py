@@ -82,3 +82,13 @@ class DeepSpeedInferenceConfig(BaseModel):
             self.quant.enabled = True
             name = "bfloat16"
         object.__setattr__(self, "dtype", name)
+        # kv_cache_dtype takes the same float aliases PLUS the quantized
+        # paged-pool dtypes: "int8" / "fp8" (e4m3) store int8/fp8 KV
+        # pages with parallel per-row f32 scale pools (ops/quant/kv.py);
+        # unlike dtype, kv "int8" is NOT weight quantization — the two
+        # knobs are independent
+        kv = str(self.kv_cache_dtype).lower().replace("torch.", "")
+        kv_aliases = dict(aliases, fp8="fp8", float8="fp8",
+                          float8_e4m3fn="fp8")
+        object.__setattr__(self, "kv_cache_dtype",
+                           kv_aliases.get(kv, kv))

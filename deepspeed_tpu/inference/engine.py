@@ -104,17 +104,32 @@ class InferenceEngine:
         self._serving_shd = None
         self._validate_mesh_for_model()
 
-        for field, val in (("dtype", config.dtype),
-                           ("kv_cache_dtype", config.kv_cache_dtype)):
-            if val not in DTYPES:
-                hint = "; dtype='int8' (weight-only quantization) is " \
-                    "accepted via init_inference/DeepSpeedInferenceConfig" \
-                    if field == "dtype" else ""
-                raise ValueError(
-                    f"unsupported inference {field} {val!r}; pick one of "
-                    f"{sorted(DTYPES)}{hint}")
+        from deepspeed_tpu.ops.quant.kv import (KV_QUANT_DTYPES,
+                                                kv_storage_dtype)
+        if config.dtype not in DTYPES:
+            raise ValueError(
+                f"unsupported inference dtype {config.dtype!r}; pick one "
+                f"of {sorted(DTYPES)}; dtype='int8' (weight-only "
+                "quantization) is accepted via init_inference/"
+                "DeepSpeedInferenceConfig")
+        if config.kv_cache_dtype not in DTYPES and \
+                config.kv_cache_dtype not in KV_QUANT_DTYPES:
+            raise ValueError(
+                f"unsupported inference kv_cache_dtype "
+                f"{config.kv_cache_dtype!r}; pick one of "
+                f"{sorted(DTYPES) + sorted(KV_QUANT_DTYPES)}")
         self.dtype = DTYPES[config.dtype]
-        self.kv_dtype = DTYPES[config.kv_cache_dtype]
+        # kv_dtype is either a jnp dtype (float pools) or the quantized
+        # kv-dtype NAME ("int8"/"fp8" — the paged pools then carry
+        # int8/fp8 payload + parallel f32 scale pools, ops/quant/kv.py);
+        # fp8 runtime support is validated HERE, at construction, not on
+        # the first serving dispatch
+        if config.kv_cache_dtype in KV_QUANT_DTYPES:
+            kv_storage_dtype(config.kv_cache_dtype)   # runtime gate
+            self.kv_dtype = config.kv_cache_dtype
+        else:
+            self.kv_dtype = DTYPES[config.kv_cache_dtype]
+        self.kv_dtype_name = config.kv_cache_dtype
         self._rng = jax.random.PRNGKey(seed)
         self._model_times = []
         self.params = None
@@ -311,11 +326,11 @@ class InferenceEngine:
                 dev = dev.astype(self.dtype)
             key = jax.tree_util.keystr(path)
             if quantize and self._quant_leaf_predicate(key) and \
-                    _eligible(dev, qcfg.group_size):
+                    _eligible(dev):
                 qv, scale = q(dev, bits=qcfg.num_bits,
                               group_size=qcfg.group_size)
                 out.append(QTensor(host(qv), host(scale), dev.dtype,
-                                   qcfg.num_bits))
+                                   qcfg.num_bits, qcfg.group_size))
             else:
                 out.append(host(dev))
             del dev
@@ -360,6 +375,13 @@ class InferenceEngine:
                  f"{' +host-offload' if offload else ''}, "
                  f"tp={self.mp_world_size}", ranks=[0])
         return self
+
+    @property
+    def weight_dtype_name(self):
+        """Canonical weight-storage dtype for operator surfaces
+        (health(), ds_serve startup log): "int8" under weight-only
+        quantization, else the compute dtype name."""
+        return "int8" if self._config.quant.enabled else self._config.dtype
 
     @staticmethod
     def _quant_leaf_predicate(path):
@@ -457,7 +479,7 @@ class InferenceEngine:
             for pth, leaf in flat:
                 key = jax.tree_util.keystr(pth)
                 if quant and self._quant_leaf_predicate(key) and \
-                        _eligible(leaf, qcfg.group_size):
+                        _eligible(leaf):
                     dev = jax.device_put(
                         leaf, leaf.sharding.with_memory_kind("device"))
                     qv, scale = q(dev, bits=qcfg.num_bits,
@@ -465,7 +487,7 @@ class InferenceEngine:
                     host = lambda x: jax.device_put(
                         x, x.sharding.with_memory_kind("pinned_host"))
                     out.append(QTensor(host(qv), host(scale), dev.dtype,
-                                       qcfg.num_bits))
+                                       qcfg.num_bits, qcfg.group_size))
                     del dev
                 else:
                     out.append(leaf)
@@ -537,9 +559,16 @@ class InferenceEngine:
 
     def _init_cache(self, batch_size, max_len):
         from deepspeed_tpu.models import gpt2, llama
+        from deepspeed_tpu.ops.quant.kv import is_quantized_kv
         mod = llama if isinstance(self.module, llama.Llama) else gpt2
+        # quantized kv_dtype applies to the PAGED serving pools only;
+        # generate()'s dense cache stays fp32 — generate() is the
+        # divergence oracle the quantized serving path is measured
+        # against, so it must not quantize out from under that contract
+        dt = jnp.float32 if is_quantized_kv(self.kv_dtype) \
+            else self.kv_dtype
         return mod.init_kv_cache(self.module.cfg, batch_size,
-                                 max_len=max_len, dtype=self.kv_dtype)
+                                 max_len=max_len, dtype=dt)
 
     def _build_gen_fns(self):
         module = self.module
@@ -605,7 +634,7 @@ class InferenceEngine:
             "paged serving needs a KV-cache model contract (GPT2/Llama); "
             f"got {type(self.module).__name__}")
 
-    def init_paged_cache(self, num_pages, page_size):
+    def init_paged_cache(self, num_pages, page_size, kv_dtype=None):
         """Device-resident per-layer K/V page pools, committed to the
         serving pool sharding (kv_heads over ``model``, page ids
         global). The page table, lengths and active mask are host-owned
@@ -613,14 +642,53 @@ class InferenceEngine:
         Built INSIDE a jit so the pools carry the same committed
         sharding as the pools the serving primitives return — otherwise
         the first prefill/decode call compiles a second signature just
-        for the uncommitted zeros."""
+        for the uncommitted zeros.
+
+        ``kv_dtype`` overrides the engine's configured kv_cache_dtype
+        for THIS pool (the serving autotuner varies the knob per trial
+        scheduler without rebuilding engines): a float name from
+        ``DTYPES`` or a quantized name ("int8"/"fp8") — quantized pools
+        add parallel f32 scale leaves, all four under the one pool-axis
+        sharding (the scale leaf keeps rank 4, trailing dim 1, exactly
+        so the single NamedSharding broadcasts)."""
+        from deepspeed_tpu.ops.quant.kv import (KV_QUANT_DTYPES,
+                                                kv_storage_dtype)
         mod = self._paged_module()
-        cfg, dt = self.module.cfg, self.kv_dtype
+        cfg = self.module.cfg
+        dt = self.kv_dtype if kv_dtype is None else kv_dtype
+        if isinstance(dt, str):
+            if dt in DTYPES:
+                dt = DTYPES[dt]
+            elif dt in KV_QUANT_DTYPES:
+                kv_storage_dtype(dt)   # fp8 runtime gate
+            else:
+                # a raw CLI path (worker --kv-dtype) can reach here
+                # without the config-level alias normalization: fail
+                # with the crisp message, not a jnp.zeros TypeError
+                # from inside the pool-init jit
+                raise ValueError(
+                    f"unsupported kv_dtype {dt!r}; pick one of "
+                    f"{sorted(DTYPES) + sorted(KV_QUANT_DTYPES)}")
         pool_sh = self._serving_shardings().pool
         with dist.mesh_scope(self.mesh):
             return jax.jit(lambda: mod.init_paged_kv_cache(
                 cfg, num_pages, page_size, dtype=dt),
                 out_shardings=pool_sh)()
+
+    def kv_page_bytes(self, page_size, kv_dtype=None):
+        """Exact bytes ONE paged-KV page costs across all layers (K+V
+        payload + the f32 scale rows of a quantized pool) — the unit
+        the capacity ledgers and the autotuner's feasibility arithmetic
+        bill in.  Agrees with the allocated leaves' nbytes to the byte
+        (pinned by tests/unit/test_kv_quant.py)."""
+        from deepspeed_tpu.ops.quant import kv as kvq
+        cfg = self.module.cfg
+        heads, kv_heads = self._model_head_counts()
+        dt = self.kv_dtype if kv_dtype is None else kv_dtype
+        if isinstance(dt, str) and dt in DTYPES:
+            dt = DTYPES[dt]
+        return kvq.kv_page_bytes(cfg.num_layers, kv_heads or heads,
+                                 cfg.head_dim, page_size, dt)
 
     def _build_serving_fns(self):
         module = self.module
@@ -790,14 +858,17 @@ class InferenceEngine:
             # a page copy moves one index of the GLOBAL page dim; the
             # kv-head shards copy in place on their own devices (no
             # cross-device traffic), so the pool sharding is pinned
-            # through like every other primitive
-            pool_sh = self._serving_shardings().pool
-
+            # through like every other primitive.  Copying EVERY leaf of
+            # the layer dict (not just k/v payload) is what keeps a
+            # quantized pool's per-row scales welded to their page: a
+            # COW copy that moved payload without scales would dequantize
+            # the private copy with the ORIGINAL page's scales forever
             def copy(pools, src, dst):
                 return {"layers": [
-                    {"k_pages": L["k_pages"].at[dst].set(L["k_pages"][src]),
-                     "v_pages": L["v_pages"].at[dst].set(L["v_pages"][src])}
+                    {name: arr.at[dst].set(arr[src])
+                     for name, arr in L.items()}
                     for L in pools["layers"]]}
+            pool_sh = self._serving_shardings().pool
 
             self._copy_page_fn = jax.jit(copy, donate_argnums=(0,),
                                          out_shardings=pool_sh)

@@ -146,8 +146,9 @@ class SelfAttention(nn.Module):
             assert self.window == 0, \
                 "paged serving does not support local attn_windows yet"
             from deepspeed_tpu.ops.attention import (decode_attention,
-                                                     gather_pages,
                                                      paged_decode_attention)
+            from deepspeed_tpu.ops.quant.kv import (paged_gather,
+                                                    paged_write)
             k_pages, v_pages = cache["k_pages"], cache["v_pages"]
             num_pages, ps = k_pages.shape[0], k_pages.shape[1]
             pt = cache["page_table"]                     # [slots, maxp]
@@ -173,12 +174,15 @@ class SelfAttention(nn.Module):
                 pos = positions[0]                       # [l]
                 valid = jnp.arange(l) < cache["n_valid"]
                 page_ids = jnp.where(valid, pt[slot, pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k[0].astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v[0].astype(v_pages.dtype), mode="drop")
-                k_slot = gather_pages(k_pages, pt[slot][None])
-                v_slot = gather_pages(v_pages, pt[slot][None])
+                # write through the (possibly int8/fp8-quantized) pool:
+                # quantized pools carry parallel per-row scale pools
+                # that the same masked page ids update atomically
+                # (ops/quant/kv.py); float pools take the byte-identical
+                # legacy path
+                pools_out = paged_write(cache, page_ids, pos % ps,
+                                        k[0], v[0])
+                k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
+                                              q.dtype)
                 mask = k_pos[None, None, :] <= positions[:, :, None]
                 bias = jnp.where(mask, 0.0,
                                  jnp.finfo(jnp.float32).min)[:, None]
@@ -201,12 +205,8 @@ class SelfAttention(nn.Module):
                 write = jnp.arange(l)[None, :] < widths[:, None]
                 page_ids = jnp.where(
                     write, pt[jnp.arange(b)[:, None], pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k.astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v.astype(v_pages.dtype), mode="drop")
-                k_slot = gather_pages(k_pages, pt)
-                v_slot = gather_pages(v_pages, pt)
+                pools_out = paged_write(cache, page_ids, pos % ps, k, v)
+                k_slot, v_slot = paged_gather(pools_out, pt, q.dtype)
                 mask = k_pos[None, None, :] <= pos[:, :, None]
                 bias = jnp.where(mask, 0.0,
                                  jnp.finfo(jnp.float32).min)[:, None]
@@ -220,19 +220,21 @@ class SelfAttention(nn.Module):
                 pos = positions[:, 0]                    # [slots]
                 page_ids = jnp.where(active,
                                      pt[jnp.arange(b), pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k[:, 0].astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v[:, 0].astype(v_pages.dtype), mode="drop")
-                out = paged_decode_attention(q, k_pages, v_pages, pt, pos,
-                                             bias=alibi)
+                pools_out = paged_write(cache, page_ids, pos % ps,
+                                        k[:, 0], v[:, 0])
+                out = paged_decode_attention(
+                    q, pools_out["k_pages"], pools_out["v_pages"], pt,
+                    pos, bias=alibi,
+                    k_scale=pools_out.get("k_scale"),
+                    v_scale=pools_out.get("v_scale"))
             # multi-chip serving: pin the pools' kv-head sharding on the
             # updated arrays so GSPMD keeps the scatter/gather split over
-            # the `model` axis (no-op on a single-device mesh)
+            # the `model` axis (no-op on a single-device mesh); the
+            # quantized scale pools share the payload's [pages, ps,
+            # kv_heads, 1] axis family and pin identically
             from deepspeed_tpu.serving.sharding import constrain_kv_pages
-            k_pages = constrain_kv_pages(k_pages)
-            v_pages = constrain_kv_pages(v_pages)
-            new_cache = {"k_pages": k_pages, "v_pages": v_pages}
+            new_cache = {name: constrain_kv_pages(arr)
+                         for name, arr in pools_out.items()}
         elif cache is not None:
             # decode: append k/v at cache["index"], attend over the valid
             # prefix with a positional mask (same scheme as models/llama.py)
@@ -655,13 +657,13 @@ def init_paged_kv_cache(cfg: GPTConfig, num_pages, page_size,
     """Per-layer paged KV pools (serving/ subsystem): ``num_pages`` fixed
     pages of ``page_size`` tokens shared by every live sequence through a
     page table. The table/lengths/active arrays are host-owned (the
-    scheduler passes them per call); only the pools live here."""
-    layer = lambda: {
-        "k_pages": jnp.zeros((num_pages, page_size, cfg.num_heads,
-                              cfg.head_dim), dtype),
-        "v_pages": jnp.zeros((num_pages, page_size, cfg.num_heads,
-                              cfg.head_dim), dtype),
-    }
+    scheduler passes them per call); only the pools live here.
+    ``dtype`` may be a quantized kv-dtype name ("int8"/"fp8"): the
+    layer then carries int8/fp8 payload pools plus parallel per-row f32
+    scale pools (ops/quant/kv.py storage contract)."""
+    from deepspeed_tpu.ops.quant.kv import paged_pool_layer
+    layer = lambda: paged_pool_layer(num_pages, page_size, cfg.num_heads,
+                                     cfg.head_dim, dtype)
     return {"layers": [layer() for _ in range(cfg.num_layers)]}
 
 

@@ -98,8 +98,9 @@ class LlamaAttention(nn.Module):
             # pools [num_pages, page_size, kv_h, d] shared via a per-slot
             # page table; GQA pools stay grouped end to end
             from deepspeed_tpu.ops.attention import (decode_attention,
-                                                     gather_pages,
                                                      paged_decode_attention)
+            from deepspeed_tpu.ops.quant.kv import (paged_gather,
+                                                    paged_write)
             k_pages, v_pages = cache["k_pages"], cache["v_pages"]
             num_pages, ps = k_pages.shape[0], k_pages.shape[1]
             pt = cache["page_table"]
@@ -110,17 +111,18 @@ class LlamaAttention(nn.Module):
                 # boundary: rotary offsets follow the positions array,
                 # writes never touch shared read-only pages below the
                 # boundary, and the copy-on-write tail page's stale
-                # region is overwritten-before-gather or masked
+                # region is overwritten-before-gather or masked.
+                # paged_write quantizes to int8/fp8 pools (with parallel
+                # per-row scale pools) when the cache carries them;
+                # float pools take the byte-identical legacy path
                 slot = cache["slot"]
                 pos = positions[0]
                 valid = jnp.arange(l) < cache["n_valid"]
                 page_ids = jnp.where(valid, pt[slot, pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k[0].astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v[0].astype(v_pages.dtype), mode="drop")
-                k_slot = gather_pages(k_pages, pt[slot][None])
-                v_slot = gather_pages(v_pages, pt[slot][None])
+                pools_out = paged_write(cache, page_ids, pos % ps,
+                                        k[0], v[0])
+                k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
+                                              q.dtype)
                 k_pos = jnp.arange(max_len)
                 mask = k_pos[None, None, :] <= positions[:, :, None]
                 bias = jnp.where(mask, 0.0,
@@ -140,12 +142,8 @@ class LlamaAttention(nn.Module):
                 write = jnp.arange(l)[None, :] < widths[:, None]
                 page_ids = jnp.where(
                     write, pt[jnp.arange(b)[:, None], pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k.astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v.astype(v_pages.dtype), mode="drop")
-                k_slot = gather_pages(k_pages, pt)
-                v_slot = gather_pages(v_pages, pt)
+                pools_out = paged_write(cache, page_ids, pos % ps, k, v)
+                k_slot, v_slot = paged_gather(pools_out, pt, q.dtype)
                 k_pos = jnp.arange(max_len)
                 mask = k_pos[None, None, :] <= pos[:, :, None]
                 bias = jnp.where(mask, 0.0,
@@ -156,19 +154,20 @@ class LlamaAttention(nn.Module):
                 pos = positions[:, 0]
                 page_ids = jnp.where(active,
                                      pt[jnp.arange(b), pos // ps], num_pages)
-                k_pages = k_pages.at[page_ids, pos % ps].set(
-                    k[:, 0].astype(k_pages.dtype), mode="drop")
-                v_pages = v_pages.at[page_ids, pos % ps].set(
-                    v[:, 0].astype(v_pages.dtype), mode="drop")
-                out = paged_decode_attention(q, k_pages, v_pages, pt, pos)
+                pools_out = paged_write(cache, page_ids, pos % ps,
+                                        k[:, 0], v[:, 0])
+                out = paged_decode_attention(
+                    q, pools_out["k_pages"], pools_out["v_pages"], pt,
+                    pos, k_scale=pools_out.get("k_scale"),
+                    v_scale=pools_out.get("v_scale"))
             # multi-chip serving: pin the pools' kv-head sharding on the
             # updated arrays so GSPMD keeps the scatter/gather split
             # over the `model` axis — GQA pools shard num_kv_heads, so
-            # the `model` size must divide it (engine-validated)
+            # the `model` size must divide it (engine-validated); the
+            # quantized scale pools share the payload's axis family
             from deepspeed_tpu.serving.sharding import constrain_kv_pages
-            k_pages = constrain_kv_pages(k_pages)
-            v_pages = constrain_kv_pages(v_pages)
-            new_cache = {"k_pages": k_pages, "v_pages": v_pages}
+            new_cache = {name: constrain_kv_pages(arr)
+                         for name, arr in pools_out.items()}
         elif cache is not None:
             # decode: append k/v at cache["index"], attend over valid prefix
             k_cache = lax.dynamic_update_slice(
@@ -340,13 +339,14 @@ def init_kv_cache(cfg: LlamaConfig, batch_size, max_len=None,
 def init_paged_kv_cache(cfg: LlamaConfig, num_pages, page_size,
                         dtype=jnp.bfloat16):
     """Per-layer paged KV pools (serving/ subsystem) — GQA pools are
-    sized to num_kv_heads and stay grouped through the paged kernel."""
-    layer = lambda: {
-        "k_pages": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
-                              cfg.head_dim), dtype),
-        "v_pages": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
-                              cfg.head_dim), dtype),
-    }
+    sized to num_kv_heads and stay grouped through the paged kernel.
+    ``dtype`` may be a quantized kv-dtype name ("int8"/"fp8"): int8/fp8
+    payload pools plus parallel per-row f32 scale pools
+    (ops/quant/kv.py storage contract)."""
+    from deepspeed_tpu.ops.quant.kv import paged_pool_layer
+    layer = lambda: paged_pool_layer(num_pages, page_size,
+                                     cfg.num_kv_heads, cfg.head_dim,
+                                     dtype)
     return {"layers": [layer() for _ in range(cfg.num_layers)]}
 
 

@@ -146,6 +146,68 @@ def _multichip_mesh():
     return any(int(mesh.shape.get(a, 1)) > 1 for a in ("model", "data"))
 
 
+def _paged_decode_kernel_quant(pt_ref, len_ref, q_ref, k_ref, v_ref,
+                               ks_ref, vs_ref, o_ref, m_scr, l_scr,
+                               acc_scr, *, scale, page_size, np_):
+    """Quantized-pool variant of ``_paged_decode_kernel``: the K/V page
+    block arrives int8/fp8 and its per-row scale block ([1, page_size,
+    h, 1] — the parallel scale pool, fetched through the SAME
+    scalar-prefetched page-table index map, so a page and its scales
+    are one unit) dequantizes in VMEM right before the dot — the
+    fused-dequant property that makes quantized decode a bandwidth win
+    rather than a copy: only quantized bytes ever stream from HBM."""
+    si = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    h = q_ref.shape[1]
+    pos = len_ref[si]
+
+    @pl.when(ki * page_size <= pos)
+    def _compute():
+        q = q_ref[0]                                      # [h, 1, d]
+        k = (k_ref[0].astype(jnp.float32) *
+             ks_ref[0].astype(jnp.float32)).astype(q.dtype)
+        v = (v_ref[0].astype(jnp.float32) *
+             vs_ref[0].astype(jnp.float32)).astype(q.dtype)
+        k = k.transpose(1, 0, 2)                          # [h, ps, d]
+        v = v.transpose(1, 0, 2)                          # [h, ps, d]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [h, 1, ps]
+        k_pos = ki * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        s = jnp.maximum(s, NEG_INF)
+
+        m_prev = m_scr[:h, :1]
+        l_prev = l_scr[:h, :1]
+        m_cur = jnp.max(s, axis=2)
+        m_new = jnp.maximum(m_prev, m_cur)
+        row_live = m_new > NEG_INF / 2
+        alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(row_live[..., None], jnp.exp(s - m_new[..., None]),
+                      0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=2)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [h, 1, d]
+        acc_scr[:h] = acc_scr[:h] * alpha + pv[:, 0, :]
+        m_scr[:h] = jnp.broadcast_to(m_new, (h, m_scr.shape[1]))
+        l_scr[:h] = jnp.broadcast_to(l_new, (h, l_scr.shape[1]))
+
+    @pl.when(ki == np_ - 1)
+    def _finalize():
+        l = l_scr[:h, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = ((acc_scr[:h] / l)[:, None, :]).astype(o_ref.dtype)
+
+
 def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                          m_scr, l_scr, acc_scr, *, scale, page_size, np_):
     """Paged variant of ``_decode_kernel``: one grid step is ALL heads of
@@ -207,29 +269,47 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions, *,
-                         scale, interpret):
+                         scale, interpret, k_scale=None, v_scale=None):
     slots, one, h, d = q.shape
     page_size = k_pages.shape[1]
     maxp = page_table.shape[1]
     kv_h = k_pages.shape[2]
+    quantized = k_scale is not None
     if kv_h != h:
         k_pages = _repeat_kv(k_pages, h // kv_h)
         v_pages = _repeat_kv(v_pages, h // kv_h)
+        if quantized:
+            k_scale = _repeat_kv(k_scale, h // kv_h)
+            v_scale = _repeat_kv(v_scale, h // kv_h)
     scr_rows = max(h, 8)
     q_t = q.transpose(0, 2, 1, 3)                         # [slots, h, 1, d]
 
-    kernel = functools.partial(_paged_decode_kernel, scale=scale,
-                               page_size=page_size, np_=maxp)
+    page_spec = pl.BlockSpec((1, page_size, h, d),
+                             lambda si, ki, pt, ln: (pt[si, ki], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h, 1, d), lambda si, ki, pt, ln: (si, 0, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [q_t, k_pages, v_pages]
+    if quantized:
+        # the scale pools ride the SAME prefetched page-table index map
+        # as their payload: one grid step fetches a page and its scales
+        # as a unit, and the dequant happens in VMEM inside the kernel
+        scale_spec = pl.BlockSpec(
+            (1, page_size, h, 1),
+            lambda si, ki, pt, ln: (pt[si, ki], 0, 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+        kernel = functools.partial(_paged_decode_kernel_quant,
+                                   scale=scale, page_size=page_size,
+                                   np_=maxp)
+    else:
+        kernel = functools.partial(_paged_decode_kernel, scale=scale,
+                                   page_size=page_size, np_=maxp)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, maxp),
-        in_specs=[
-            pl.BlockSpec((1, h, 1, d), lambda si, ki, pt, ln: (si, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, h, d),
-                         lambda si, ki, pt, ln: (pt[si, ki], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, h, d),
-                         lambda si, ki, pt, ln: (pt[si, ki], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, 1, d),
                                lambda si, ki, pt, ln: (si, 0, 0, 0)),
         scratch_shapes=[
@@ -242,7 +322,7 @@ def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions, *,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((slots, h, 1, d), q.dtype),
         interpret=interpret,
-    )(page_table, positions, q_t, k_pages, v_pages)
+    )(page_table, positions, *operands)
     return out.transpose(0, 2, 1, 3)                      # [slots, 1, h, d]
 
 
@@ -258,12 +338,22 @@ def gather_pages(pages, page_table):
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
                            scale=None, bias=None, interpret=None,
-                           force_kernel=False):
+                           force_kernel=False, k_scale=None,
+                           v_scale=None):
     """Single-token attention of ``q`` [slots, 1, heads, d] over a PAGED
     cache: a shared pool ``k_pages``/``v_pages`` [num_pages, page_size,
     kv_heads, d] indexed through ``page_table`` [slots, max_pages] with
     per-slot query ``positions`` [slots] (key positions <= position are
     live — the current token's k/v must already be written).
+
+    ``k_scale``/``v_scale`` (optional, [num_pages, page_size, kv_heads,
+    1] f32) mark a QUANTIZED pool (int8/fp8 payload + per-row scales,
+    ops/quant/kv.py): the Pallas path fetches each page's scale block
+    through the same scalar-prefetched page-table index map and
+    dequantizes in VMEM right before the dot (only quantized bytes
+    stream from HBM — the bandwidth win), while the fallback gathers
+    payload + scales and dequantizes the contiguous buffers (the jnp
+    reference for CPU/mesh parity).
 
     The Pallas path streams K/V page-by-page via scalar-prefetched table
     lookups (true PagedAttention: no per-slot contiguous copy). The
@@ -305,10 +395,19 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
     if use_kernel:
         return _paged_decode_pallas(q, k_pages, v_pages,
                                     page_table.astype(jnp.int32), positions,
-                                    scale=scale, interpret=interpret)
+                                    scale=scale, interpret=interpret,
+                                    k_scale=k_scale, v_scale=v_scale)
 
     k_full = gather_pages(k_pages, page_table)
     v_full = gather_pages(v_pages, page_table)
+    if k_scale is not None:
+        from deepspeed_tpu.ops.quant.kv import dequantize_kv_rows
+        k_full = dequantize_kv_rows(k_full, gather_pages(k_scale,
+                                                         page_table),
+                                    q.dtype)
+        v_full = dequantize_kv_rows(v_full, gather_pages(v_scale,
+                                                         page_table),
+                                    q.dtype)
     k_pos = jnp.arange(max_len)
     mask = k_pos[None, None, None, :] <= positions[:, None, None, None]
     full_bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)

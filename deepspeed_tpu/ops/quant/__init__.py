@@ -4,3 +4,7 @@ dequantize.cu, pt_binding.cpp — and ``deepspeed/ops/quantizer/``)."""
 from deepspeed_tpu.ops.quant.quantizer import (  # noqa: F401
     QTensor, dequantize, dequantize_tree, quantize, quantize_tree)
 from deepspeed_tpu.ops.quant.kernels import int8_matmul  # noqa: F401
+from deepspeed_tpu.ops.quant.kv import (  # noqa: F401
+    KV_QUANT_DTYPES, dequantize_kv_rows, is_quantized_kv, kv_dtype_name,
+    kv_page_bytes, paged_gather, paged_pool_layer, paged_write,
+    quantize_kv_rows)

@@ -43,7 +43,16 @@ def _quant_impl(impl):
 def quant_matmul(x, qt, impl="auto"):
     """x [..., k] @ dequant(qt) -> [..., n], impl per module docstring."""
     from deepspeed_tpu.ops.quant.kernels import int8_matmul
-    if _quant_impl(impl) == "xla":
+    k = x.shape[-1]
+    # a trailing partial group (k % scale rows != 0, or an explicit
+    # group_size the rows don't tile) has no legal Pallas k-blocking —
+    # the dequant-matmul kernel owns whole scale rows per k step.  Route
+    # those tensors through the XLA dequant path instead of asserting
+    # inside the kernel.
+    trailing = k % qt.scale.shape[0] != 0 or (
+        qt.group_size is not None and
+        qt.group_size * qt.scale.shape[0] != k)
+    if trailing or _quant_impl(impl) == "xla":
         return x @ qt.dequant().astype(x.dtype)
     lead = x.shape[:-1]
     m = 1

@@ -10,8 +10,11 @@ the serving hot path.
 
 Weights quantize per group along the contraction (input) axis: a kernel
 [in, out] with group size G stores q int8 [in, out] and scales
-[in/G, out] — each group of G input rows shares one scale per output
-column. Symmetric: q = round(x / s), s = max|x| / qmax.
+[ceil(in/G), out] — each group of (up to) G input rows shares one scale
+per output column.  A non-divisible ``in`` gets a short TRAILING group
+(its scale covers only the real rows — zero padding never inflates an
+absmax, and the padded rows are sliced away before they exist in the
+stored q).  Symmetric: q = round(x / s), s = max|x| / qmax.
 """
 
 import jax
@@ -22,13 +25,19 @@ import jax.numpy as jnp
 class QTensor:
     """Quantized weight leaf: (q int8, scale) with the original dtype.
     Lives inside a params pytree; jit/flatten treat q and scale as
-    children so the tree passes straight into jitted functions."""
+    children so the tree passes straight into jitted functions.
+    ``group_size`` is part of the aux data: with a trailing partial
+    group the grouping is NOT derivable from the shapes alone
+    (ceil(in/groups) != the real group size), so dequantization must
+    carry it."""
 
-    def __init__(self, q, scale, dtype=jnp.bfloat16, bits=8):
+    def __init__(self, q, scale, dtype=jnp.bfloat16, bits=8,
+                 group_size=None):
         self.q = q
         self.scale = scale
         self.dtype = dtype
         self.bits = bits
+        self.group_size = group_size
 
     @property
     def shape(self):
@@ -36,14 +45,21 @@ class QTensor:
 
     @property
     def nbytes(self):
-        return self.q.size * self.q.dtype.itemsize + \
-            self.scale.size * self.scale.dtype.itemsize
+        """True storage footprint: the int8 payload AND the scale rows.
+        Counting only q under-reports by scale.size * 4 bytes — at small
+        group sizes the scales are a double-digit percentage of the
+        whole tensor, and the serving byte ledgers (health / mem
+        telemetry) bill real bytes, not wishful ones."""
+        return int(self.q.size) * self.q.dtype.itemsize + \
+            int(self.scale.size) * self.scale.dtype.itemsize
 
     def dequant(self):
-        return dequantize(self.q, self.scale, self.dtype)
+        return dequantize(self.q, self.scale, self.dtype,
+                          group_size=self.group_size)
 
     def tree_flatten(self):
-        return (self.q, self.scale), (self.dtype, self.bits)
+        return (self.q, self.scale), (self.dtype, self.bits,
+                                      self.group_size)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -55,31 +71,66 @@ class QTensor:
 
 
 def quantize(x, *, bits=8, group_size=128):
-    """[in, out] float -> (q int8 [in, out], scale f32 [in/G, out]).
-    `in` must divide by group_size (callers pick eligible leaves)."""
+    """[in, out] float -> (q int8 [in, out], scale f32 [ceil(in/G), out]).
+    A non-divisible ``in`` quantizes with a short trailing group (the
+    zero padding used for the reshape cannot raise any |x| max, and the
+    padded rows are sliced off the returned q)."""
     assert bits in (8, 4), f"bits={bits} (int8 / int4 symmetric)"
     n_in, n_out = x.shape
-    assert n_in % group_size == 0, (n_in, group_size)
     qmax = 2.0 ** (bits - 1) - 1
-    g = x.reshape(n_in // group_size, group_size, n_out).astype(jnp.float32)
+    groups = -(-n_in // group_size)
+    pad = groups * group_size - n_in
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+    g = xf.reshape(groups, group_size, n_out)
     absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)      # [G, 1, out]
     scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
     q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
-    return q.reshape(n_in, n_out), scale[:, 0, :]
+    q = q.reshape(groups * group_size, n_out)
+    if pad:
+        q = q[:n_in]
+    return q, scale[:, 0, :]
 
 
-def dequantize(q, scale, dtype=jnp.bfloat16):
-    """Inverse of :func:`quantize`."""
+def dequantize(q, scale, dtype=jnp.bfloat16, group_size=None):
+    """Inverse of :func:`quantize`.  ``group_size`` is required when the
+    quantization used a trailing partial group (in % G != 0): the
+    grouping is not derivable from the shapes then.  Omitted, it falls
+    back to the exact-divisible inference (in // groups) and raises on
+    ambiguity rather than silently mis-grouping."""
     n_in, n_out = q.shape
     groups = scale.shape[0]
-    g = q.reshape(groups, n_in // groups, n_out).astype(jnp.float32)
-    return (g * scale[:, None, :]).reshape(n_in, n_out).astype(dtype)
+    if group_size is None:
+        if n_in % groups != 0:
+            raise ValueError(
+                f"dequantize: {groups} scale rows do not evenly divide "
+                f"{n_in} input rows — this tensor was quantized with a "
+                "trailing partial group; pass group_size=")
+        group_size = n_in // groups
+    pad = groups * group_size - n_in
+    if pad < 0 or pad >= group_size:
+        raise ValueError(
+            f"dequantize: group_size={group_size} inconsistent with "
+            f"q rows {n_in} / {groups} scale rows")
+    qf = q.astype(jnp.float32)
+    if pad:
+        qf = jnp.pad(qf, ((0, pad), (0, 0)))
+    g = qf.reshape(groups, group_size, n_out)
+    out = (g * scale[:, None, :]).reshape(groups * group_size, n_out)
+    if pad:
+        out = out[:n_in]
+    return out.astype(dtype)
 
 
-def _eligible(leaf, group_size):
+def _eligible(leaf):
+    """2-D floating kernels quantize; the contraction dim need NOT
+    divide by the group size any more (a trailing partial group handles
+    the remainder — eligibility is shape-only now), but degenerate
+    single-row kernels stay float — one scale per element saves
+    nothing."""
     shape = jnp.shape(leaf)
-    return (len(shape) == 2 and shape[0] % group_size == 0 and
-            shape[0] >= group_size and
+    return (len(shape) == 2 and shape[0] > 1 and
             jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating))
 
 
@@ -90,11 +141,11 @@ def quantize_tree(params, *, bits=8, group_size=128, predicate=None):
     pred = predicate or (lambda path, leaf: True)
 
     def per_leaf(path, leaf):
-        if _eligible(leaf, group_size) and pred(path, leaf):
+        if _eligible(leaf) and pred(path, leaf):
             dtype = jnp.asarray(leaf).dtype
             q, s = quantize(jnp.asarray(leaf), bits=bits,
                             group_size=group_size)
-            return QTensor(q, s, dtype, bits)
+            return QTensor(q, s, dtype, bits, group_size)
         return leaf
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)

@@ -309,7 +309,8 @@ class ProcessReplica:
                  num_pages=32, page_size=16, max_pages_per_slot=8,
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
                  hb_timeout_s=60.0, env=None, trace=False,
-                 mem_telemetry=False, comm_telemetry=False):
+                 mem_telemetry=False, comm_telemetry=False,
+                 kv_dtype=None):
         self.id = replica_id
         self.state = UP
         self.death_reason = None
@@ -324,7 +325,8 @@ class ProcessReplica:
                          prefill_chunk=prefill_chunk,
                          prefix_cache=prefix_cache, trace=bool(trace),
                          mem_telemetry=bool(mem_telemetry),
-                         comm_telemetry=bool(comm_telemetry))
+                         comm_telemetry=bool(comm_telemetry),
+                         kv_dtype=kv_dtype)
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
@@ -360,6 +362,10 @@ class ProcessReplica:
                "--page-size", str(cfg["page_size"]),
                "--max-pages-per-slot", str(cfg["max_pages_per_slot"]),
                "--prefill-chunk", str(cfg["prefill_chunk"])]
+        if cfg.get("kv_dtype"):
+            # quantized (or explicitly float) paged-KV pools survive a
+            # worker restart: the dtype is part of the replica config
+            cmd += ["--kv-dtype", str(cfg["kv_dtype"])]
         if cfg["prefix_cache"]:
             cmd.append("--prefix-cache")
         if cfg["mem_telemetry"]:

@@ -814,12 +814,16 @@ def make_local_fleet(engine, n, *, id_prefix="replica", **sched_kw):
 
 def make_disaggregated_group(engine, *, name="g0", num_prefill=1,
                              num_decode=1, num_pages=64, page_size=16,
-                             **sched_kw):
+                             kv_dtype=None, **sched_kw):
     """A prefill/decode worker group: separate schedulers (separate
     slot tables) over ONE shared page pool and ONE device-pools ref, so
-    a finished prompt's KV chain transfers by page id — zero copies."""
+    a finished prompt's KV chain transfers by page id — zero copies.
+    ``kv_dtype`` overrides the engine's pool dtype for the SHARED pools
+    (int8/fp8 quantized pages handoff by page id like any others —
+    their scale pools ride the same ids)."""
     pool = PagePool(num_pages, page_size)
-    pools_ref = _PoolsRef(engine.init_paged_cache(num_pages, page_size))
+    pools_ref = _PoolsRef(engine.init_paged_cache(num_pages, page_size,
+                                                  kv_dtype=kv_dtype))
     group = DisaggGroup(name, pool, pools_ref)
 
     def factory():

@@ -73,6 +73,11 @@ def main(argv=None):
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-slot", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=8)
+    p.add_argument("--kv-dtype", default=None,
+                   help="paged-KV pool dtype (float32/bfloat16/int8/"
+                        "fp8); int8/fp8 pools store quantized pages + "
+                        "per-row f32 scale pools.  Default: the engine "
+                        "dtype")
     p.add_argument("--prefix-cache", action="store_true")
     p.add_argument("--mem-telemetry", action="store_true",
                    help="page-state attribution + per-request "
@@ -111,6 +116,7 @@ def main(argv=None):
         page_size=args.page_size,
         max_pages_per_slot=args.max_pages_per_slot,
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
+        kv_dtype=args.kv_dtype,
         mem_telemetry=args.mem_telemetry,
         comm_telemetry=args.comm_telemetry)
 

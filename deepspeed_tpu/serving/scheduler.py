@@ -220,6 +220,7 @@ class ServingScheduler:
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
                  spec_decode=None, spec_k=8, spec_drafter=None,
+                 kv_dtype=None,
                  shared_pool=None, pools_ref=None, on_handoff=None,
                  tracer=None, mem_telemetry=False, audit_every=None,
                  comm_telemetry=False, compile_watchdog=None,
@@ -253,9 +254,25 @@ class ServingScheduler:
         # each other's functional updates; standalone schedulers own a
         # private ref and behave exactly as before
         if pools_ref is None:
-            pools_ref = _PoolsRef(engine.init_paged_cache(num_pages,
-                                                          page_size))
+            # kv_dtype overrides the engine's configured kv_cache_dtype
+            # for THIS scheduler's pools ("float32"/"bfloat16"/"int8"/
+            # "fp8") — the serving autotuner varies it per trial on one
+            # engine.  int8/fp8 pools carry parallel per-row f32 scale
+            # pools; every host mechanism (COW, donation, truncate,
+            # handoff) is dtype-blind because it moves page IDS
+            pools_ref = _PoolsRef(engine.init_paged_cache(
+                num_pages, page_size, kv_dtype=kv_dtype))
+        elif kv_dtype is not None:
+            raise ValueError(
+                "kv_dtype cannot be set on a scheduler adopting shared "
+                "pools (pools_ref=): the dtype is baked into the shared "
+                "arrays — set it where the pools are built")
         self._pools_ref = pools_ref
+        # live truth for health()/operators: derived from the allocated
+        # leaves, not from config (a shared pool reports what it IS)
+        from deepspeed_tpu.ops.quant.kv import kv_dtype_name
+        self.kv_dtype_name = kv_dtype_name(
+            self._pools_ref.pools["layers"][0])
         # prefill-worker hook: a request submitted with handoff=True
         # finishes its prompt, emits the boundary token, and hands its
         # page chain to this callback instead of decoding on
@@ -2025,6 +2042,13 @@ class ServingScheduler:
             "mesh": self.mesh_info.get("mesh_shape"),
             "mesh_devices": self.mesh_info.get("mesh_devices"),
             "serving_axes": self.mesh_info.get("serving_axes"),
+            # quantized serving memory: the pool dtype actually
+            # allocated (int8/fp8 pools report their TRUE byte
+            # footprint below — payload + scale leaves summed, never a
+            # hand-computed figure) and the weight storage dtype
+            "kv_dtype": self.kv_dtype_name,
+            "weight_dtype": getattr(self.engine, "weight_dtype_name",
+                                    None),
             "kv_pool_bytes_per_device":
                 self.mesh_info.get("kv_pool_bytes_per_device"),
             "kv_pool_bytes_total":

@@ -193,3 +193,98 @@ def test_int8_inference_end_to_end():
         q_engine.params,
         is_leaf=lambda x: isinstance(x, QT)) if isinstance(x := l, QT)]
     assert qleaves, "no weights were quantized"
+
+
+# ----------------------- group-size edge cases (ISSUE-14 regressions)
+
+
+def test_quantize_trailing_partial_group():
+    """in % group_size != 0: the trailing short group gets its own
+    scale row, the roundtrip stays inside the symmetric-int8 bound, and
+    the stored q keeps the ORIGINAL row count (no padding leaks out)."""
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(200, 48)).astype(np.float32)   # 128 + 72 tail
+    q, s = quantize(jnp.asarray(w), bits=8, group_size=128)
+    assert q.shape == (200, 48) and s.shape == (2, 48)
+    back = np.asarray(dequantize(q, s, jnp.float32, group_size=128))
+    bounds = []
+    for g0, g1 in ((0, 128), (128, 200)):
+        absmax = np.abs(w[g0:g1]).max(axis=0, keepdims=True)
+        bounds.append(np.repeat(absmax / 127.0 / 2.0 + 1e-8,
+                                g1 - g0, axis=0))
+    assert (np.abs(back - w) <= np.concatenate(bounds) + 1e-6).all()
+    # the trailing group's scale reflects ITS rows, not the padding
+    # (zero pad rows cannot raise an absmax, only real rows count)
+    np.testing.assert_allclose(np.asarray(s)[1],
+                               np.abs(w[128:]).max(axis=0) / 127.0,
+                               rtol=1e-6)
+
+
+def test_quantize_smaller_than_group():
+    """in < group_size is a single partial group (the tiny-model head
+    projections the old divisibility rule excluded entirely)."""
+    rng = np.random.default_rng(8)
+    w = rng.normal(size=(48, 96)).astype(np.float32)
+    q, s = quantize(jnp.asarray(w), group_size=128)
+    assert s.shape == (1, 96)
+    back = np.asarray(dequantize(q, s, jnp.float32, group_size=128))
+    assert np.abs(back - w).max() <= np.abs(w).max() / 127.0 + 1e-6
+
+
+def test_dequantize_ambiguous_grouping_raises():
+    """Without group_size=, a trailing-group tensor whose shapes do not
+    admit the legacy exact-divisible inference must refuse to guess
+    (when the row count happens to divide the group count the ambiguity
+    is undetectable from shapes — which is exactly why QTensor carries
+    group_size in its aux data and always passes it)."""
+    w = jnp.asarray(np.random.default_rng(9).normal(size=(130, 8)),
+                    jnp.float32)
+    q, s = quantize(w, group_size=64)      # groups [64, 64, 2]
+    assert s.shape[0] == 3
+    with pytest.raises(ValueError, match="trailing partial group"):
+        dequantize(q, s, jnp.float32)
+    # the QTensor path is immune: group_size rides the aux data
+    qt = QTensor(q, s, jnp.float32, 8, 64)
+    assert np.asarray(qt.dequant()).shape == (130, 8)
+
+
+def test_qtensor_nbytes_counts_scales_and_roundtrips_jit():
+    """QTensor.nbytes must bill the scale rows too (the serving byte
+    ledgers report real bytes), and the group_size aux must survive
+    tree flatten/unflatten so dequant inside jit stays correct for
+    trailing-group tensors."""
+    rng = np.random.default_rng(10)
+    w = rng.normal(size=(200, 32)).astype(np.float32)
+    q, s = quantize(jnp.asarray(w), group_size=128)
+    qt = QTensor(q, s, jnp.float32, 8, 128)
+    assert qt.nbytes == 200 * 32 * 1 + 2 * 32 * 4
+    assert qt.nbytes > int(q.size)          # scales actually counted
+
+    @jax.jit
+    def f(qt):
+        return qt.dequant()                 # needs group_size via aux
+
+    np.testing.assert_allclose(
+        np.asarray(f(qt)),
+        np.asarray(dequantize(q, s, jnp.float32, group_size=128)),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_tree_trailing_kernel_and_quant_matmul():
+    """quantize_tree picks up a non-divisible kernel now; QDense's
+    quant_matmul routes it through the XLA dequant path on every impl
+    (the Pallas kernel has no legal k-blocking for a partial group)."""
+    from deepspeed_tpu.ops.quant.qdense import quant_matmul
+
+    rng = np.random.default_rng(11)
+    tree = {"proj": {"kernel": rng.normal(size=(100, 64)).astype("f4")}}
+    qtree = quantize_tree(tree, group_size=64,
+                          predicate=lambda p, l: "kernel" in p)
+    qt = qtree["proj"]["kernel"]
+    assert isinstance(qt, QTensor) and qt.scale.shape[0] == 2
+    x = jnp.asarray(rng.normal(size=(3, 100)), jnp.float32)
+    ref = x @ np.asarray(qt.dequant().astype(jnp.float32))
+    for impl in ("xla", "pallas", "auto"):
+        np.testing.assert_allclose(
+            np.asarray(quant_matmul(x, qt, impl=impl)), np.asarray(ref),
+            rtol=2e-5, atol=2e-5)

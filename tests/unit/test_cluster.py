@@ -337,6 +337,11 @@ HEALTH_SCHEMA = {
     "mesh": (dict, type(None)),
     "mesh_devices": (int, type(None)),
     "serving_axes": (dict, type(None)),
+    # quantized serving memory (kv_dtype in {float32, bfloat16, int8,
+    # fp8}); the byte figures reflect the TRUE quantized footprint
+    # (payload + scale pools summed from the allocated leaves)
+    "kv_dtype": (str,),
+    "weight_dtype": (str, type(None)),
     "kv_pool_bytes_per_device": (int, type(None)),
     "kv_pool_bytes_total": (int, type(None)),
     "prefix_cache": (bool,),

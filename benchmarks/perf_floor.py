@@ -120,6 +120,17 @@ CHECKS = [
      "kv_quant.same_slots.speedup_tokens_per_sec", "info", None),
     ("kv-quant int8 tokens/s (equal bytes)",
      "kv_quant.capacity.int8.tokens_per_sec", "info", None),
+    # shard_map'd paged-kernel rows (PR 15): on CPU the kernel column
+    # prices interpret-mode EMULATION (expected << 1 — it proves the
+    # dispatch, not a win); the ratio becomes the real scorecard when
+    # the first TPU sweep lands like-for-like in the same JSON paths.
+    # Info, never gating, until a TPU round anchors the numbers
+    ("mesh kernel/reference ratio (2x4, interpret on CPU)",
+     "mesh_sweep.sweep.2x4.kernel_vs_reference", "info", None),
+    ("mesh kernel/reference ratio (1x8, interpret on CPU)",
+     "mesh_sweep.sweep.1x8.kernel_vs_reference", "info", None),
+    ("mesh kernel tokens/s (2x4)",
+     "mesh_sweep.sweep.2x4.kernel.tokens_per_sec", "info", None),
 ]
 
 TRACING_OVERHEAD_CEILING = 0.05   # the committed <5% contract

@@ -360,24 +360,35 @@ def run_mesh_sweep(module, vocab, cfg, args, horizon, overlap):
         "backend": jax.default_backend(),
         "note": "CPU mesh shapes share the same physical cores: this "
                 "measures sharded-serving correctness + dispatch "
-                "overhead, not chip-scaling speedup",
+                "overhead, not chip-scaling speedup; the kernel column "
+                "runs the shard_map'd Pallas paged kernel in interpret "
+                "mode (emulation price on CPU — the same leg is the "
+                "real kernel measurement on TPU)",
         "sweep": {},
     }
-    for m, d in shapes:
+    def measure_leg(m, d, paged_kernel="auto"):
+        """Build one mesh engine and measure the standard workload:
+        untimed warmup (the shape's full signature set) then best-of
+        --repeats.  ONE code path for the reference and kernel columns,
+        so the two legs can never drift methodologically."""
         engine = deepspeed_tpu.init_inference(
             module, dtype="float32", kv_cache_dtype="float32",
             tensor_parallel={"tp_size": m}, mesh={"data": d, "model": m},
+            paged_kernel=paged_kernel,
             max_out_tokens=cfg["max_pages_per_slot"] * cfg["page_size"])
         engine.init_params()
-        # warmup compiles this mesh's full signature set untimed
         run_continuous(engine, prompts, max_new, arrivals, cfg,
                        horizon=horizon, overlap=overlap)
         r = None
         for _ in range(max(1, args.repeats)):
-            cand = run_continuous(engine, prompts, max_new, arrivals, cfg,
-                                  horizon=horizon, overlap=overlap)
+            cand = run_continuous(engine, prompts, max_new, arrivals,
+                                  cfg, horizon=horizon, overlap=overlap)
             if r is None or cand["tokens_per_sec"] > r["tokens_per_sec"]:
                 r = cand
+        return engine, r
+
+    for m, d in shapes:
+        engine, r = measure_leg(m, d)
         entry = {k: r[k] for k in _MESH_KEYS if k in r}
         entry["mesh"] = {"model": m, "data": d}
         entry["decode_multi_compiles"] = \
@@ -388,6 +399,25 @@ def run_mesh_sweep(module, vocab, cfg, args, horizon, overlap):
         entry["kv_pool_bytes_per_device"] = \
             info.get("kv_pool_bytes_per_device")
         entry["serving_axes"] = info.get("serving_axes")
+        entry["paged_attention"] = info.get("paged_attention")
+
+        # kernel-vs-reference column: the SAME workload through a
+        # paged_kernel="force" engine — the shard_map'd Pallas kernel
+        # per shard (interpret mode on CPU, where it prices emulation
+        # overhead, not a win; on real TPU this exact leg is the
+        # like-for-like kernel measurement the sweep exists for).
+        # 1x1 keeps its single-device kernel leg too, as the baseline.
+        if getattr(args, "mesh_kernel", True):
+            _, kr = measure_leg(m, d, paged_kernel="force")
+            entry["kernel"] = {
+                "tokens_per_sec": kr["tokens_per_sec"],
+                "wall_s": kr["wall_s"],
+                "paged_attention":
+                    (kr.get("mesh_info") or {}).get("paged_attention"),
+            }
+            entry["kernel_vs_reference"] = round(
+                kr["tokens_per_sec"] / entry["tokens_per_sec"], 3) \
+                if entry["tokens_per_sec"] else None
         section["sweep"][f"{m}x{d}"] = entry
         print(json.dumps({
             "metric": "serving_mesh_tokens_per_sec",
@@ -1152,6 +1182,16 @@ def main():
                         "included). On CPU, force virtual devices with "
                         "XLA_FLAGS=--xla_force_host_platform_device_"
                         "count=8 first")
+    p.add_argument("--mesh-kernel", action="store_true", default=True,
+                   help="(default on) add the kernel-vs-reference "
+                        "column to the --mesh sweep: each shape also "
+                        "serves through a paged_kernel='force' engine "
+                        "— the shard_map'd Pallas paged kernel per kv "
+                        "shard (interpret-mode emulation price on CPU; "
+                        "the like-for-like kernel leg on real TPU)")
+    p.add_argument("--no-mesh-kernel", dest="mesh_kernel",
+                   action="store_false",
+                   help="skip the kernel column (reference path only)")
     p.add_argument("--cluster", type=int, default=0,
                    help="run the cluster-routing workload instead: a "
                         "prefix-aware router over this many in-process "

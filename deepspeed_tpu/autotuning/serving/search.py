@@ -297,6 +297,16 @@ class ServingAutotuner(Autotuner):
             "pruned_ranked_out": sum(
                 1 for d in dropped if d.get("pruned") == "ranked_out"),
             "search_seconds": round(time.monotonic() - t_search0, 3),
+            # tuning provenance: the serving knob space is PER-TOPOLOGY
+            # (per-device pool bytes, collective costs and slot
+            # sharding all change with the mesh shape), so the tuned
+            # config records the mesh it was measured on and ds_serve
+            # --tuned-config refuses to apply it on a different shape
+            # (None under an injected measure_fn with no real engine —
+            # ds_serve only enforces the check when the field is set)
+            "mesh_shape": None if getattr(engine, "mesh", None) is None
+            else ({a: int(s) for a, s in engine.mesh.shape.items()
+                   if int(s) > 1} or {"data": 1}),
             "table": table,
             # the flag line must describe THE SAME config as "knobs" —
             # overrides alone would complete against the library

@@ -65,7 +65,20 @@ class DeepSpeedInferenceConfig(BaseModel):
     return_tuple: bool = True
     # TPU additions
     mesh: Optional[Dict[str, int]] = None
+    # multi-slice topologies: ICI (within-slice) sizes ride `mesh`,
+    # the across-slice DCN factors ride this — per-axis mesh size is
+    # their product (parallel/topology.make_hybrid_mesh; pure config,
+    # the serving axis rules are untouched)
+    mesh_dcn: Optional[Dict[str, int]] = None
     kv_cache_dtype: str = "bfloat16"
+    # paged-attention kernel dispatch policy (ops/attention/decode.py
+    # paged_kernel_decision): "auto" picks the Pallas kernel on TPU
+    # with 128-aligned pages (shard_mapped per-shard on a multi-device
+    # mesh) and the jnp gather reference otherwise; "force" pins the
+    # kernel (interpret mode off-TPU — the CI parity oracle);
+    # "reference" pins the gather fallback.  Trace-time static: set it
+    # before the first serving dispatch, not mid-flight.
+    paged_kernel: str = "auto"
     # pluggable checkpoint backend (checkpoint/backend.py) — must match
     # the backend the training engine saved with
     checkpoint_engine: Dict[str, Any] = Field(default_factory=dict)

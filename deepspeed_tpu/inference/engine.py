@@ -87,12 +87,33 @@ class InferenceEngine:
         self.module = model
         self.mp_world_size = config.tensor_parallel.tp_size
 
+        # multi-slice ICI x DCN topologies are pure config: `mesh`
+        # carries the within-slice (ICI) sizes, `mesh_dcn` the
+        # across-slice factors; the serving axis rules are untouched
+        # (model stays ICI-innermost, slots span DCN over data)
+        self.mesh_dcn = {k: int(v) for k, v in (config.mesh_dcn or {})
+                         .items() if int(v) > 1} or None
         if mesh is None:
+            from deepspeed_tpu.parallel.topology import make_hybrid_mesh
             from deepspeed_tpu.runtime.config import MeshConfig
             mcfg = config.mesh or {"data": -1,
                                    "model": config.tensor_parallel.tp_size}
-            mesh = make_mesh(MeshConfig(**mcfg), allow_subset=True)
+            if self.mesh_dcn:
+                mesh = make_hybrid_mesh(MeshConfig(**mcfg), self.mesh_dcn,
+                                        allow_subset=True)
+            else:
+                mesh = make_mesh(MeshConfig(**mcfg), allow_subset=True)
         self.mesh = mesh
+        # paged-attention dispatch policy ("auto"|"force"|"reference");
+        # trace-time static — see DeepSpeedInferenceConfig.paged_kernel
+        from deepspeed_tpu.ops.attention import decode as _decode_ops
+        mode = {"off": "reference"}.get(config.paged_kernel,
+                                        config.paged_kernel)
+        if mode not in _decode_ops.PAGED_KERNEL_MODES:
+            raise ValueError(
+                f"unsupported paged_kernel {config.paged_kernel!r}; "
+                f"pick one of {_decode_ops.PAGED_KERNEL_MODES}")
+        self.paged_kernel_mode = mode
         # don't clobber a live training engine's global mesh; module
         # internals see self.mesh via dist.mesh_scope around every trace
         if dist.get_mesh() is None:
@@ -242,15 +263,42 @@ class InferenceEngine:
 
     def _serving_scope(self):
         """Trace scope for the model-tracing serving primitives: the
-        mesh via ``dist.mesh_scope`` (module internals) plus the
-        engine's serving rule table via ``sharding.config_scope`` (the
-        in-graph KV-pool constraint must agree with the pinned
-        out_shardings even under a custom table)."""
+        mesh via ``dist.mesh_scope`` (module internals), the engine's
+        serving rule table via ``sharding.config_scope`` (the in-graph
+        KV-pool constraint must agree with the pinned out_shardings
+        even under a custom table), and the paged-kernel dispatch mode
+        via ``decode.kernel_mode_scope`` (so
+        ``paged_decode_attention`` resolves kernel-vs-reference with
+        the engine's configured policy)."""
         import contextlib
+        from deepspeed_tpu.ops.attention.decode import kernel_mode_scope
         stack = contextlib.ExitStack()
         stack.enter_context(dist.mesh_scope(self.mesh))
         stack.enter_context(config_scope(self.serving_sharding))
+        stack.enter_context(kernel_mode_scope(self.paged_kernel_mode))
         return stack
+
+    def paged_kernel_decision(self, pools=None, page_size=None):
+        """The paged-attention kernel-eligibility decision
+        (``ops/attention/decode.paged_kernel_decision``) for THIS
+        engine's model + mesh + configured mode: ``{"path", "dispatch",
+        "reason"}``.  ``page_size`` comes from the live pools when
+        given (the leaves' page dim), else from the argument; the
+        serving dispatch makes the IDENTICAL decision at trace time, so
+        what health() reports is what runs."""
+        from deepspeed_tpu.ops.attention import decode as _decode_ops
+        heads, kv_heads = self._model_head_counts()
+        if page_size is None and pools is not None:
+            layers = pools.get("layers") if isinstance(pools, dict) \
+                else None
+            if layers:
+                page_size = int(layers[0]["k_pages"].shape[1])
+        cfg = getattr(self.module, "cfg", None)
+        return _decode_ops.paged_kernel_decision(
+            num_heads=heads or 1, num_kv_heads=kv_heads or heads or 1,
+            page_size=page_size, mesh=self.mesh,
+            mode=self.paged_kernel_mode,
+            has_bias=bool(getattr(cfg, "use_alibi", False)))
 
     def serving_mesh_info(self, pools=None, num_slots=None):
         """Mesh topology + serving-sharding snapshot for operators
@@ -267,7 +315,19 @@ class InferenceEngine:
             "mesh_devices": int(np.prod(list(self.mesh.shape.values()))),
             "serving_axes":
                 self._serving_shardings(num_slots=num_slots).describe(),
+            # the kernel-vs-reference dispatch decision, as data — an
+            # accidental reference-path fallback must be visible to
+            # operators, never silent (health() snapshots this)
+            "paged_attention": self.paged_kernel_decision(pools=pools),
         }
+        if self.mesh_dcn:
+            info["mesh_hybrid"] = {
+                "ici": {a: int(s) // self.mesh_dcn.get(a, 1)
+                        for a, s in self.mesh.shape.items()
+                        if int(s) // self.mesh_dcn.get(a, 1) > 1} or
+                       {"data": 1},
+                "dcn": dict(self.mesh_dcn),
+            }
         if pools is not None:
             info["kv_pool_bytes_per_device"] = pool_bytes_per_device(pools)
             info["kv_pool_bytes_total"] = sum(
@@ -669,6 +729,26 @@ class InferenceEngine:
                 raise ValueError(
                     f"unsupported kv_dtype {dt!r}; pick one of "
                     f"{sorted(DTYPES) + sorted(KV_QUANT_DTYPES)}")
+        # one-shot kernel-eligibility report at pool construction (the
+        # serving "constructor" moment): which paged-attention path
+        # will run, how it dispatches, and why — an accidental
+        # reference fallback is a logged fact plus a health() field,
+        # never a silent slowdown.  A page size that is the ONLY
+        # blocker warns loudly by name (the old silent `page_size %
+        # 128` gate).
+        dec = self.paged_kernel_decision(page_size=page_size)
+        if not getattr(self, "_paged_kernel_logged", False):
+            self._paged_kernel_logged = True
+            via = f" via {dec['dispatch']}" if dec.get("dispatch") else ""
+            log_dist(f"paged attention path: {dec['path']}{via} — "
+                     f"{dec['reason']}", ranks=[0])
+        if dec.get("blocker") == "page_size":
+            import warnings
+            warnings.warn(
+                f"page_size={page_size} keeps the paged Pallas kernel "
+                "OFF (pages must tile the 128-lane TPU layout): decode "
+                "runs the gather reference path — use page_size 128 or "
+                "256 for kernel-speed paged attention", stacklevel=2)
         pool_sh = self._serving_shardings().pool
         with dist.mesh_scope(self.mesh):
             return jax.jit(lambda: mod.init_paged_kv_cache(

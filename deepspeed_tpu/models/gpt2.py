@@ -215,7 +215,13 @@ class SelfAttention(nn.Module):
                 out = decode_attention(q, k_slot, v_slot, bias=bias)
             else:
                 # continuous-batch decode: b == slots, l == 1; inactive
-                # slots write nowhere and produce ignored outputs
+                # slots write nowhere and produce ignored outputs.
+                # paged_decode_attention owns the kernel-vs-reference
+                # dispatch (engine's paged_kernel mode rides the trace
+                # scope): on a multi-device mesh the Pallas kernel
+                # runs per-shard under shard_map — kv heads over
+                # `model`, slots over `data`, the page table global —
+                # so this call site never changes with the topology
                 active = cache["active"]
                 pos = positions[:, 0]                    # [slots]
                 page_ids = jnp.where(active,

@@ -150,6 +150,11 @@ class LlamaAttention(nn.Module):
                                  jnp.finfo(jnp.float32).min)[:, None]
                 out = decode_attention(q, k_slot, v_slot, bias=bias)
             else:                        # continuous-batch decode (l == 1)
+                # paged_decode_attention owns the kernel dispatch: GQA
+                # pools run the per-kv-head BlockSpec kernel grouped
+                # (never expanded), and a multi-device mesh runs it
+                # per-shard under shard_map — each device gets its kv
+                # shard's q-head group; this call site is topology-blind
                 active = cache["active"]
                 pos = positions[:, 0]
                 page_ids = jnp.where(active,

@@ -130,20 +130,42 @@ def _repeat_kv(x, n_rep):
         .reshape(b, l, h * n_rep, d)
 
 
+def _inside_shard_map(mesh):
+    """True when tracing INSIDE a ``shard_map`` body over ``mesh``: the
+    mesh axis names are bound as manual axes there, so probing any of
+    them succeeds.  The per-shard context must never re-trigger the
+    multi-chip dispatch decision — inside the body each device already
+    holds exactly its shard, and the kernel runs on local arrays."""
+    for a in mesh.axis_names:
+        try:
+            jax.lax.axis_size(a)
+            return True
+        except Exception:       # NameError: axis not bound -> outside
+            continue
+    return False
+
+
 def _multichip_mesh():
     """True when the trace-time serving mesh spans more than one device
-    on the ``model``/``data`` axes.  GSPMD cannot partition a
-    ``pallas_call``, so the decode kernels must not see mesh-sharded
-    operands: the jnp fallback shards cleanly under GSPMD (slots over
-    `data`, kv heads over `model`) and is what multi-chip serving
-    routes through — a shard_mapped per-shard paged kernel is the
-    follow-up, not a silent wrong answer.  ``force_kernel`` still
-    overrides (single-device parity tests)."""
+    on the ``model``/``data`` axes — AND we are not already inside a
+    ``shard_map`` body (the per-shard context sees only local arrays;
+    re-triggering the mesh bypass there would route every shard to the
+    gather reference and defeat the dispatch).
+
+    GSPMD cannot partition a ``pallas_call``, so on a multi-device mesh
+    the paged decode runs the kernel through the ``shard_map`` dispatch
+    in :func:`paged_decode_attention` (each device runs the kernel over
+    its kv-head/slot shard); the dense-cache :func:`decode_attention`
+    still falls back to the jnp reference, which shards cleanly under
+    GSPMD.  ``force_kernel`` still overrides (single-device parity
+    tests)."""
     from deepspeed_tpu import comm as dist
     mesh = dist.get_mesh()
     if mesh is None:
         return False
-    return any(int(mesh.shape.get(a, 1)) > 1 for a in ("model", "data"))
+    if not any(int(mesh.shape.get(a, 1)) > 1 for a in ("model", "data")):
+        return False
+    return not _inside_shard_map(mesh)
 
 
 def _paged_decode_kernel_quant(pt_ref, len_ref, q_ref, k_ref, v_ref,
@@ -268,6 +290,132 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = ((acc_scr[:h] / l)[:, None, :]).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel_gqa(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                             scale, page_size, np_, quantized):
+    """GQA-native paged decode: one grid step is ONE kv head's GROUP of
+    query heads against one page, so the grid is (slots, kv_heads,
+    pages) and the K/V BlockSpec picks a single kv head — the pool is
+    never expanded to full heads (the ``_repeat_kv`` copy the original
+    auto path paid group_factor x pool bytes for).  ``q`` arrives
+    pre-reshaped [slots, kv_heads, group, d] (query head kv*group + g
+    belongs to kv head kv — the same contiguous grouping
+    ``_repeat_kv`` spells out), so the per-step dot is a plain
+    [group, d] x [page_size, d]^T matmul.  ``quantized`` appends the
+    per-row scale refs ([1, page_size, 1, 1] blocks riding the SAME
+    prefetched page-table index map) and dequantizes in VMEM before
+    the dot.  Tiling note: blocks expose (group, d) / (page_size, d)
+    as their trailing dims; a sub-8 ``group`` relies on Mosaic padding
+    the sublane tile — interpret mode (CI) is exact either way, and
+    the real-TPU bench run is where the tile economics get measured."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    si = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    # pages is the innermost grid dim: ki resets to 0 whenever the
+    # (slot, kv head) pair advances, so this init starts a fresh
+    # online-softmax accumulation per pair
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    g = q_ref.shape[2]
+    pos = len_ref[si]
+
+    @pl.when(ki * page_size <= pos)
+    def _compute():
+        q = q_ref[0, 0]                                   # [group, d]
+        k = k_ref[0, :, 0, :]                             # [ps, d]
+        v = v_ref[0, :, 0, :]                             # [ps, d]
+        if quantized:
+            k = (k.astype(jnp.float32) *
+                 ks_ref[0, :, 0, :].astype(jnp.float32)).astype(q.dtype)
+            v = (v.astype(jnp.float32) *
+                 vs_ref[0, :, 0, :].astype(jnp.float32)).astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [group, ps]
+        k_pos = ki * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        s = jnp.maximum(s, NEG_INF)
+
+        m_prev = m_scr[:g, :1]
+        l_prev = l_scr[:g, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        row_live = m_new > NEG_INF / 2
+        alpha = jnp.where(row_live, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(row_live, jnp.exp(s - m_new), 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [group, d]
+        acc_scr[:g] = acc_scr[:g] * alpha + pv
+        m_scr[:g] = jnp.broadcast_to(m_new, (g, m_scr.shape[1]))
+        l_scr[:g] = jnp.broadcast_to(l_new, (g, l_scr.shape[1]))
+
+    @pl.when(ki == np_ - 1)
+    def _finalize():
+        l = l_scr[:g, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:g] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas_gqa(q, k_pages, v_pages, page_table, positions, *,
+                             scale, interpret, k_scale=None, v_scale=None):
+    """Grouped-query paged kernel dispatch: grid (slots, kv_heads,
+    pages), per-kv-head BlockSpecs — see ``_paged_decode_kernel_gqa``.
+    Shapes as in :func:`_paged_decode_pallas`."""
+    slots, one, h, d = q.shape
+    page_size, kv_h = k_pages.shape[1], k_pages.shape[2]
+    maxp = page_table.shape[1]
+    group = h // kv_h
+    quantized = k_scale is not None
+    # [slots, 1, h, d] -> [slots, kv_h, group, d]: head kv*group + g is
+    # kv head kv's g-th query head (the _repeat_kv grouping)
+    q_g = q.transpose(0, 2, 1, 3).reshape(slots, kv_h, group, d)
+    scr_rows = max(group, 8)   # TPU sublane tile
+
+    page_spec = pl.BlockSpec(
+        (1, page_size, 1, d),
+        lambda si, hi, ki, pt, ln: (pt[si, ki], 0, hi, 0))
+    q_spec = pl.BlockSpec((1, 1, group, d),
+                          lambda si, hi, ki, pt, ln: (si, hi, 0, 0))
+    in_specs = [q_spec, page_spec, page_spec]
+    operands = [q_g, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, page_size, 1, 1),
+            lambda si, hi, ki, pt, ln: (pt[si, ki], 0, hi, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    kernel = functools.partial(_paged_decode_kernel_gqa, scale=scale,
+                               page_size=page_size, np_=maxp,
+                               quantized=quantized)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, kv_h, maxp),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((scr_rows, 128), jnp.float32),
+            pltpu.VMEM((scr_rows, 128), jnp.float32),
+            pltpu.VMEM((scr_rows, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, kv_h, group, d), q.dtype),
+        interpret=interpret,
+    )(page_table, positions, *operands)
+    return out.reshape(slots, h, d)[:, None]              # [slots, 1, h, d]
+
+
 def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions, *,
                          scale, interpret, k_scale=None, v_scale=None):
     slots, one, h, d = q.shape
@@ -276,11 +424,13 @@ def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions, *,
     kv_h = k_pages.shape[2]
     quantized = k_scale is not None
     if kv_h != h:
-        k_pages = _repeat_kv(k_pages, h // kv_h)
-        v_pages = _repeat_kv(v_pages, h // kv_h)
-        if quantized:
-            k_scale = _repeat_kv(k_scale, h // kv_h)
-            v_scale = _repeat_kv(v_scale, h // kv_h)
+        # grouped (GQA) pools get the per-kv-head BlockSpec kernel: the
+        # q-head group rides in per kv head and the pool streams its
+        # native grouped layout (no _repeat_kv expansion copying
+        # group x pool bytes per step)
+        return _paged_decode_pallas_gqa(
+            q, k_pages, v_pages, page_table, positions, scale=scale,
+            interpret=interpret, k_scale=k_scale, v_scale=v_scale)
     scr_rows = max(h, 8)
     q_t = q.transpose(0, 2, 1, 3)                         # [slots, h, 1, d]
 
@@ -326,6 +476,162 @@ def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions, *,
     return out.transpose(0, 2, 1, 3)                      # [slots, 1, h, d]
 
 
+_KERNEL_MODE = None       # None -> "auto"; see kernel_mode_scope
+
+PAGED_KERNEL_MODES = ("auto", "force", "reference")
+
+
+class kernel_mode_scope:
+    """Trace-time channel for the paged-kernel dispatch policy: the
+    engine wraps every serving trace in
+    ``kernel_mode_scope(engine.paged_kernel_mode)`` so
+    :func:`paged_decode_attention` resolves kernel-vs-reference with
+    the engine's CONFIGURED mode ("auto" | "force" | "reference").
+    The mode is an engine-lifetime static — it picks the traced branch,
+    so flipping it after the serving fns compiled would not retrace
+    (same contract as the mesh/rule-table scopes)."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self._saved = None
+
+    def __enter__(self):
+        global _KERNEL_MODE
+        self._saved = _KERNEL_MODE
+        _KERNEL_MODE = self.mode
+        return self.mode
+
+    def __exit__(self, *exc):
+        global _KERNEL_MODE
+        _KERNEL_MODE = self._saved
+        return False
+
+
+def paged_kernel_decision(*, num_heads, num_kv_heads, page_size,
+                          mesh=None, mode="auto", has_bias=False,
+                          backend=None):
+    """THE paged-attention kernel-eligibility decision, as data: returns
+    ``{"path": "kernel"|"reference", "dispatch": "shard_map"|"direct"|
+    None, "reason": str}``.  :func:`paged_decode_attention` makes this
+    exact decision at trace time; the engine surfaces it through
+    ``serving_mesh_info()``/``health()`` (one-shot logged at pool
+    construction) so an accidental reference-path fallback is VISIBLE
+    instead of silent — the decision depends only on static config
+    (model head counts, page size, mesh, backend, mode), never on
+    per-step data, so the two views cannot disagree.
+
+    ``dispatch`` says HOW the kernel runs: "direct" is a plain
+    ``pallas_call`` (single device), "shard_map" wraps it per-shard
+    over the mesh (each device runs the kernel on its kv-head/slot
+    shard — GSPMD cannot partition a ``pallas_call``, so multi-chip
+    kernels only exist through this dispatch)."""
+    if mode not in PAGED_KERNEL_MODES:
+        raise ValueError(f"unknown paged-kernel mode {mode!r}; pick one "
+                         f"of {PAGED_KERNEL_MODES}")
+    multi = False
+    if mesh is not None:
+        multi = any(int(mesh.shape.get(a, 1)) > 1
+                    for a in ("model", "data"))
+    disp = "shard_map" if multi else "direct"
+
+    def ref(reason):
+        return {"path": "reference", "dispatch": None, "reason": reason}
+
+    if pltpu is None:
+        return ref("this jax build has no Pallas TPU backend")
+    if has_bias:
+        return ref("additive bias (ALiBi) rides the gather reference "
+                   "(the paged kernel computes only the positional "
+                   "mask in-kernel)")
+    if num_kv_heads and num_heads % num_kv_heads != 0:
+        return ref(f"num_heads={num_heads} is not a multiple of "
+                   f"num_kv_heads={num_kv_heads}")
+    if mode == "reference":
+        return ref("paged_kernel='reference' pins the gather fallback")
+    if mode == "force":
+        return {"path": "kernel", "dispatch": disp,
+                "reason": "paged_kernel='force' pins the kernel "
+                          "(interpret mode off-TPU)"}
+    backend = jax.default_backend() if backend is None else backend
+    if backend != "tpu":
+        return ref(f"off-TPU backend {backend!r}: interpret-mode Pallas "
+                   "is slower than the jnp reference "
+                   "(paged_kernel='force' overrides for parity runs)")
+    if page_size is None:
+        return ref("page size unknown until the paged pool is built")
+    if page_size % 128 != 0:
+        # `blocker` is the STRUCTURED spelling of this gate: the
+        # engine's constructor-time warning keys on it, never on the
+        # human-readable reason wording
+        out = ref(f"page_size={page_size} is not a multiple of 128 "
+                  "(the TPU lane tile): the paged Pallas kernel "
+                  "cannot tile its pages — pick page_size 128/256 to "
+                  "enable the kernel path")
+        out["blocker"] = "page_size"
+        return out
+    return {"path": "kernel", "dispatch": disp,
+            "reason": "TPU backend, 128-aligned pages"
+                      + (" — shard_mapped over the mesh" if multi
+                         else "")}
+
+
+def _shard_map_axes(mesh, slots, h, kv_h):
+    """Resolve which mesh axes the shard_map dispatch partitions over,
+    from the ACTIVE serving rule table (serving/sharding.py
+    ``config_scope`` — the same trace-time channel
+    ``constrain_kv_pages`` reads, so the per-shard split always agrees
+    with the pinned pool/carry shardings).  An axis that cannot divide
+    its dim degrades to replicated for that dim — exactly mirroring
+    ``ServingShardingConfig.resolve``'s slot-family degrade."""
+    from deepspeed_tpu.serving.sharding import active_rules
+    rules = active_rules()
+    kv_ax = rules.get("kv_heads")
+    slot_ax = rules.get("slots")
+    msize = int(mesh.shape.get(kv_ax, 1)) if kv_ax else 1
+    dsize = int(mesh.shape.get(slot_ax, 1)) if slot_ax else 1
+    head_ax = kv_ax if (msize > 1 and kv_h % msize == 0 and
+                        h % msize == 0) else None
+    s_ax = slot_ax if (dsize > 1 and slots % dsize == 0) else None
+    return head_ax, s_ax
+
+
+def _paged_decode_shard_map(q, k_pages, v_pages, page_table, positions,
+                            *, scale, interpret, mesh, k_scale=None,
+                            v_scale=None):
+    """Run the paged kernel per-shard over the serving mesh: kv pools
+    enter sharded [pages, ps, KV_H/model, dim] (each device holds its
+    kv-head slice of EVERY page — page ids are global, the host-side
+    page table needs no translation), q/page_table/positions shard
+    their slot dim over ``data``, and each shard runs the ordinary
+    kernel on its local arrays — so per-shard BlockSpecs need no new
+    indexing, and GQA groups stay intact (the q-head group belonging
+    to the local kv shard rides in; a sharded MHA model sees grouped
+    heads the same way).  Inside the body ``_multichip_mesh`` reports
+    False (the axis names are bound), so nothing re-triggers the mesh
+    bypass."""
+    from jax.sharding import PartitionSpec as P
+    slots, _, h, d = q.shape
+    kv_h = k_pages.shape[2]
+    head_ax, slot_ax = _shard_map_axes(mesh, slots, h, kv_h)
+    q_spec = P(slot_ax, None, head_ax, None)
+    pool_spec = P(None, None, head_ax, None)
+    in_specs = [q_spec, pool_spec, pool_spec, P(slot_ax, None),
+                P(slot_ax)]
+    args = [q, k_pages, v_pages, page_table, positions]
+    if k_scale is not None:
+        in_specs += [pool_spec, pool_spec]
+        args += [k_scale, v_scale]
+
+    def body(q_, kp_, vp_, pt_, pos_, *scales):
+        ks, vs = scales if scales else (None, None)
+        return _paged_decode_pallas(q_, kp_, vp_, pt_, pos_, scale=scale,
+                                    interpret=interpret, k_scale=ks,
+                                    v_scale=vs)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=q_spec, check_vma=False)(*args)
+
+
 def gather_pages(pages, page_table):
     """[num_pages, page_size, kv_h, d] gathered through [slots, maxp] ->
     contiguous per-slot buffers [slots, maxp*page_size, kv_h, d].
@@ -356,10 +662,18 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
     reference for CPU/mesh parity).
 
     The Pallas path streams K/V page-by-page via scalar-prefetched table
-    lookups (true PagedAttention: no per-slot contiguous copy). The
-    fallback gathers pages into contiguous buffers and reuses
-    :func:`decode_attention` — correct everywhere, but it materializes
-    [slots, max_pages*page_size] K/V transiently.
+    lookups (true PagedAttention: no per-slot contiguous copy); GQA
+    pools run it with per-kv-head BlockSpecs (the q-head group rides in
+    per kv head — the pool is never expanded), and on a multi-device
+    mesh it runs per-shard under ``shard_map`` (kv heads over
+    ``model``, slots over ``data``; see ``_paged_decode_shard_map``).
+    The fallback gathers pages into contiguous buffers and reuses
+    :func:`decode_attention` — correct everywhere (it is the jnp
+    correctness oracle, and what GSPMD partitions when the kernel is
+    ineligible), but it materializes [slots, max_pages*page_size] K/V
+    transiently.  :func:`paged_kernel_decision` is the one
+    kernel-vs-reference rule; the engine exports it through
+    ``serving_mesh_info()``/``health()``.
 
     ``bias`` (optional, broadcastable to [slots, heads, 1, max_len])
     carries extra additive terms (ALiBi); when present the fallback path
@@ -381,18 +695,33 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions, *,
         interpret = jax.default_backend() != "tpu"
     positions = positions.astype(jnp.int32)
 
-    # GQA pools stay on the gather fallback in auto mode: expanding the
-    # WHOLE pool to full heads (the contiguous kernel's _repeat_kv trick)
-    # would copy group_factor x pool bytes per step — more traffic than
-    # the per-slot gather it is meant to avoid. A true GQA paged kernel
-    # needs per-kv-head BlockSpec mapping (future work); force_kernel
-    # still exercises the expansion path for parity tests.
-    use_kernel = (l == 1 and bias is None and pltpu is not None and
-                  h % kv_h == 0 and
-                  (force_kernel or (kv_h == h and page_size % 128 == 0 and
-                                    jax.default_backend() == "tpu" and
-                                    not _multichip_mesh())))
-    if use_kernel:
+    # Kernel-vs-reference dispatch (all static, scan-safe): the
+    # decision is paged_kernel_decision's — the same function the
+    # engine surfaces through serving_mesh_info()/health(), so the
+    # active path is always visible to operators.  GQA pools run the
+    # per-kv-head BlockSpec kernel (grid (slots, kv_heads, pages) — no
+    # pool expansion); on a multi-device mesh the kernel runs through
+    # the shard_map dispatch, each device over its kv-head/slot shard
+    # (GSPMD cannot partition a pallas_call, so this dispatch is the
+    # ONLY multi-chip kernel path — the jnp reference below remains
+    # the GSPMD-partitionable correctness oracle).  Inside a shard_map
+    # body the mesh axes are bound, _multichip_mesh reports False, and
+    # the decision resolves "direct" — the per-shard kernel never
+    # re-triggers the bypass.
+    from deepspeed_tpu import comm as dist
+    mesh = dist.get_mesh()
+    if mesh is not None and _inside_shard_map(mesh):
+        mesh = None
+    mode = "force" if force_kernel else (_KERNEL_MODE or "auto")
+    decision = paged_kernel_decision(
+        num_heads=h, num_kv_heads=kv_h, page_size=page_size, mesh=mesh,
+        mode=mode, has_bias=bias is not None)
+    if l == 1 and decision["path"] == "kernel":
+        if decision["dispatch"] == "shard_map":
+            return _paged_decode_shard_map(
+                q, k_pages, v_pages, page_table.astype(jnp.int32),
+                positions, scale=scale, interpret=interpret, mesh=mesh,
+                k_scale=k_scale, v_scale=v_scale)
         return _paged_decode_pallas(q, k_pages, v_pages,
                                     page_table.astype(jnp.int32), positions,
                                     scale=scale, interpret=interpret,

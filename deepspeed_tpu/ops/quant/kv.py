@@ -187,9 +187,13 @@ def paged_gather(pools, page_table, dtype):
     float path returns the raw gathered pages (exactly the
     pre-quantization behavior); the quantized path gathers payload AND
     scale pools (the scales ride the same page ids) and dequantizes to
-    ``dtype`` — the jnp reference path for CPU/mesh parity, where the
-    transient dequantized buffer is the price of GSPMD-partitionable
-    ops."""
+    ``dtype`` — the jnp reference/oracle path, where the transient
+    dequantized buffer is the price of GSPMD-partitionable ops.  The
+    fast path on any topology is the Pallas kernel in
+    ``ops/attention/decode.py``: its quantized variants fetch each
+    page's scale block through the same prefetched page-table index
+    map and dequantize in VMEM (shard_mapped per-shard on a
+    multi-device mesh), so only quantized bytes stream from HBM."""
     from deepspeed_tpu.ops.attention.decode import gather_pages
     k = gather_pages(pools["k_pages"], page_table)
     v = gather_pages(pools["v_pages"], page_table)

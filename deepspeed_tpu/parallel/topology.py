@@ -158,6 +158,74 @@ def make_mesh(mesh_config=None, devices=None, allow_subset=False):
     return Mesh(device_array, MESH_AXES)
 
 
+def make_hybrid_mesh(mesh_config=None, dcn_sizes=None, devices=None,
+                     allow_subset=False):
+    """Multi-slice ICI x DCN mesh: per-axis size = ici * dcn (the
+    t5x/MaxText hybrid split).  ``mesh_config`` carries the ICI
+    (within-slice) sizes — ``-1`` resolves against the PER-SLICE device
+    count — and ``dcn_sizes`` maps axis names to their across-slice
+    (DCN) factors.  On real multi-slice TPU pods the device array comes
+    from ``mesh_utils.create_hybrid_device_mesh`` (devices grouped by
+    ``slice_index``, DCN-major per axis so ICI neighbors stay
+    physically adjacent); single-slice/CPU runtimes — where devices
+    carry no slice attribution — fall back to the same DCN-major
+    per-axis layout over the flat device list, so the topology is pure
+    config everywhere and CI exercises the exact axis arithmetic a pod
+    run uses.
+
+    Keep ``model`` (tensor parallel) ICI-only: a ``dcn_sizes['model']``
+    factor is legal config but puts per-layer collectives on the slow
+    across-slice links — the serving rule table maps ``slots`` to the
+    DCN-spanning ``data`` axis precisely so per-token traffic never
+    crosses DCN."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    dcn = {ax: int((dcn_sizes or {}).get(ax, 1) or 1) for ax in MESH_AXES}
+    bad = [f"{ax}={s}" for ax, s in dcn.items() if s < 1]
+    if bad:
+        raise ValueError(f"dcn mesh sizes must be >= 1 (no -1 wildcard "
+                         f"across slices): {', '.join(bad)}")
+    unknown = set(dcn_sizes or {}) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown dcn mesh axes {sorted(unknown)}; "
+                         f"valid axes: {MESH_AXES}")
+    total_dcn = int(np.prod(list(dcn.values())))
+    if n % total_dcn != 0:
+        raise ValueError(
+            f"dcn mesh {dcn_sizes} needs a device count divisible by "
+            f"{total_dcn}, got {n}")
+    ici = resolve_mesh_dims(mesh_config, n // total_dcn,
+                            allow_subset=allow_subset) \
+        if mesh_config is not None else \
+        {ax: (n // total_dcn if ax == "data" else 1) for ax in MESH_AXES}
+    ici_shape = tuple(ici[ax] for ax in MESH_AXES)
+    dcn_shape = tuple(dcn[ax] for ax in MESH_AXES)
+    total = int(np.prod(ici_shape)) * total_dcn
+    devices = list(devices)[:total]
+    if getattr(devices[0], "slice_index", None) is not None:
+        # real multi-slice pod: slice membership is ground truth, and
+        # any shape/topology mismatch must fail LOUDLY here — falling
+        # back to a flat-list layout would silently put "ICI" neighbors
+        # across DCN and tank every per-layer collective
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        # single-slice / CPU devices carry no slice attribution:
+        # emulate the hybrid layout — DCN-major per axis, matching
+        # create_hybrid_device_mesh's semantics (slice-local blocks
+        # stay contiguous on every combined axis) — so CI exercises
+        # the exact axis arithmetic a pod run uses
+        arr = np.asarray(devices).reshape(dcn_shape + ici_shape)
+        nd = len(MESH_AXES)
+        perm = []
+        for i in range(nd):
+            perm += [i, nd + i]
+        device_array = arr.transpose(perm).reshape(
+            tuple(d * i for d, i in zip(dcn_shape, ici_shape)))
+    return Mesh(device_array, MESH_AXES)
+
+
 def single_device_mesh(device=None):
     device = device or jax.devices()[0]
     arr = np.asarray([device]).reshape((1,) * len(MESH_AXES))

@@ -2042,6 +2042,11 @@ class ServingScheduler:
             "mesh": self.mesh_info.get("mesh_shape"),
             "mesh_devices": self.mesh_info.get("mesh_devices"),
             "serving_axes": self.mesh_info.get("serving_axes"),
+            # the paged-attention path actually dispatched (kernel vs
+            # reference, shard_map vs direct, and why): an accidental
+            # reference fallback must show up on the operator surface,
+            # not hide behind a silent slowdown
+            "paged_attention": self.mesh_info.get("paged_attention"),
             # quantized serving memory: the pool dtype actually
             # allocated (int8/fp8 pools report their TRUE byte
             # footprint below — payload + scale leaves summed, never a

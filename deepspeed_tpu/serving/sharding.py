@@ -27,11 +27,16 @@ page dim stays replicated by design: every device holds the full page
 refcounts, donation, COW, eviction — is mesh-agnostic host logic and a
 page id means the same thing on every chip.
 
-A multi-slice ICI x DCN topology later is the same config: build the
-mesh with ``mesh_utils.create_hybrid_device_mesh`` (ICI parallelism
-within a slice, DCN across slices — SNIPPETS [2]/[3]), keep ``model``
-on the ICI-innermost axis, map ``slots`` to the DCN-spanning data axis,
-and these rules need not change.
+A multi-slice ICI x DCN topology IS the same config (landed):
+``parallel.topology.make_hybrid_mesh`` builds the device array with
+``mesh_utils.create_hybrid_device_mesh`` (ICI parallelism within a
+slice, DCN across slices — the t5x/MaxText split), ``model`` stays on
+the ICI-innermost axis, ``slots`` ride the DCN-spanning data axis, and
+this rule table is untouched — the engine takes the split as pure
+config (``mesh_dcn=`` / ``ds_serve --mesh ...,dcn.data=N``).  The
+shard_map'd paged kernel (ops/attention/decode.py) reads this same
+table through :func:`active_rules` so its per-shard split always
+agrees with the pinned pool/carry shardings.
 """
 
 import dataclasses
@@ -213,6 +218,16 @@ class config_scope:
         global _ACTIVE_CONFIG
         _ACTIVE_CONFIG = self._saved
         return False
+
+
+def active_rules():
+    """The ACTIVE logical-axis rule table as a dict (trace-time): the
+    engine-configured table inside a serving trace (``config_scope``),
+    the default table otherwise.  The shard_map'd paged kernel resolves
+    its per-shard axes through this, so a custom rule table partitions
+    the kernel consistently with the pinned shardings."""
+    cfg = _ACTIVE_CONFIG
+    return dict(cfg.rules if cfg is not None else SERVING_AXIS_RULES)
 
 
 def constrain_kv_pages(pages):

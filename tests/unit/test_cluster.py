@@ -337,6 +337,9 @@ HEALTH_SCHEMA = {
     "mesh": (dict, type(None)),
     "mesh_devices": (int, type(None)),
     "serving_axes": (dict, type(None)),
+    # the paged-attention dispatch decision (path/dispatch/reason) —
+    # kernel vs reference must be operator-visible, never silent
+    "paged_attention": (dict, type(None)),
     # quantized serving memory (kv_dtype in {float32, bfloat16, int8,
     # fp8}); the byte figures reflect the TRUE quantized footprint
     # (payload + scale pools summed from the allocated leaves)

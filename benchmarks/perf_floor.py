@@ -120,6 +120,22 @@ CHECKS = [
      "kv_quant.same_slots.speedup_tokens_per_sec", "info", None),
     ("kv-quant int8 tokens/s (equal bytes)",
      "kv_quant.capacity.int8.tokens_per_sec", "info", None),
+    # decoding-policy rows (PR 16): the sampled-vs-greedy throughput
+    # ratio prices the on-device logit pipeline (fp32 processing +
+    # categorical draws per token on a CPU rig — a TPU round will
+    # re-anchor); grammar validity must sit at 1.0 and the policy
+    # path's extra compiles near 0 (bucket coverage noise only) — info
+    # rows first, per the telemetry-PR pattern
+    ("sampled vs greedy tokens/s (policy mix)",
+     "sampling.sampled_vs_greedy", "info", None),
+    ("sampled tokens/s (policy mix)",
+     "sampling.sampled.tokens_per_sec", "info", None),
+    ("grammar-constrained tokens/s",
+     "sampling.grammar.tokens_per_sec", "info", None),
+    ("grammar schema-valid frac",
+     "sampling.grammar.grammar_valid_frac", "info", None),
+    ("policy-path extra compiles (timed repeats)",
+     "sampling.policy_extra_compiles", "info", None),
     # shard_map'd paged-kernel rows (PR 15): on CPU the kernel column
     # prices interpret-mode EMULATION (expected << 1 — it proves the
     # dispatch, not a win); the ratio becomes the real scorecard when

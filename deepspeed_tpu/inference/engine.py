@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.parallel import sharding as shd
 from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.serving.sampling import pipeline as policy_pipeline
 from deepspeed_tpu.serving.sharding import (ServingShardingConfig,
                                             config_scope,
                                             pool_bytes_per_device)
@@ -257,6 +258,8 @@ class InferenceEngine:
                 self._paged_decode_fn = None
                 self._paged_decode_multi_fn = None
                 self._paged_verify_fn = None
+                self._paged_decode_policy_fn = None
+                self._paged_verify_policy_fn = None
             self._serving_shd = fresh
             self._serving_shd_slots = num_slots
         return self._serving_shd
@@ -896,6 +899,131 @@ class InferenceEngine:
             return (out_toks, valid, tok_end, active_end, lengths_end,
                     emitted_end, accepted, {"layers": cache["layers"]})
 
+        def decode_multi_policy(params, tok, active, page_table, lengths,
+                                pools, emitted, budgets, eos_ids, keys,
+                                tok_base, temps, top_ks, top_ps, rep_pens,
+                                pres_pens, freq_pens, counts, mask,
+                                horizon):
+            """``decode_multi`` with the per-slot decoding-policy
+            pipeline (serving/sampling/pipeline.py) in place of the
+            static-args sampler.  EVERY policy knob is a traced
+            per-slot array — temperature, top-k/p, the three history
+            penalties over the ``counts`` token table, the grammar
+            ``mask``, and a per-request PRNG key + absolute token base
+            — so a mixed greedy/sampled/penalized/constrained batch is
+            ONE compiled signature per horizon bucket and param churn
+            never recompiles.  Token ``tok_base[s] + emitted[s]`` keys
+            the slot's fold_in stream: batching-independent and
+            replayable across preemption/failover.  Freeze rules are
+            decode_multi's exactly; ``counts`` rides the carry so
+            penalties see tokens sampled earlier in the same chain."""
+            slots = tok.shape[0]
+
+            def body(carry, i):
+                tok, active, lengths, emitted, counts, layers = carry
+                cache = {"layers": layers, "page_table": page_table,
+                         "lengths": lengths, "active": active}
+                logits, cache = module.apply(
+                    {"params": materialize(params)}, tok[:, None],
+                    cache=cache)
+                x = policy_pipeline.process_logits(
+                    logits[:, 0], counts, mask, temps, top_ks, top_ps,
+                    rep_pens, pres_pens, freq_pens)
+                nxt = policy_pipeline.sample_processed(
+                    x, keys, tok_base + emitted, temps).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tok)
+                counts = counts.at[jnp.arange(slots), nxt].add(
+                    active.astype(jnp.int32))
+                emitted = emitted + active.astype(jnp.int32)
+                new_active = active & (nxt != eos_ids) & (emitted < budgets)
+                return (nxt, new_active, cache["lengths"], emitted,
+                        counts, cache["layers"]), (nxt, active)
+            (tok, active, lengths, emitted, counts, layers), \
+                (toks, valid) = jax.lax.scan(
+                    body, (tok, active, lengths, emitted, counts,
+                           pools["layers"]), jnp.arange(horizon))
+            return (toks.T, valid.T, tok, active, lengths, emitted,
+                    counts, {"layers": layers})
+
+        def verify_multi_policy(params, tok, drafts, widths, active,
+                                page_table, lengths, pools, emitted,
+                                budgets, eos_ids, keys, tok_base, temps,
+                                top_ks, top_ps, rep_pens, pres_pens,
+                                freq_pens, counts, mask):
+            """Lossless speculative verification under the decoding
+            policy: one teacher-forced forward (identical to
+            ``verify_multi``), then a scan over the K+1 logit columns
+            applying leftover-probability rejection sampling per slot.
+            Our drafters propose point-mass tokens (no draft probs), so
+            the accept rule collapses to ``u < p_target(draft)`` and a
+            rejection resamples the residual (p_target with the draft
+            zeroed, renormalized) — by construction the emitted stream
+            is distributed EXACTLY as sequential ``decode_multi_policy``
+            (frequency oracle pins this).  Greedy rows (temp == 0) keep
+            the legacy token-exact rule: accept iff fp32 argmax ==
+            draft, the correction token IS the argmax.  Column ``j``
+            draws from ``fold_in(key, tok_base + j)`` sub-streams;
+            counts carry accepted drafts so penalties stay causal
+            within the round.  Assembly (eos/budget clamping, rewound
+            lengths, carries) matches ``verify_multi`` line for line."""
+            slots, K = drafts.shape
+            x_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+            cols = jnp.where(active, widths + 1, 0)
+            cache = dict(pools, page_table=page_table, lengths=lengths,
+                         active=active, widths=cols)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         x_in, cache=cache)
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+
+            def col(carry, j):
+                counts_c, accepting, acc, bonus = carry
+                lg = policy_pipeline.process_logits(
+                    logits[:, j], counts_c, mask, temps, top_ks, top_ps,
+                    rep_pens, pres_pens, freq_pens)
+                d = drafts_pad[:, j]
+                is_draft = (j < widths) & accepting
+                is_bonus = (j == widths) & accepting
+                accept_col, fallback = policy_pipeline.accept_or_resample(
+                    lg, d, keys, tok_base + j, temps)
+                bonus_col = policy_pipeline.bonus_sample(
+                    lg, keys, tok_base + j, temps)
+                draft_accept = is_draft & accept_col
+                reject_now = is_draft & ~accept_col
+                bonus = jnp.where(reject_now, fallback,
+                                  jnp.where(is_bonus, bonus_col, bonus))
+                counts_c = counts_c.at[jnp.arange(slots), d].add(
+                    draft_accept.astype(jnp.int32))
+                acc = acc + draft_accept.astype(jnp.int32)
+                return (counts_c, draft_accept, acc, bonus), None
+            (counts, _, a, bonus), _ = jax.lax.scan(
+                col, (counts, active, jnp.zeros(slots, jnp.int32),
+                      jnp.zeros(slots, jnp.int32)), jnp.arange(K + 1))
+            jW = jnp.arange(K + 1)
+            out_toks = jnp.where(jW[None, :] < a[:, None], drafts_pad,
+                                 bonus[:, None])
+            nominal = a + 1
+            is_eos = (out_toks == eos_ids[:, None]) & \
+                (eos_ids[:, None] >= 0)
+            has_eos = jnp.any(is_eos, axis=1)
+            n_eos = jnp.where(has_eos, jnp.argmax(is_eos, axis=1) + 1,
+                              K + 2)
+            n = jnp.minimum(jnp.minimum(nominal, n_eos),
+                            jnp.maximum(budgets - emitted, 0))
+            n = jnp.where(active, n, 0)
+            valid = jW[None, :] < n[:, None]
+            emitted_end = emitted + n
+            last = jnp.take_along_axis(
+                out_toks, jnp.maximum(n - 1, 0)[:, None], axis=1)[:, 0]
+            tok_end = jnp.where(n > 0, last, tok)
+            emitted_eos = has_eos & (n_eos <= n)
+            active_end = active & ~emitted_eos & (emitted_end < budgets)
+            lengths_end = lengths + n
+            accepted = jnp.minimum(a, n)
+            return (out_toks, valid, tok_end, active_end, lengths_end,
+                    emitted_end, accepted, counts,
+                    {"layers": cache["layers"]})
+
         # every in/out array family gets its serving sharding
         # (serving/sharding.py): pools shard kv_heads over `model`,
         # slot carries / token blocks / the page table shard slots over
@@ -926,6 +1054,20 @@ class InferenceEngine:
             verify_multi, donate_argnums=(7,),
             out_shardings=(block, block, slot, slot, slot, slot, slot,
                            pool))
+        # policy twins: horizon is the ONLY static — every sampling /
+        # penalty / grammar knob is a traced per-slot array, so the
+        # compile count stays bounded by the horizon/K bucket sets
+        # across arbitrary per-request param churn.  counts donates and
+        # round-trips (the in-chain penalty carry); mask is read-only.
+        self._paged_decode_policy_fn = jax.jit(
+            decode_multi_policy, donate_argnums=(5, 17),
+            static_argnums=(19,),
+            out_shardings=(block, block, slot, slot, slot, slot, block,
+                           pool))
+        self._paged_verify_policy_fn = jax.jit(
+            verify_multi_policy, donate_argnums=(7, 19),
+            out_shardings=(block, block, slot, slot, slot, slot, slot,
+                           block, pool))
 
     def copy_page(self, pools, src_page, dst_page):
         """Copy ONE KV page across every layer's pool (the prefix
@@ -1266,11 +1408,168 @@ class InferenceEngine:
                                   detail=None if self._compile_watchdog
                                   is None else {"k": k})
 
+    def _stage_policy_inputs(self, shd, keys, tok_base, temps, top_ks,
+                             top_ps, rep_pens, pres_pens, freq_pens,
+                             counts, mask):
+        """Stage the per-slot decoding-policy arrays (one batched
+        device_put, same committed shardings every dispatch): the raw
+        uint32 request keys and every pipeline knob as slot lanes, the
+        counts/mask tables slot-major like the page table."""
+        slot, blk = shd.slot, shd.block
+        return self._stage_host_inputs([
+            (keys, np.uint32, blk), (tok_base, np.int32, slot),
+            (temps, np.float32, slot), (top_ks, np.int32, slot),
+            (top_ps, np.float32, slot), (rep_pens, np.float32, slot),
+            (pres_pens, np.float32, slot), (freq_pens, np.float32, slot),
+            (counts, np.int32, blk), (mask, bool, blk)])
+
+    def decode_multi_policy(self, toks, active, page_table, lengths,
+                            pools, *, horizon, budgets, eos_ids, keys,
+                            tok_base, temps, top_ks, top_ps, rep_pens,
+                            pres_pens, freq_pens, counts, mask,
+                            emitted=None):
+        """``decode_multi`` under the per-slot decoding policy.  Same
+        carries and return shape plus a ``counts`` carry before the
+        pools: ``(toks_block, valid, tok_end, active_end, lengths_end,
+        emitted_end, counts_end, pools)``.  All policy knobs are traced
+        per-slot arrays (see ``_build_serving_fns``) — ONE compiled
+        signature per horizon bucket regardless of the request mix, so
+        ``serving_decode_multi_compile_count()`` (which sums the legacy
+        and policy caches) stays within the bucket set across sampling-
+        param churn.  ``counts``/``mask`` accept host numpy at a
+        barrier or the previous call's device carry in a chain."""
+        assert self.params is not None, "set_params/init_params first"
+        shd = self._serving_shardings(num_slots=int(np.shape(budgets)[0]))
+        if getattr(self, "_paged_decode_policy_fn", None) is None:
+            self._build_serving_fns()
+        if emitted is None:
+            emitted = np.zeros(np.shape(budgets), np.int32)
+        slot, blk = shd.slot, shd.block
+        toks, active, page_table, lengths, emitted, budgets, eos_ids = \
+            self._stage_host_inputs([
+                (toks, np.int32, slot), (active, bool, slot),
+                (page_table, np.int32, blk), (lengths, np.int32, slot),
+                (emitted, np.int32, slot), (budgets, np.int32, slot),
+                (eos_ids, np.int32, slot)])
+        (keys, tok_base, temps, top_ks, top_ps, rep_pens, pres_pens,
+         freq_pens, counts, mask) = self._stage_policy_inputs(
+             shd, keys, tok_base, temps, top_ks, top_ps, rep_pens,
+             pres_pens, freq_pens, counts, mask)
+        args = (self.params, toks, active, page_table, lengths, pools,
+                emitted, budgets, eos_ids, keys, tok_base, temps,
+                top_ks, top_ps, rep_pens, pres_pens, freq_pens, counts,
+                mask)
+        if self._comm_capture is not None:
+            self._capture_comm_sig(
+                "decode_multi_policy",
+                f"decode_multi_policy[h={int(horizon)}]",
+                "_paged_decode_policy_fn", args, (int(horizon),))
+        with self._serving_scope():
+            return self._dispatch(
+                "decode_multi_policy", self._paged_decode_policy_fn,
+                *args, int(horizon),
+                detail=None if self._compile_watchdog is None
+                else {"horizon": int(horizon), "policy": True})
+
+    def verify_multi_policy(self, toks, drafts, active, page_table,
+                            lengths, pools, *, widths, budgets, eos_ids,
+                            keys, tok_base, temps, top_ks, top_ps,
+                            rep_pens, pres_pens, freq_pens, counts, mask,
+                            emitted=None):
+        """Lossless speculative verification under the decoding policy
+        (leftover-probability rejection sampling; greedy rows keep the
+        token-exact argmax rule).  ``verify_multi``'s contract with a
+        ``counts`` carry before the pools: ``(toks_block, valid,
+        tok_end, active_end, lengths_end, emitted_end, accepted,
+        counts_end, pools)``.  One compiled signature per K bucket —
+        sampling params are traced, so sampled+spec composes without
+        recompiles (the gate ``ds_serve`` used to force off)."""
+        assert self.params is not None, "set_params/init_params first"
+        shd = self._serving_shardings(num_slots=int(np.shape(budgets)[0]))
+        if getattr(self, "_paged_verify_policy_fn", None) is None:
+            self._build_serving_fns()
+        if emitted is None:
+            emitted = np.zeros(np.shape(budgets), np.int32)
+        slot, blk = shd.slot, shd.block
+        (toks, drafts, widths, active, page_table, lengths, emitted,
+         budgets, eos_ids) = self._stage_host_inputs([
+             (toks, np.int32, slot), (drafts, np.int32, blk),
+             (widths, np.int32, slot), (active, bool, slot),
+             (page_table, np.int32, blk), (lengths, np.int32, slot),
+             (emitted, np.int32, slot), (budgets, np.int32, slot),
+             (eos_ids, np.int32, slot)])
+        (keys, tok_base, temps, top_ks, top_ps, rep_pens, pres_pens,
+         freq_pens, counts, mask) = self._stage_policy_inputs(
+             shd, keys, tok_base, temps, top_ks, top_ps, rep_pens,
+             pres_pens, freq_pens, counts, mask)
+        args = (self.params, toks, drafts, widths, active, page_table,
+                lengths, pools, emitted, budgets, eos_ids, keys,
+                tok_base, temps, top_ks, top_ps, rep_pens, pres_pens,
+                freq_pens, counts, mask)
+        k = int(np.shape(drafts)[1])
+        if self._comm_capture is not None:
+            self._capture_comm_sig("verify_policy",
+                                   f"verify_policy[k={k}]",
+                                   "_paged_verify_policy_fn", args)
+        with self._serving_scope():
+            return self._dispatch(
+                "verify_policy", self._paged_verify_policy_fn, *args,
+                detail=None if self._compile_watchdog is None
+                else {"k": k, "policy": True})
+
+    def sample_from_logits_policy(self, logits, keys, tok_idx, temps,
+                                  top_ks, top_ps, rep_pens, pres_pens,
+                                  freq_pens, counts, mask):
+        """Boundary sampling under the decoding policy: the prefill-
+        finish counterpart of ``sample_from_logits``.  ``logits`` is a
+        list of [vocab] rows (or an [n, vocab] batch); every other
+        argument is per-row.  Unlike the legacy sampled path (one rng
+        split per CALL), each row draws from ``fold_in(keys[r],
+        tok_idx[r])`` — the same position-keyed stream the fused decode
+        uses, so the boundary token is reproducible across batching,
+        preemption-recompute and failover.  One compiled signature per
+        row count (bounded by num_slots)."""
+        if isinstance(logits, (list, tuple)):
+            rows = jnp.stack([jnp.asarray(r) for r in logits])
+        else:
+            rows = jnp.asarray(logits)
+        single = rows.ndim == 1
+        if single:
+            rows = rows[None]
+        if getattr(self, "_policy_rows_fn", None) is None:
+            def rows_fn(rows, keys, tok_idx, temps, top_ks, top_ps,
+                        rep_pens, pres_pens, freq_pens, counts, mask):
+                x = policy_pipeline.process_logits(
+                    rows, counts, mask, temps, top_ks, top_ps, rep_pens,
+                    pres_pens, freq_pens)
+                return policy_pipeline.sample_processed(
+                    x, keys, tok_idx, temps).astype(jnp.int32)
+            self._policy_rows_fn = jax.jit(rows_fn)
+        n = rows.shape[0]
+        with dist.mesh_scope(self.mesh):
+            toks = self._dispatch(
+                "sample_policy", self._policy_rows_fn, rows,
+                jnp.asarray(np.asarray(keys, np.uint32).reshape(n, 2)),
+                jnp.asarray(np.asarray(tok_idx, np.int32)),
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(np.asarray(top_ks, np.int32)),
+                jnp.asarray(np.asarray(top_ps, np.float32)),
+                jnp.asarray(np.asarray(rep_pens, np.float32)),
+                jnp.asarray(np.asarray(pres_pens, np.float32)),
+                jnp.asarray(np.asarray(freq_pens, np.float32)),
+                jnp.asarray(np.asarray(counts, np.int32)),
+                jnp.asarray(np.asarray(mask, bool)))
+        out = [int(t) for t in np.asarray(jax.device_get(toks))]
+        return out[0] if single else out
+
     def serving_verify_compile_count(self):
-        """Compiled signatures behind verify_multi — bounded by the
-        scheduler's spec-K bucket set (one per draft width K), never by
-        request churn or acceptance outcomes."""
-        return jit_cache_size(getattr(self, "_paged_verify_fn", None))
+        """Compiled signatures behind verify_multi (legacy greedy +
+        policy twin summed) — bounded by the scheduler's spec-K bucket
+        set per path, never by request churn, acceptance outcomes or
+        sampling-param churn."""
+        return (jit_cache_size(getattr(self, "_paged_verify_fn", None)) +
+                jit_cache_size(getattr(self, "_paged_verify_policy_fn",
+                                       None)))
 
     def sample_from_logits(self, logits, do_sample=False, temperature=1.0,
                            top_k=0, top_p=1.0):
@@ -1306,11 +1605,16 @@ class InferenceEngine:
         return jit_cache_size(getattr(self, "_paged_decode_fn", None))
 
     def serving_decode_multi_compile_count(self):
-        """Compiled signatures behind decode_multi — bounded by the
-        scheduler's horizon bucket set (one per distinct horizon, per
-        sampling combo), never by request churn."""
-        return jit_cache_size(getattr(self, "_paged_decode_multi_fn",
-                                      None))
+        """Compiled signatures behind decode_multi (legacy greedy +
+        policy twin summed) — bounded by the scheduler's horizon bucket
+        set (one per distinct horizon per path), never by request churn
+        or per-request sampling-param churn: policy knobs are traced
+        arrays, so a greedy/sampled/penalized mix re-uses the bucket's
+        one executable."""
+        return (jit_cache_size(getattr(self, "_paged_decode_multi_fn",
+                                       None)) +
+                jit_cache_size(getattr(self, "_paged_decode_policy_fn",
+                                       None)))
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,

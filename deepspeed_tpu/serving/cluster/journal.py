@@ -40,10 +40,12 @@ class JournalEntry:
                  "deadline_abs", "on_token", "emitted", "state", "error",
                  "attempts", "replays", "replica", "replica_history",
                  "handle", "next_try", "t_submit", "t_first", "t_last",
-                 "cancel_requested", "trace_flow")
+                 "cancel_requested", "trace_flow",
+                 "sampling", "seed", "grammar")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
-                 on_token=None, deadline_s=None):
+                 on_token=None, deadline_s=None, sampling=None, seed=None,
+                 grammar=None):
         self.rid = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -63,6 +65,15 @@ class JournalEntry:
         self.next_try = 0.0        # monotonic gate for backoff retries
         self.t_first = None        # first delivered token (cluster TTFT)
         self.t_last = None
+        # Decoding-policy wire fields, carried verbatim so a failover
+        # resubmission reproduces the EXACT per-request token stream:
+        # the position-keyed PRNG plus `sample_offset = len(emitted)`
+        # makes the survivor draw the same uniforms the dead replica
+        # would have, and the grammar spec recompiles + replays the
+        # emitted suffix so the constraint cursor resumes in place.
+        self.sampling = dict(sampling) if sampling else None
+        self.seed = None if seed is None else int(seed)
+        self.grammar = dict(grammar) if grammar else None
         self.cancel_requested = False
         self.trace_flow = None     # open failover-replay flow-link id:
                                    # set when a death replays this entry,
@@ -99,6 +110,8 @@ class JournalEntry:
             "attempts": self.attempts, "replays": self.replays,
             "replica": self.replica,
             "replica_history": list(self.replica_history),
+            "sampling": self.sampling, "seed": self.seed,
+            "grammar": self.grammar,
         }
 
 
@@ -113,7 +126,8 @@ class RequestJournal:
         self._auto_rid = 0
 
     def admit(self, prompt, max_new_tokens, eos_token_id=None,
-              on_token=None, deadline_s=None, rid=None):
+              on_token=None, deadline_s=None, rid=None, sampling=None,
+              seed=None, grammar=None):
         """Returns ``(entry, created)``; a duplicate rid returns the
         incumbent with ``created=False`` (at-most-once admission)."""
         if rid is None:
@@ -122,7 +136,8 @@ class RequestJournal:
         if rid in self.entries:
             return self.entries[rid], False
         entry = JournalEntry(rid, prompt, max_new_tokens, eos_token_id,
-                             on_token, deadline_s)
+                             on_token, deadline_s, sampling=sampling,
+                             seed=seed, grammar=grammar)
         self.entries[rid] = entry
         return entry, True
 

@@ -100,24 +100,30 @@ class LocalReplica:
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
-               trace_ctx=None):
+               trace_ctx=None, sampling=None, seed=None, grammar=None,
+               sample_offset=0):
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
         return self.sched.submit(prompt, max_new_tokens,
                                  eos_token_id=eos_token_id,
                                  on_token=on_token, deadline_s=deadline_s,
-                                 handoff=handoff, trace_ctx=trace_ctx)
+                                 handoff=handoff, trace_ctx=trace_ctx,
+                                 sampling=sampling, seed=seed,
+                                 grammar=grammar,
+                                 sample_offset=sample_offset)
 
     def attach(self, prompt, pages, length, first_tok, *, max_new_tokens,
                eos_token_id=None, deadline_s=None, on_token=None,
-               trace_ctx=None):
+               trace_ctx=None, sampling=None, seed=None, grammar=None,
+               sample_offset=0):
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
         return self.sched.attach_handoff(
             prompt, pages, length, first_tok,
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
             on_token=on_token, deadline_s=deadline_s,
-            trace_ctx=trace_ctx)
+            trace_ctx=trace_ctx, sampling=sampling, seed=seed,
+            grammar=grammar, sample_offset=sample_offset)
 
     def set_handoff_sink(self, cb):
         """Router wiring for prefill workers: where finished-prompt KV
@@ -463,7 +469,8 @@ class ProcessReplica:
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
-               trace_ctx=None):
+               trace_ctx=None, sampling=None, seed=None, grammar=None,
+               sample_offset=0):
         if handoff:
             raise ValueError("process replicas serve unified only")
         if self.state != UP:
@@ -477,6 +484,16 @@ class ProcessReplica:
               "max_new_tokens": int(max_new_tokens),
               "eos_token_id": eos_token_id,
               "deadline_s": deadline_s}
+        # decoding-policy wire fields are omitted when default so old
+        # workers keep accepting the protocol
+        if sampling:
+            op["sampling"] = dict(sampling)
+        if seed:
+            op["seed"] = int(seed)
+        if grammar:
+            op["grammar"] = dict(grammar)
+        if sample_offset:
+            op["sample_offset"] = int(sample_offset)
         if trace_ctx is not None:
             # the trace id crosses the process boundary with the
             # request, so worker-side spans carry the journal rid

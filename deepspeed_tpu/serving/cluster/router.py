@@ -148,14 +148,20 @@ class ClusterRouter:
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
-               on_token=None, deadline_s=None, rid=None):
+               on_token=None, deadline_s=None, rid=None, sampling=None,
+               seed=None, grammar=None):
         """Journal a request (idempotent on ``rid``) for routing at the
         next pump.  Returns the journal entry — its ``state`` /
         ``emitted`` are the client-visible truth across any number of
-        replica deaths."""
+        replica deaths.  ``sampling``/``seed``/``grammar`` are wire
+        dicts journaled verbatim: a failover resubmission replays the
+        identical decoding policy (position-keyed PRNG + grammar-cursor
+        replay make the continuation stream-exact, not just
+        distribution-exact)."""
         entry, created = self.journal.admit(
             prompt, max_new_tokens, eos_token_id=eos_token_id,
-            on_token=on_token, deadline_s=deadline_s, rid=rid)
+            on_token=on_token, deadline_s=deadline_s, rid=rid,
+            sampling=sampling, seed=seed, grammar=grammar)
         if created:
             self.metrics.submitted += 1
         else:
@@ -369,7 +375,14 @@ class ClusterRouter:
                     on_token=self._make_token_sink(entry),
                     handoff=handoff,
                     trace_ctx=None if self.tracer is None else
-                    {"trace_id": entry.rid, "attempt": entry.replays})
+                    {"trace_id": entry.rid, "attempt": entry.replays},
+                    # the folded prompt carries len(emitted) already-
+                    # served positions: sample_offset re-anchors the
+                    # position-keyed PRNG and tells the scheduler which
+                    # prompt suffix to replay through the grammar cursor
+                    sampling=entry.sampling, seed=entry.seed,
+                    grammar=entry.grammar,
+                    sample_offset=len(entry.emitted))
             except ReplicaKilled:
                 continue    # heartbeat pass will handle the body
             except ValueError as e:
@@ -471,7 +484,15 @@ class ClusterRouter:
                     else max(0.001, entry.deadline_abs - now),
                     on_token=self._make_token_sink(entry),
                     trace_ctx=None if self.tracer is None else
-                    {"trace_id": entry.rid, "attempt": entry.replays})
+                    {"trace_id": entry.rid, "attempt": entry.replays},
+                    # the boundary token (already journal-emitted) rides
+                    # in out_tokens on the decode side, so the offset
+                    # excludes it: next position = offset + len(out) =
+                    # len(emitted) — the stream stays position-exact
+                    # across the handoff
+                    sampling=entry.sampling, seed=entry.seed,
+                    grammar=entry.grammar,
+                    sample_offset=max(0, len(entry.emitted) - 1))
             except Exception:
                 pkt.pool.free(pkt.pages)
                 self._requeue_unified(entry, "attach failed")

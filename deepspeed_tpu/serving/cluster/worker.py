@@ -4,6 +4,8 @@ process, driven over a JSONL stdin/stdout protocol.
 stdin ops (one JSON object per line):
   {"op": "submit", "rid": ..., "prompt": [...], "max_new_tokens": N,
    "eos_token_id": E?, "deadline_s": D?,
+   "sampling": {...}?, "seed": S?, "grammar": {...}?,
+   "sample_offset": O?,           # decoding policy; omitted = greedy
    "trace": {"trace_id": ...}?}   # cluster trace ctx rides the wire
   {"op": "cancel", "rid": ...}
   {"op": "drain"}            # stop admitting, finish in-flight
@@ -195,7 +197,11 @@ def main(argv=None):
                         op["prompt"], op.get("max_new_tokens", 32),
                         eos_token_id=op.get("eos_token_id"),
                         deadline_s=op.get("deadline_s"),
-                        on_token=on_token, trace_ctx=op.get("trace"))
+                        on_token=on_token, trace_ctx=op.get("trace"),
+                        sampling=op.get("sampling"),
+                        seed=op.get("seed"),
+                        grammar=op.get("grammar"),
+                        sample_offset=op.get("sample_offset", 0))
                 except Exception as e:
                     _emit({"ev": "done", "rid": op["rid"],
                            "status": "shed", "tokens": [],

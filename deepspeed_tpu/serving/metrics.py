@@ -57,6 +57,11 @@ class ServingMetrics:
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
         self.handoffs = 0              # prefill->decode KV chains handed
         self.handoff_tokens = 0        # prefilled positions transferred
+        # decoding-policy subsystem (serving/sampling/)
+        self.sampled_requests = 0      # intakes with a sampled policy
+        self.grammar_requests = 0      # intakes carrying a grammar
+        self.policy_dispatches = 0     # fused dispatches on the policy twins
+        self.grammar_violations = 0    # grammar cursor rejected a token
         # memory telemetry (MemTelemetry drives these; all 0 when off)
         self.mem_pressure_events = 0   # capacity causal chains recorded
         self.mem_pressure_episodes = 0  # sustained episodes fired
@@ -300,6 +305,37 @@ class ServingMetrics:
         recompiles."""
         self._write([("serving/comm/recompile", cumulative, step)])
 
+    def record_policy_request(self, step, *, sampled, grammar):
+        """One intake (submit/attach) carried a non-default decoding
+        policy: it samples/penalizes (``sampled``) and/or is grammar-
+        constrained (``grammar``)."""
+        events = []
+        if sampled:
+            self.sampled_requests += 1
+            events.append(("serving/sampling/sampled_requests",
+                           self.sampled_requests, step))
+        if grammar:
+            self.grammar_requests += 1
+            events.append(("serving/sampling/grammar_requests",
+                           self.grammar_requests, step))
+        if events:
+            self._write(events)
+
+    def record_policy_dispatch(self, step, slots):
+        """One fused dispatch took the policy twins (decode_multi_policy
+        / verify_multi_policy) — per-slot traced sampling lanes instead
+        of the legacy greedy statics — over ``slots`` running slots."""
+        self.policy_dispatches += 1
+        self._write([("serving/sampling/policy_dispatch", slots, step)])
+
+    def record_grammar_violation(self, step, rid=None):
+        """The host grammar cursor rejected a token the device emitted —
+        the device mask makes this unreachable in a healthy loop, so a
+        violation means corrupted constraint state; the request fails
+        contained."""
+        self.grammar_violations += 1
+        self._write([("serving/sampling/grammar_violation", 1, step)])
+
     def record_handoff(self, step, tokens):
         """One prefill->decode KV handoff: ``tokens`` prefilled
         positions changed owners without a byte of KV copied."""
@@ -383,6 +419,10 @@ class ServingMetrics:
             "spec_degraded": self.spec_degraded,
             "handoffs": self.handoffs,
             "handoff_tokens": self.handoff_tokens,
+            "sampled_requests": self.sampled_requests,
+            "grammar_requests": self.grammar_requests,
+            "policy_dispatches": self.policy_dispatches,
+            "grammar_violations": self.grammar_violations,
             "tune_nudges": self.tune_nudges,
         }
         if wall_s:

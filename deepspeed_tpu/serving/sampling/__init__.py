@@ -1,0 +1,31 @@
+"""Decoding-policy subsystem: per-slot on-device logit pipeline,
+lossless speculative sampling, grammar-constrained generation.
+
+Three pillars (see each module's docstring for the contracts):
+
+* :mod:`params`   — per-request :class:`SamplingParams` + the staged
+  per-slot no-op encodings that keep mixed batches on ONE compiled
+  signature per horizon/K bucket.
+* :mod:`pipeline` — the traced processor chain (grammar mask ->
+  penalties -> temperature -> top-k -> top-p -> sample) and the
+  leftover-probability rejection-sampling kernel for lossless spec
+  verification.
+* :mod:`grammar`  — host-compiled regex / JSON-schema -> char DFA ->
+  per-state token bitmask, with replayable per-request cursors.
+"""
+
+from .grammar import (CharDFA, GrammarConstraint, GrammarConstraintError,
+                      RegexError, TokenDFA, byte_vocab, compile_grammar,
+                      json_schema_to_regex, json_value_regex)
+from .params import GREEDY, SamplingParams, request_key
+from .pipeline import (accept_or_resample, bonus_sample, fold_keys,
+                       process_logits, sample_processed)
+
+__all__ = [
+    "SamplingParams", "GREEDY", "request_key",
+    "process_logits", "sample_processed", "accept_or_resample",
+    "bonus_sample", "fold_keys",
+    "CharDFA", "TokenDFA", "GrammarConstraint", "GrammarConstraintError",
+    "RegexError", "byte_vocab", "compile_grammar", "json_schema_to_regex",
+    "json_value_regex",
+]

@@ -1,0 +1,178 @@
+"""On-device per-slot logit pipeline.
+
+Every function here is pure JAX and traces into the fused serving
+primitives (`decode_multi_policy` / `verify_multi_policy` scans and the
+prefill-boundary sampler).  The design constraint that shapes all of
+it: **policy parameters are per-slot device ARRAYS, never jit
+statics** — a mixed greedy / sampled / penalized / grammar-constrained
+batch shares one compiled executable per horizon/K bucket, and param
+churn (a new temperature, a different top-p) never recompiles.
+
+Processor chain order (documented contract, pinned by unit tests):
+
+    fp32 cast -> grammar mask -> repetition/presence/frequency penalties
+              -> temperature -> top-k -> top-p
+              -> sample (argmax where temp == 0, else categorical)
+
+The grammar mask applies FIRST so the truncation gates (top-k / top-p)
+select within the ALLOWED lanes: the nucleus of the constrained
+distribution.  Masking last instead would let top-p truncate away every
+grammar-allowed token when none of them sits in the unconstrained
+nucleus — an all--inf row whose categorical draw is garbage (a real
+failure mode: one allowed continuation with low unconstrained
+probability).  Since sort order puts -inf lanes past every finite lane
+and the cutoff always keeps the top lane, a masked row can never lose
+its last allowed token to truncation.
+
+No-op encodings guarantee bitwise identity for untouched rows:
+``temp=0`` (greedy), ``top_k=0``, ``top_p=1.0``, ``rep=1.0``,
+``pres=0.0``, ``freq=0.0``, ``mask=all-True``.  Each gate is a
+``jnp.where`` on the *original* lane, so a greedy row's logits pass
+through the whole chain bit-exact and its argmax ties to the LOWEST
+token id — the same greedy contract the legacy path pins.
+
+Top-p uses the exact `_sample_tokens` semantics: sort descending,
+softmax, cumsum, ``cutoff_idx = sum(cum < top_p)`` (smallest set whose
+cumulative mass REACHES top_p; the boundary token that crosses the
+threshold is kept), then drop everything strictly below the cutoff
+logit — so probability ties at the cutoff are all kept.
+
+PRNG: each slot carries a raw uint32[2] threefry key (the request's
+``PRNGKey(seed)``) plus an absolute token index; token ``n`` draws from
+``fold_in(key, n)``.  Position-keyed folding makes the stream
+batching-independent and replayable: the same request sharded to a
+different slot, chained, preempted, or failed over to another replica
+draws the same randomness for the same token position.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_keys(keys, idx):
+    """Per-slot ``fold_in``: keys [slots, 2] uint32 (raw threefry key
+    data, exactly ``PRNGKey(seed)``'s buffer), idx scalar or [slots]
+    int32 -> folded keys [slots, 2]."""
+    idx = jnp.broadcast_to(idx, (keys.shape[0],)).astype(jnp.uint32)
+    return jax.vmap(jax.random.fold_in)(keys, idx)
+
+
+def process_logits(logits, counts, mask, temps, top_ks, top_ps,
+                   rep_pens, pres_pens, freq_pens):
+    """Apply the full per-slot processor chain; returns fp32 logits
+    ready for argmax/categorical.
+
+    logits  [slots, vocab]  any float dtype (cast fp32 here)
+    counts  [slots, vocab]  int32   prompt+output token counts
+    mask    [slots, vocab]  bool    grammar allowed-token mask
+    temps/top_ps/rep_pens/pres_pens/freq_pens [slots] f32
+    top_ks  [slots] i32
+    """
+    x = logits.astype(jnp.float32)
+    vocab = x.shape[-1]
+
+    # --- grammar mask FIRST (see module docstring): top-k/top-p below
+    # truncate within the allowed lanes, so a constrained row always
+    # keeps at least its best allowed token
+    x = jnp.where(mask, x, -jnp.inf)
+
+    seen = counts > 0
+
+    # --- repetition penalty (CTRL rule: divide positive logits,
+    # multiply negative) on tokens present in prompt+output
+    rp = rep_pens[:, None]
+    penalized = jnp.where(x > 0, x / rp, x * rp)
+    x = jnp.where(seen & (rp != 1.0), penalized, x)
+
+    # --- presence / frequency penalties (OpenAI semantics)
+    x = x - pres_pens[:, None] * seen.astype(jnp.float32)
+    x = x - freq_pens[:, None] * counts.astype(jnp.float32)
+
+    # --- temperature (temp == 0 encodes greedy: lane untouched, the
+    # sampler argmaxes it)
+    t = temps[:, None]
+    x = jnp.where(t > 0, x / jnp.where(t > 0, t, 1.0), x)
+
+    # --- top-k (per-slot traced k; k <= 0 is the no-op)
+    sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    k = jnp.clip(top_ks, 0, vocab)
+    kth = jnp.take_along_axis(sorted_desc,
+                              jnp.maximum(k - 1, 0)[:, None], axis=-1)
+    x = jnp.where((k > 0)[:, None] & (x < kth), -jnp.inf, x)
+
+    # --- top-p over the post-top-k distribution (`cum < top_p`
+    # smallest-set cutoff — identical to _sample_tokens)
+    sorted_p = jnp.sort(x, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(
+        sorted_p, jnp.minimum(cutoff_idx, vocab - 1)[:, None], axis=-1)
+    x = jnp.where((top_ps < 1.0)[:, None] & (x < cutoff), -jnp.inf, x)
+    return x
+
+
+def sample_processed(x, keys, tok_idx, temps):
+    """Draw one token per slot from processed fp32 logits.
+
+    Greedy rows (``temps <= 0``) take ``argmax`` (ties to lowest id);
+    sampled rows draw ``categorical(fold_in(key, tok_idx))`` with a
+    per-slot key — one stream per request, position-keyed.
+    """
+    folded = fold_keys(keys, tok_idx)
+    sampled = jax.vmap(jax.random.categorical)(folded, x)
+    greedy = jnp.argmax(x, axis=-1).astype(sampled.dtype)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def accept_or_resample(x, draft, keys, tok_idx, temps):
+    """One column of lossless speculative verification.
+
+    For a draft token ``d`` proposed by a *point-mass* drafter (our
+    drafters propose tokens, not distributions: ``p_draft(d) = 1``),
+    leftover-probability rejection sampling reduces to:
+
+        accept d with prob  min(1, p_target(d) / 1) = p_target(d)
+        on rejection, resample from the residual
+        (p_target with d zeroed, renormalized)
+
+    which reproduces ``p_target`` exactly for ANY proposal token — the
+    distribution-exactness the frequency oracle pins.  Greedy rows keep
+    the legacy token-exact rule: accept iff ``argmax == d``, resample
+    is the argmax itself (which on a greedy rejection IS the residual
+    argmax, since the argmax differs from d).
+
+    Two independent draws per column come from sub-folds of the
+    position key: ``fold_in(fold_in(key, n), 0)`` for the accept
+    uniform, ``(..., 1)`` for the resample categorical.
+
+    Returns ``(accept [slots] bool, fallback [slots] int32)`` where
+    fallback is the resampled token to emit if this column rejects.
+    """
+    kcol = fold_keys(keys, tok_idx)
+    ku = fold_keys(kcol, 0)
+    kr = fold_keys(kcol, 1)
+    probs = jax.nn.softmax(x, axis=-1)
+    p_draft_tok = jnp.take_along_axis(probs, draft[:, None], axis=-1)[:, 0]
+    u = jax.vmap(jax.random.uniform)(ku)
+    greedy_tok = jnp.argmax(x, axis=-1)
+    greedy_row = temps <= 0.0
+
+    accept = jnp.where(greedy_row, greedy_tok == draft, u < p_draft_tok)
+
+    # residual: zero the draft token and renormalize (categorical over
+    # logits with the draft lane at -inf does both)
+    x_res = jnp.where(
+        jax.nn.one_hot(draft, x.shape[-1], dtype=jnp.bool_), -jnp.inf, x)
+    resampled = jax.vmap(jax.random.categorical)(kr, x_res)
+    fallback = jnp.where(greedy_row, greedy_tok,
+                         resampled).astype(jnp.int32)
+    return accept, fallback
+
+
+def bonus_sample(x, keys, tok_idx, temps):
+    """The bonus column: all drafts accepted — draw the next token from
+    the full target distribution (argmax for greedy rows).  Uses the
+    ``fold_in(key, n)`` position stream directly, matching what
+    ``decode_multi_policy`` would have drawn for this position."""
+    return sample_processed(x, keys, tok_idx, temps).astype(jnp.int32)

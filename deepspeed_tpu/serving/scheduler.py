@@ -60,10 +60,29 @@ shrunk on low acceptance and capped under page-pool pressure through
 the same pre-reservation path as horizons), one teacher-forced
 ``verify_multi`` dispatch scores them all, the longest greedy-matching
 prefix plus the target's bonus token is emitted, and KV written past
-the rejection point rolls back (``truncate_slot``).  Verification
-compares against the exact ``temperature=0`` argmax contract, so
-output is token-exact vs ``generate()`` and vs ``spec_decode=off``
-regardless of drafter quality.  Spec rounds need host-authoritative
+the rejection point rolls back (``truncate_slot``).  Greedy
+verification compares against the exact ``temperature=0`` argmax
+contract, so output is token-exact vs ``generate()`` and vs
+``spec_decode=off`` regardless of drafter quality.  Sampled slots
+verify by *lossless* leftover-probability rejection sampling
+(``verify_multi_policy``): each draft token is accepted with the
+target's probability for it and a rejection resamples the residual, so
+the emitted stream is distribution-exact — identical in law to
+unspeculated sampling — for ANY drafter that opts in
+(``supports_sampling``).
+
+**Decoding policy.**  Every request carries a
+:class:`~deepspeed_tpu.serving.sampling.SamplingParams` (temperature /
+top-k / top-p / repetition / presence / frequency penalties), a PRNG
+seed keying a position-indexed sample stream, and optionally a
+grammar constraint (regex / JSON-schema) compiled host-side to a
+per-step allowed-token mask.  Policy knobs are traced per-slot device
+lanes — a mixed greedy/sampled/penalized batch shares ONE compiled
+signature per horizon/K bucket — while a pure-greedy batch under a
+greedy default keeps riding the legacy signatures byte-identically.
+Constrained slots run horizon-1 barrier steps (their mask is a host
+function of emitted tokens) and never draft, but may ride verify
+rounds as width-0 one-token decodes.  Spec rounds need host-authoritative
 token history to draft from, so every step runs as a barrier step
 while a drafter is configured (no horizon chaining — a chained round
 never consults the drafter, and chaining plain rounds would starve it
@@ -108,6 +127,7 @@ All latency accounting uses ``time.monotonic()``: an NTP clock step
 must never produce negative or wild TTFT/ITL samples.
 """
 
+import json
 import re
 import time
 from collections import deque
@@ -122,6 +142,9 @@ from deepspeed_tpu.serving.page_manager import (PagedKVManager,
                                                 PagePoolExhausted,
                                                 default_page_size)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
+from deepspeed_tpu.serving.sampling import (GREEDY, GrammarConstraintError,
+                                            SamplingParams, compile_grammar,
+                                            request_key)
 from deepspeed_tpu.serving.trace import NULL_TRACER
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
@@ -185,6 +208,17 @@ class Request:
         self.page_seconds = 0.0
         self.error = None            # reason string for failed/shed
         self.handoff = False         # prefill-worker mode (see submit)
+        # decoding policy (serving/sampling/): per-request params, PRNG
+        # seed, grammar cursor, and the position base for the
+        # position-keyed sample stream.  Token n of the request draws
+        # from fold_in(PRNGKey(seed), sample_offset + n) — sample_offset
+        # counts tokens emitted in a PREVIOUS life of this request
+        # (replica failover folds them into the prompt), so replay
+        # continues the exact stream instead of restarting it.
+        self.sampling = GREEDY
+        self.seed = 0
+        self.sample_offset = 0
+        self.grammar = None          # GrammarConstraint cursor or None
         self.cancelled = False
         self.t_submit = time.monotonic()
         self.deadline = None if deadline_s is None \
@@ -397,6 +431,32 @@ class ServingScheduler:
         self._last_error = None
         self.sampling = dict(do_sample=do_sample, temperature=temperature,
                              top_k=top_k, top_p=top_p)
+        # Decoding-policy subsystem (serving/sampling/): `self.sampling`
+        # stays the LEGACY greedy path's static kwargs; every request
+        # additionally carries a per-request SamplingParams (defaulting
+        # to the scheduler-level knobs above).  A dispatch whose batch
+        # is pure greedy — and whose scheduler default is greedy — rides
+        # the legacy signatures byte-identically; anything else routes
+        # through the policy twins (decode_multi_policy /
+        # verify_multi_policy), where every knob is a traced per-slot
+        # lane: ONE compiled signature per horizon/K bucket regardless
+        # of the greedy/sampled/penalized/constrained mix.
+        self.default_sampling = SamplingParams(
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+        self._default_greedy = self.default_sampling.is_greedy
+        # per-slot policy mirrors, staged into device lanes at dispatch
+        # (no-op encodings for greedy slots — see sampling/params.py)
+        self._samp_temps = np.zeros(num_slots, np.float32)
+        self._samp_topk = np.zeros(num_slots, np.int32)
+        self._samp_topp = np.ones(num_slots, np.float32)
+        self._samp_rep = np.ones(num_slots, np.float32)
+        self._samp_pres = np.zeros(num_slots, np.float32)
+        self._samp_freq = np.zeros(num_slots, np.float32)
+        self._samp_keys = np.zeros((num_slots, 2), np.uint32)
+        self._tok_counts = None      # lazy [num_slots, vocab] int32
+        self._grammar_masks = None   # lazy [num_slots, vocab] bool
+        self._grammar_cache = {}     # spec json -> prototype cursor
         # fused decode horizons: power-of-two buckets up to the max so
         # varying horizon choices share a bounded set of compiled
         # signatures (decode_horizon_steps=1 recovers the legacy
@@ -450,9 +510,18 @@ class ServingScheduler:
             raise ValueError(
                 "spec_decode='draft' needs a spec_drafter="
                 "DraftModelDrafter(...) carrying the draft engine")
-        if self._spec is not None and not greedy:
+        # Capability gate (replacing the old greedy-only gate): lossless
+        # leftover-probability verification makes speculation
+        # distribution-exact under ANY sampling policy, so sampled+spec
+        # composes whenever the drafter opts in (`supports_sampling` —
+        # True for the stock point-mass drafters).  A drafter without
+        # the capability only loses SAMPLED slots' proposals; with a
+        # sampled scheduler-wide default that is every slot, so spec is
+        # disabled up front with a distinct reason.
+        if self._spec is not None and not greedy and \
+                not getattr(self._spec, "supports_sampling", False):
             self._spec = None
-            self.spec_mode = "off (sampled mode)"
+            self.spec_mode = "off (drafter lacks supports_sampling)"
         # online autotuner (autotuning/serving/online.py): bounded
         # nudges of the safely-re-resolvable knobs (decode horizon,
         # spec-K ceiling, prefix-cache retention split) from the live
@@ -484,7 +553,8 @@ class ServingScheduler:
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                on_token=None, deadline_s=None, handoff=False,
-               trace_ctx=None):
+               trace_ctx=None, sampling=None, seed=None, grammar=None,
+               sample_offset=0):
         """Queue a request; raises :class:`QueueFull` at max_queue (the
         backpressure signal callers turn into 429/retry). ``deadline_s``
         is a relative budget: a request that cannot finish inside it is
@@ -493,7 +563,19 @@ class ServingScheduler:
         hands its KV page chain to ``on_handoff`` (disaggregated
         serving).  ``trace_ctx`` (``{"trace_id": ..., "attempt": n}``)
         propagates a cluster-level trace id so this scheduler's spans
-        for the request share the journal rid across replicas."""
+        for the request share the journal rid across replicas.
+
+        Decoding policy (per request): ``sampling`` is a
+        :class:`~deepspeed_tpu.serving.sampling.SamplingParams` or wire
+        dict overriding the scheduler-level default; ``seed`` keys the
+        request's position-keyed PRNG stream (default 0 — deterministic
+        and replayable); ``grammar`` is a constraint spec
+        (``{"regex": ...}`` / ``{"json_schema": ...}`` /
+        ``{"response_format": "json_object"}``) compiled host-side to a
+        per-step allowed-token mask; ``sample_offset`` counts tokens a
+        previous life of this request already emitted (failover replay
+        folds them into the prompt), so the PRNG stream and grammar
+        cursor CONTINUE instead of restarting."""
         if self.draining:
             raise QueueFull("scheduler is draining (shutdown/restart in "
                             "progress); resubmit elsewhere")
@@ -512,6 +594,7 @@ class ServingScheduler:
         req.handoff = bool(handoff)
         if trace_ctx is not None and trace_ctx.get("trace_id") is not None:
             req.trace_rid = trace_ctx["trace_id"]
+        self._apply_policy(req, sampling, seed, grammar, sample_offset)
         if req.max_new_tokens <= 0:
             # parity with generate(max_new_tokens=0): nothing to emit —
             # but it still counts as completed, so health()/summary
@@ -523,6 +606,142 @@ class ServingScheduler:
         self.requests[req.rid] = req
         self.waiting.append(req)
         return req
+
+    # ------------------------------------------------- decoding policy
+    def _apply_policy(self, req, sampling, seed, grammar, sample_offset):
+        """Attach the per-request decoding policy at intake (submit /
+        attach_handoff).  Grammar compilation is host work and can
+        raise — intake is the right place to reject a bad spec, before
+        any pages are held.  A replayed request (``sample_offset > 0``,
+        or handoff tokens already in ``out_tokens``) advances the fresh
+        grammar cursor through everything previously emitted, so the
+        constraint state survives preemption and failover exactly."""
+        req.sampling = SamplingParams.from_dict(
+            sampling, defaults=self.default_sampling)
+        req.seed = 0 if seed is None else int(seed)
+        req.sample_offset = max(0, int(sample_offset))
+        if grammar is not None:
+            req.grammar = self._compile_grammar(grammar, req.eos_token_id)
+            if req.sample_offset:
+                req.grammar.replay(req.prompt[-req.sample_offset:])
+            if req.out_tokens:
+                req.grammar.replay(req.out_tokens)
+        if req.sampling.needs_policy or req.grammar is not None:
+            self.metrics.record_policy_request(
+                self.step_idx, sampled=not req.sampling.is_greedy,
+                grammar=req.grammar is not None)
+
+    def _compile_grammar(self, spec, eos_token_id):
+        """Spec dict -> fresh :class:`GrammarConstraint` cursor.  The
+        DFA + token-mask compilation is cached per (spec, eos) — many
+        requests sharing one schema share one TokenDFA (and its lazily
+        built per-state mask rows); each request gets its own cursor."""
+        if hasattr(spec, "token_mask"):     # pre-built cursor
+            return spec
+        key = (json.dumps(spec, sort_keys=True),
+               None if eos_token_id is None else int(eos_token_id))
+        proto = self._grammar_cache.get(key)
+        if proto is None:
+            proto = compile_grammar(spec, self._vocab_size(),
+                                    eos_token_id=eos_token_id)
+            self._grammar_cache[key] = proto
+        return proto.fresh()
+
+    def _vocab_size(self):
+        v = self.mesh_info.get("vocab_size")
+        if v is None:
+            cfg = getattr(getattr(self.engine, "module", None), "cfg",
+                          None)
+            v = getattr(cfg, "vocab_size", None)
+        if v is None:
+            raise RuntimeError(
+                "engine does not expose vocab_size; the decoding-policy "
+                "tables (token counts / grammar masks) need it")
+        return int(v)
+
+    @staticmethod
+    def _req_needs_policy(req):
+        return req.sampling.needs_policy or req.grammar is not None
+
+    def _batch_needs_policy(self, slots):
+        """True when this dispatch must take the policy twins: any
+        request samples/penalizes/constrains, or the scheduler-wide
+        default is sampled (explicit-greedy requests under a sampled
+        default still ride the policy path — its greedy lanes are
+        argmax-exact — so the legacy kwargs are never repurposed)."""
+        return (not self._default_greedy) or any(
+            self._req_needs_policy(self.slot_req[s]) for s in slots)
+
+    def _ensure_policy_tables(self):
+        if self._tok_counts is None:
+            v = self._vocab_size()
+            self._tok_counts = np.zeros((self.num_slots, v), np.int32)
+            self._grammar_masks = np.ones((self.num_slots, v), bool)
+
+    def _seed_slot_policy(self, slot, req):
+        """Stage one admitted request's policy into the slot mirrors.
+        Counts seed from the request's TRUE token history
+        (``orig_prompt + out_tokens`` — after a preemption the folded
+        prompt already contains the emitted tokens, after a handoff the
+        boundary token lives only in ``out_tokens``; the union covers
+        both without double counting)."""
+        if not (self._req_needs_policy(req) or
+                self._tok_counts is not None):
+            return
+        self._ensure_policy_tables()
+        sp = req.sampling
+        self._samp_temps[slot] = sp.staged_temperature
+        self._samp_topk[slot] = 0 if sp.is_greedy else sp.top_k
+        self._samp_topp[slot] = 1.0 if sp.is_greedy else sp.top_p
+        self._samp_rep[slot] = sp.repetition_penalty
+        self._samp_pres[slot] = sp.presence_penalty
+        self._samp_freq[slot] = sp.frequency_penalty
+        self._samp_keys[slot] = request_key(req.seed)
+        v = self._tok_counts.shape[1]
+        hist = np.asarray(req.orig_prompt + req.out_tokens, np.int64)
+        hist = hist[(hist >= 0) & (hist < v)]
+        self._tok_counts[slot] = np.bincount(hist, minlength=v)[:v]
+        self._grammar_masks[slot] = True if req.grammar is None \
+            else req.grammar.token_mask()
+
+    def _policy_args(self, running):
+        """The staged per-slot policy arrays one dispatch consumes.
+        ``tok_base`` is each request's absolute position base —
+        ``sample_offset + len(out_tokens)`` — so the device's in-scan
+        fold index (``tok_base + emitted``) is position-keyed across
+        batching, chaining, preemption and failover."""
+        self._ensure_policy_tables()
+        base = np.zeros(self.num_slots, np.int32)
+        for s in running:
+            req = self.slot_req[s]
+            base[s] = req.sample_offset + len(req.out_tokens)
+        return dict(keys=self._samp_keys, tok_base=base,
+                    temps=self._samp_temps, top_ks=self._samp_topk,
+                    top_ps=self._samp_topp, rep_pens=self._samp_rep,
+                    pres_pens=self._samp_pres, freq_pens=self._samp_freq,
+                    counts=self._tok_counts, mask=self._grammar_masks)
+
+    def _note_emitted(self, slot, req, tok):
+        """Host policy bookkeeping for ONE delivered token: the count
+        mirror and the grammar cursor.  A grammar rejection raises
+        GrammarConstraintError into the caller's per-request
+        containment (it is attributable to exactly this request)."""
+        if self._tok_counts is not None and \
+                0 <= tok < self._tok_counts.shape[1]:
+            self._tok_counts[slot, tok] += 1
+        if req.grammar is not None and not req.grammar.finished:
+            try:
+                req.grammar.advance(tok)
+            except GrammarConstraintError:
+                self.metrics.record_grammar_violation(self.step_idx,
+                                                      req.rid)
+                raise
+
+    def _grammar_finished(self, req):
+        """A constrained request finishes when its cursor is done (eos
+        consumed, or the DFA has no continuation left) — even if the
+        model never emits eos."""
+        return req.grammar is not None and req.grammar.done
 
     # --------------------------------------------------------- accounting
     def _emit(self, req, tok):
@@ -958,6 +1177,7 @@ class ServingScheduler:
                                      args={"slot": slot})
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
+            self._seed_slot_policy(slot, req)
             self.lengths[slot] = 0
             req.cached_prefix_tokens = 0
             if hit is not None:
@@ -1055,27 +1275,51 @@ class ServingScheduler:
             except Exception as e:   # containment: fail one, not all
                 self._close_slot(slot, FAILED,
                                  f"{type(e).__name__}: {e}")
+        # a later slot's growth may have evicted an earlier finishing
+        # slot — drop stale entries BEFORE the batched sample (the
+        # policy-table gathers index by slot, so a vacated slot must
+        # not reach them)
+        finishing = [(s, r, lg) for s, r, lg in finishing
+                     if self.slot_req[s] is r and r.state == PREFILL]
         if not finishing:
             return
         # the batched sample is shared work (like the decode dispatch);
         # emit/callback stays contained per request below
-        toks = self.engine.sample_from_logits(
-            [lg for _, _, lg in finishing], **self.sampling)
+        rows = [lg for _, _, lg in finishing]
+        if self._batch_needs_policy([s for s, _, _ in finishing]):
+            # boundary token under the decoding policy: same pipeline,
+            # same position-keyed stream as the fused decode (token 0
+            # of the request draws from fold_in(key, sample_offset))
+            self._ensure_policy_tables()
+            sl = [s for s, _, _ in finishing]
+            idx = np.array([r.sample_offset + len(r.out_tokens)
+                            for _, r, _ in finishing], np.int32)
+            toks = self.engine.sample_from_logits_policy(
+                rows, self._samp_keys[sl], idx, self._samp_temps[sl],
+                self._samp_topk[sl], self._samp_topp[sl],
+                self._samp_rep[sl], self._samp_pres[sl],
+                self._samp_freq[sl], self._tok_counts[sl],
+                self._grammar_masks[sl])
+        else:
+            toks = self.engine.sample_from_logits(rows, **self.sampling)
         for (slot, req, _), tok in zip(finishing, toks):
             if self.slot_req[slot] is not req or req.state != PREFILL:
                 continue   # a later slot's growth evicted this one
             try:
                 self._emit(req, tok)
+                self._note_emitted(slot, req, tok)
             except Exception as e:
                 self._close_slot(slot, FAILED, f"{type(e).__name__}: {e}")
                 continue
-            if req._finished_by(tok):
+            if req._finished_by(tok) or self._grammar_finished(req):
                 self._retire(slot)
             elif req.handoff and self.on_handoff is not None:
                 self._do_handoff(slot, req, tok)
             else:
                 self.last_tok[slot] = tok
                 req.state = RUNNING
+                if req.grammar is not None:
+                    self._grammar_masks[slot] = req.grammar.token_mask()
 
     # ------------------------------------------------ disaggregated KV
     def _do_handoff(self, slot, req, tok):
@@ -1108,7 +1352,8 @@ class ServingScheduler:
 
     def attach_handoff(self, prompt, pages, length, first_tok, *,
                        max_new_tokens, eos_token_id=None, on_token=None,
-                       deadline_s=None, trace_ctx=None):
+                       deadline_s=None, trace_ctx=None, sampling=None,
+                       seed=None, grammar=None, sample_offset=0):
         """Decode-worker intake for a prefill worker's donated chain:
         the request joins with its prompt KV already written (``pages``
         cover ``length`` prefilled positions in the SHARED pool) and its
@@ -1131,6 +1376,12 @@ class ServingScheduler:
         req.out_tokens = [int(first_tok)]
         req.t_first = req.t_last = now
         req.prefill_pos = len(req.prompt)
+        # policy continuity across the handoff: the prefill worker drew
+        # the boundary token at position sample_offset + 0; out_tokens
+        # already holds it, so this side's next draw lands at +1 with
+        # the SAME offset, and _apply_policy replays the grammar cursor
+        # through it
+        self._apply_policy(req, sampling, seed, grammar, sample_offset)
         req._attach = (list(pages), int(length), int(first_tok))
         if req.remaining_new <= 0:
             self.kv.pool.free(req._attach[0])
@@ -1176,6 +1427,7 @@ class ServingScheduler:
             self.last_tok[slot] = tok
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
+            self._seed_slot_policy(slot, req)
             req.t_admit = now
             req.state = RUNNING
             if self.tracer.enabled:
@@ -1257,7 +1509,12 @@ class ServingScheduler:
         by the largest remaining token budget among running slots (scan
         steps past every budget are pure waste) and by the tightest live
         deadline (a horizon overshooting a deadline generates tokens the
-        sweep will throw away)."""
+        sweep will throw away).  A grammar-constrained slot pins the
+        batch to horizon 1: its allowed-token mask is a host-compiled
+        function of the tokens emitted so far, so the device may take
+        at most one constrained step per staged mask."""
+        if any(self.slot_req[s].grammar is not None for s in running):
+            return 1
         h = min(self.decode_horizon_steps,
                 max(self.slot_req[s].remaining_new for s in running))
         deadlines = [self.slot_req[s].deadline for s in running
@@ -1368,6 +1625,19 @@ class ServingScheduler:
         for slot in running:
             req = self.slot_req[slot]
             if getattr(req, "_spec_off", False):
+                continue
+            if req.grammar is not None:
+                # a draft column's validity depends on the mask AFTER
+                # the previous column — one staged mask per dispatch
+                # cannot cover K speculative steps.  The slot rides the
+                # verify round as a width-0 one-token decode (the bonus
+                # token is drawn under its fresh mask).
+                continue
+            if not req.sampling.is_greedy and \
+                    not getattr(self._spec, "supports_sampling", False):
+                # per-request capability gate: a drafter that has not
+                # opted into lossless sampled verification only loses
+                # THIS slot's proposals, never the round
                 continue
             # never draft past the request's budget (the verify bonus
             # token supplies the last one) or the slot's page table
@@ -1508,12 +1778,23 @@ class ServingScheduler:
             budgets[s] = self.slot_req[s].remaining_new
         self._chain_budgets = budgets
         t_disp = time.monotonic()
-        out = self.engine.verify_multi(
-            self.last_tok, draft_arr, active, self.kv.table, self.lengths,
-            self.pools, widths=widths, budgets=budgets,
-            eos_ids=self._eos_ids)
-        (toks, valid, tok_end, active_end, lengths_end, emitted_end,
-         accepted, pools) = out
+        if self._batch_needs_policy(running):
+            pol = self._policy_args(running)
+            out = self.engine.verify_multi_policy(
+                self.last_tok, draft_arr, active, self.kv.table,
+                self.lengths, self.pools, widths=widths, budgets=budgets,
+                eos_ids=self._eos_ids, **pol)
+            (toks, valid, tok_end, active_end, lengths_end, emitted_end,
+             accepted, _counts_end, pools) = out
+            self.metrics.record_policy_dispatch(self.step_idx,
+                                                len(running))
+        else:
+            out = self.engine.verify_multi(
+                self.last_tok, draft_arr, active, self.kv.table,
+                self.lengths, self.pools, widths=widths, budgets=budgets,
+                eos_ids=self._eos_ids)
+            (toks, valid, tok_end, active_end, lengths_end, emitted_end,
+             accepted, pools) = out
         self.pools = pools
         for arr in (toks, valid):
             if hasattr(arr, "copy_to_host_async"):
@@ -1564,12 +1845,23 @@ class ServingScheduler:
         # budgets baseline for any chained continuation: the device's
         # `emitted` carry counts from THIS dispatch
         self._chain_budgets = budgets
-        out = self.engine.decode_multi(
-            self.last_tok, active, self.kv.table, self.lengths, self.pools,
-            horizon=horizon, budgets=budgets, eos_ids=self._eos_ids,
-            **self.sampling)
+        if self._batch_needs_policy(running):
+            pol = self._policy_args(running)
+            out = self.engine.decode_multi_policy(
+                self.last_tok, active, self.kv.table, self.lengths,
+                self.pools, horizon=horizon, budgets=budgets,
+                eos_ids=self._eos_ids, **pol)
+            self.metrics.record_policy_dispatch(self.step_idx,
+                                                len(running))
+        else:
+            pol = None
+            out = self.engine.decode_multi(
+                self.last_tok, active, self.kv.table, self.lengths,
+                self.pools, horizon=horizon, budgets=budgets,
+                eos_ids=self._eos_ids, **self.sampling)
         self._commit_dispatch(out, running, horizon,
-                              {s: self.slot_req[s] for s in running})
+                              {s: self.slot_req[s] for s in running},
+                              policy=pol)
         if self.tracer.enabled:
             # host side of the dispatch: page reservation + argument
             # staging + launching the fused scan (the device's share of
@@ -1579,9 +1871,17 @@ class ServingScheduler:
                                  args={"horizon": horizon,
                                        "slots": len(running)})
 
-    def _commit_dispatch(self, out, running, horizon, reqs):
-        toks, valid, tok_end, active_end, lengths_end, emitted_end, pools \
-            = out
+    def _commit_dispatch(self, out, running, horizon, reqs, policy=None):
+        if policy is not None:
+            # the policy twin returns a counts carry before the pools:
+            # a chained continuation stages IT (device truth mid-chain)
+            # instead of the host mirror
+            (toks, valid, tok_end, active_end, lengths_end, emitted_end,
+             counts_end, pools) = out
+            policy = dict(policy, counts_end=counts_end)
+        else:
+            (toks, valid, tok_end, active_end, lengths_end, emitted_end,
+             pools) = out
         self.pools = pools
         for arr in (toks, valid):
             # overlap: the host copy starts NOW, so the harvest one
@@ -1598,7 +1898,7 @@ class ServingScheduler:
             "toks": toks, "valid": valid, "tok_end": tok_end,
             "active_end": active_end, "lengths_end": lengths_end,
             "emitted_end": emitted_end, "release_after": set(),
-            "t_dispatch": time.monotonic(),
+            "policy": policy, "t_dispatch": time.monotonic(),
         })
 
     def _try_chain(self):
@@ -1628,6 +1928,11 @@ class ServingScheduler:
                 prev["reqs"][s].state == RUNNING and
                 s not in self._zombies]
         if not cont:
+            return False
+        if any(prev["reqs"][s].grammar is not None for s in cont):
+            # a constrained slot's next allowed-token mask depends on
+            # the in-flight horizon's tokens (host-compiled DFA): every
+            # constrained step is a barrier step
             return False
         if all(prev["reqs"][s].remaining_new - prev["max_advance"][s] <= 0
                for s in cont):
@@ -1695,13 +2000,38 @@ class ServingScheduler:
             keep = np.ones(self.num_slots, bool)
             keep[list(self._zombies)] = False
             active = jnp.logical_and(active, jnp.asarray(keep))
-        out = self.engine.decode_multi(
-            prev["tok_end"], active, self.kv.table, prev["lengths_end"],
-            self.pools, horizon=horizon, budgets=self._chain_budgets,
-            eos_ids=self._eos_ids, emitted=prev["emitted_end"],
-            **self.sampling)
+        pol = prev.get("policy")
+        if pol is not None:
+            # same path as the in-flight horizon, same staged params
+            # (membership is frozen, so the slot mirrors are unchanged);
+            # tok_base stays the chain-start base — the device's
+            # `emitted` carry keeps the position stream continuous —
+            # and counts continue from the device carry
+            out = self.engine.decode_multi_policy(
+                prev["tok_end"], active, self.kv.table,
+                prev["lengths_end"], self.pools, horizon=horizon,
+                budgets=self._chain_budgets, eos_ids=self._eos_ids,
+                emitted=prev["emitted_end"], keys=pol["keys"],
+                tok_base=pol["tok_base"], temps=pol["temps"],
+                top_ks=pol["top_ks"], top_ps=pol["top_ps"],
+                rep_pens=pol["rep_pens"], pres_pens=pol["pres_pens"],
+                freq_pens=pol["freq_pens"], counts=pol["counts_end"],
+                mask=pol["mask"])
+            self.metrics.record_policy_dispatch(self.step_idx, len(cont))
+            chain_pol = {k: pol[k] for k in
+                         ("keys", "tok_base", "temps", "top_ks", "top_ps",
+                          "rep_pens", "pres_pens", "freq_pens", "counts",
+                          "mask")}
+        else:
+            chain_pol = None
+            out = self.engine.decode_multi(
+                prev["tok_end"], active, self.kv.table,
+                prev["lengths_end"], self.pools, horizon=horizon,
+                budgets=self._chain_budgets, eos_ids=self._eos_ids,
+                emitted=prev["emitted_end"], **self.sampling)
         self._commit_dispatch(out, cont, horizon,
-                              {s: prev["reqs"][s] for s in cont})
+                              {s: prev["reqs"][s] for s in cont},
+                              policy=chain_pol)
         if self.tracer.enabled:
             self.tracer.instant("horizon_chained", cat="dispatch",
                                 args={"horizon": horizon,
@@ -1756,16 +2086,29 @@ class ServingScheduler:
                 try:
                     self._emit(req, tok)
                     pulled += 1   # only tokens actually DELIVERED count
+                    # policy bookkeeping rides the same containment: a
+                    # grammar rejection of a delivered token fails THIS
+                    # request (the device mask should make it
+                    # impossible — reaching it means corrupted state)
+                    self._note_emitted(slot, req, tok)
                 except Exception as e:  # per-request emit/callback fault
                     self._close_slot_or_defer(
                         slot, FAILED, f"{type(e).__name__}: {e}")
                     break
-                if req._finished_by(tok):
+                if req._finished_by(tok) or self._grammar_finished(req):
                     # the device froze the slot at this same token, so
                     # its pages are read-only in any chained horizon:
-                    # immediate release is safe
+                    # immediate release is safe.  A grammar cursor with
+                    # no continuation (done) finishes the request even
+                    # without eos — the constrained output is complete.
                     self._retire(slot)
                     break
+            if self.slot_req[slot] is req and req.state == RUNNING and \
+                    req.grammar is not None:
+                # refresh the staged mask for the next (barrier)
+                # dispatch — constrained slots run horizon-1 unchained,
+                # so the mask is always exactly one token fresh
+                self._grammar_masks[slot] = req.grammar.token_mask()
             if n and self.tracer.enabled:
                 # one span per (slot, horizon) burst on the slot's own
                 # track: dispatch -> harvest, n tokens delivered.  This
@@ -2076,6 +2419,14 @@ class ServingScheduler:
             "decode_horizon_steps": self.decode_horizon_steps,
             "horizon_buckets": list(self.horizon_buckets),
             "overlap": self.overlap,
+            # decoding-policy subsystem: the scheduler-wide default
+            # policy label, and how much of the traffic actually used
+            # per-request sampling / grammar constraints
+            "decoding_policy": self.default_sampling.label(),
+            "sampled_requests": m.sampled_requests,
+            "grammar_requests": m.grammar_requests,
+            "policy_dispatches": m.policy_dispatches,
+            "grammar_violations": m.grammar_violations,
             "spec_decode": self.spec_mode,
             "spec_k": self.spec_k if self._spec is not None else None,
             "spec_acceptance_rate": round(m.spec_acceptance_rate(), 4),

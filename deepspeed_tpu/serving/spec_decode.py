@@ -66,6 +66,13 @@ class Drafter:
 
     name = "custom"
 
+    # Capability flag: the lossless leftover-probability verifier makes
+    # rejection sampling distribution-exact for ANY proposal distribution,
+    # so built-in drafters opt in.  Custom drafters that predate sampled
+    # verification keep the conservative default: the scheduler skips
+    # sampled slots when drafting (greedy slots still ride spec rounds).
+    supports_sampling = False
+
     def propose(self, items):
         raise NotImplementedError
 
@@ -102,6 +109,7 @@ class NgramDrafter(Drafter):
     double-count them)."""
 
     name = "ngram"
+    supports_sampling = True
 
     def __init__(self, max_ngram=3, min_ngram=1, window=1024):
         self.max_ngram = int(max_ngram)
@@ -171,6 +179,7 @@ class DraftModelDrafter(Drafter):
     cannot grow simply proposes nothing this round."""
 
     name = "draft"
+    supports_sampling = True
 
     def __init__(self, engine, *, num_slots, num_pages, page_size,
                  max_pages_per_slot=None, prefill_chunk=32):

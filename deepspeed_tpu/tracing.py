@@ -92,6 +92,16 @@ EVENT_TAXONOMY = {
     "serving/spec/rollback_tokens": "KV positions rolled back",
     "serving/spec/degraded": "drafter/verify fault contained",
     "serving/spec/wait_ms": "device wait harvesting a verify round",
+    # decoding policy (serving/sampling/: per-slot logit pipeline,
+    # lossless speculative sampling, grammar-constrained generation)
+    "serving/sampling/sampled_requests":
+        "cumulative intakes with a sampled/penalized decoding policy",
+    "serving/sampling/grammar_requests":
+        "cumulative intakes carrying a grammar constraint",
+    "serving/sampling/policy_dispatch":
+        "one fused dispatch took the policy twins (value = slots)",
+    "serving/sampling/grammar_violation":
+        "host grammar cursor rejected an emitted token (request failed)",
     # disaggregation
     "serving/handoff": "one prefill->decode KV chain handed off",
     "serving/handoff_tokens": "prefilled positions transferred",

@@ -121,6 +121,59 @@ def test_failover_zero_lost_token_exact(engine, tmp_path):
     assert any(s["replays"] for s in dumped["entries"])
 
 
+def test_failover_sampled_stream_exact_and_grammar_valid(engine):
+    """Decoding-policy failover: sampled requests (seeded, penalized)
+    and a grammar-constrained request survive a replica kill with the
+    EXACT token stream an undisturbed fleet serves — the position-keyed
+    PRNG means a survivor resumes the stream bitwise, not merely from
+    the same distribution — and the constrained output still matches
+    its grammar after the replay."""
+    from deepspeed_tpu.serving.sampling import compile_grammar
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (5, 9, 7, 5)]
+    rows = [
+        dict(sampling={"do_sample": True, "temperature": 0.9,
+                       "top_p": 0.95}, seed=101),
+        dict(sampling={"do_sample": True, "temperature": 1.1,
+                       "top_k": 50, "repetition_penalty": 1.2},
+             seed=202),
+        dict(sampling={"do_sample": True}, seed=303,
+             grammar={"regex": "(ab|cd)+"}),
+        dict(sampling=None, seed=None),   # greedy control rides along
+    ]
+    max_new = [8, 8, 10, 6]
+
+    def serve(kill):
+        reps = make_local_fleet(engine, 2, **CFG)
+        router = ClusterRouter(reps)
+        inj = faults.FaultInjector(seed=0)
+        plan = None
+        if kill:
+            plan = inj.on("cluster.replica_kill",
+                          match={"replica": "replica0"}, step=3,
+                          exc=RuntimeError("replica crash"))
+        with faults.injected(inj):
+            entries = [router.submit(p, max_new_tokens=m, **row)
+                       for p, m, row in zip(prompts, max_new, rows)]
+            got = router.run()
+        if kill:
+            assert plan.fired == 1
+            assert router.health()["replays"] >= 1
+        assert all(e.state == "finished" for e in entries), \
+            [(e.rid, e.state, e.error) for e in entries]
+        _leak_check(reps)
+        return [got[e.rid] for e in entries]
+
+    calm, stormy = serve(kill=False), serve(kill=True)
+    assert stormy == calm, \
+        "failover replay must continue the sampled streams bitwise"
+    g = compile_grammar({"regex": "(ab|cd)+"},
+                        engine.module.cfg.vocab_size)
+    assert g.accepts(stormy[2]), stormy[2]
+
+
 def test_replica_restart_rejoins_routing(engine):
     """A dead replica restarted through the router serves again."""
     rng = np.random.default_rng(7)
@@ -409,6 +462,15 @@ HEALTH_SCHEMA = {
     "online_tuner": (bool,),
     "tune_nudges": (int,),
     "tuned_from": (str, type(None)),
+    # decoding-policy subsystem (PR 16): the scheduler-wide default
+    # policy label plus the per-request policy counters (sampled/
+    # grammar intakes, policy-path dispatches, contained grammar
+    # violations)
+    "decoding_policy": (str,),
+    "sampled_requests": (int,),
+    "grammar_requests": (int,),
+    "policy_dispatches": (int,),
+    "grammar_violations": (int,),
     "inflight_horizons": (int,),
     "draining": (bool,),
     "handoffs": (int,),

@@ -138,6 +138,9 @@ def _drive_all_serving_events(m):
                   rollback_tokens=2, k=8, slot_rounds=1)
     m.record_spec_degrade(1, rid=1, reason="x")
     m.record_spec_wait(1, 0.001)
+    m.record_policy_request(1, sampled=True, grammar=True)
+    m.record_policy_dispatch(1, 3)
+    m.record_grammar_violation(1, rid=1)
     m.record_handoff(1, 32)
     m.record_mem(1, {"slot": 3, "prefix_shared": 2, "prefix_sole": 1,
                      "handoff": 0, "draft": 0, "unattributed": 0,

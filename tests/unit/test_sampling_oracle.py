@@ -1,0 +1,352 @@
+"""Distribution-exactness + grammar-validity oracles for the decoding
+policy subsystem (deepspeed_tpu/serving/sampling).
+
+Two oracle families:
+
+* **Frequency oracles** (pipeline level, vectorized over thousands of
+  independent request keys): the empirical token frequencies of (a)
+  direct categorical sampling and (b) leftover-probability rejection
+  sampling (lossless speculation, point-mass drafts) both match the
+  target softmax distribution within binomial tolerance — for easy AND
+  adversarial draft choices.  This is the claim that makes sampled+spec
+  composition legal: speculation changes WHEN randomness is consumed,
+  never WHAT distribution tokens are drawn from.
+
+* **Stream-invariance oracles** (scheduler level): the position-keyed
+  PRNG makes a sampled request's token stream a pure function of
+  (params, seed, prompt) — bitwise invariant under forced eviction/
+  preemption, prefix-cache hits, fused-horizon churn (horizon buckets,
+  overlap on/off), spec-decode fault degradation, and mesh sharding.
+  Grammar-constrained requests emit 100% spec-valid output under every
+  one of those disturbances.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import ServingScheduler
+from deepspeed_tpu.serving.sampling import (compile_grammar,
+                                            process_logits, request_key)
+from deepspeed_tpu.serving.sampling.pipeline import (accept_or_resample,
+                                                     sample_processed)
+
+import jax.numpy as jnp
+
+# ------------------------------------------------- frequency oracles
+
+N_TRIALS = 4096
+# binomial noise at N=4096 is sigma <= sqrt(.25/4096) ~ 0.0078 per
+# token; 0.04 is > 5 sigma — tight enough to catch a systematically
+# skewed sampler, loose enough to never flake
+TOL = 0.04
+
+
+def _target(vocab, seed):
+    """A deliberately lopsided target distribution + its logits."""
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(scale=2.0, size=vocab).astype(np.float32)
+    p = np.exp(logits - logits.max())
+    return logits, p / p.sum()
+
+
+def _batch(logits, n):
+    """n independent 'requests' over the same processed logits: one
+    slot per trial, each with its own request key."""
+    x = jnp.tile(jnp.asarray(logits)[None, :], (n, 1))
+    keys = jnp.asarray(np.stack([request_key(s) for s in range(n)]))
+    temps = jnp.ones(n, jnp.float32)
+    return x, keys, temps
+
+
+def _freqs(tokens, vocab):
+    return np.bincount(np.asarray(tokens), minlength=vocab) / len(tokens)
+
+
+def test_direct_sampling_matches_target_distribution():
+    """sample_processed draws from exactly softmax(processed logits)."""
+    vocab = 6
+    logits, p = _target(vocab, seed=0)
+    x, keys, temps = _batch(logits, N_TRIALS)
+    toks = sample_processed(x, keys, jnp.int32(0), temps)
+    assert np.abs(_freqs(toks, vocab) - p).max() < TOL
+
+
+def test_rejection_sampling_distribution_exact_any_draft():
+    """The lossless-speculation core claim: accept-or-resample with a
+    point-mass draft reproduces the target distribution for ANY draft
+    token — the mode, the least likely token, and everything between.
+    (A naive 'accept iff match' or unrenormalized residual fails this
+    immediately.)"""
+    vocab = 6
+    logits, p = _target(vocab, seed=1)
+    x, keys, temps = _batch(logits, N_TRIALS)
+    drafts = [int(np.argmax(p)), int(np.argmin(p)), 0, vocab - 1]
+    for d in drafts:
+        draft = jnp.full(N_TRIALS, d, jnp.int32)
+        accept, fallback = accept_or_resample(x, draft, keys,
+                                              jnp.int32(0), temps)
+        toks = np.where(np.asarray(accept), d, np.asarray(fallback))
+        err = np.abs(_freqs(toks, vocab) - p).max()
+        assert err < TOL, f"draft={d} skewed the distribution ({err:.3f})"
+        # sanity: the acceptance rate itself is p_target(draft)
+        acc = float(np.asarray(accept).mean())
+        assert abs(acc - p[d]) < TOL, (d, acc, p[d])
+        # a rejected column NEVER emits the draft (residual zeroes it)
+        rejected = toks[~np.asarray(accept)]
+        assert d not in rejected
+
+
+def test_rejection_sampling_composes_with_processing():
+    """Same oracle through the FULL pipeline: temperature + top-k
+    reshape the target; rejection sampling must match the RESHAPED
+    distribution (what decode_multi_policy actually samples from)."""
+    vocab = 8
+    logits, _ = _target(vocab, seed=2)
+    n = N_TRIALS
+    pol = dict(
+        counts=jnp.zeros((n, vocab), jnp.int32),
+        mask=jnp.ones((n, vocab), bool),
+        temps=jnp.full(n, 0.7, jnp.float32),
+        top_ks=jnp.full(n, 4, jnp.int32),
+        top_ps=jnp.ones(n, jnp.float32),
+        rep_pens=jnp.ones(n, jnp.float32),
+        pres_pens=jnp.zeros(n, jnp.float32),
+        freq_pens=jnp.zeros(n, jnp.float32))
+    x = process_logits(jnp.tile(jnp.asarray(logits)[None, :], (n, 1)),
+                       **pol)
+    row = np.asarray(x[0])
+    p = np.where(np.isfinite(row), np.exp(row - row[np.isfinite(row)].max()),
+                 0.0)
+    p = p / p.sum()
+    keys = jnp.asarray(np.stack([request_key(s) for s in range(n)]))
+    draft = jnp.full(n, int(np.argsort(p)[-2]), jnp.int32)
+    accept, fallback = accept_or_resample(x, draft, keys, jnp.int32(3),
+                                          pol["temps"])
+    toks = np.where(np.asarray(accept), int(draft[0]),
+                    np.asarray(fallback))
+    assert np.abs(_freqs(toks, vocab) - p).max() < TOL
+    # top-k masked tokens must NEVER appear
+    assert set(np.unique(toks)) <= set(np.flatnonzero(p > 0))
+
+
+def test_rejection_sampling_greedy_rows_token_exact():
+    """Greedy rows keep the legacy rule exactly: accept iff the draft
+    IS the argmax; the fallback is the argmax — never random."""
+    vocab = 6
+    logits, p = _target(vocab, seed=3)
+    x, keys, _ = _batch(logits, 64)
+    temps = jnp.zeros(64, jnp.float32)
+    best = int(np.argmax(logits))
+    for d, want_accept in ((best, True), ((best + 1) % vocab, False)):
+        accept, fallback = accept_or_resample(
+            x, jnp.full(64, d, jnp.int32), keys, jnp.int32(0), temps)
+        assert bool(np.asarray(accept).all()) == want_accept
+        assert (np.asarray(fallback) == best).all()
+
+
+# ------------------------------------------- stream-invariance oracles
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32",
+        kv_cache_dtype="float32", mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+ROOMY = dict(num_slots=3, num_pages=16, page_size=8, max_pages_per_slot=8,
+             prefill_chunk=8)
+TIGHT = dict(num_slots=3, num_pages=4, page_size=8, max_pages_per_slot=4,
+             prefill_chunk=8)
+
+SAMPLED = {"do_sample": True, "temperature": 0.9, "top_p": 0.95}
+PENALIZED = {"do_sample": True, "temperature": 1.1, "top_k": 50,
+             "repetition_penalty": 1.2}
+GRAMMAR = {"regex": "(ab|cd)+"}
+
+
+def _rows():
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (12, 7, 12)]
+    return [
+        (prompts[0], 10, SAMPLED, 101, None),
+        (prompts[1], 12, PENALIZED, 202, None),
+        (prompts[2], 8, SAMPLED, 303, GRAMMAR),
+    ]
+
+
+def _serve(engine, rows, **cfg):
+    sched = ServingScheduler(engine, **cfg)
+    reqs = [sched.submit(p, max_new_tokens=m, sampling=s, seed=seed,
+                         grammar=g)
+            for p, m, s, seed, g in rows]
+    got = sched.run()
+    # with a prefix cache, finished requests donate their pages to the
+    # cache — reclaimable capacity, not a leak
+    cached = 0 if sched.prefix_cache is None \
+        else sched.prefix_cache.cached_pages
+    assert sched.kv.pool.pages_in_use == cached
+    return [got[r.rid] for r in reqs], sched
+
+
+def _check_grammar(rows, streams, engine):
+    for (p, m, s, seed, g), out in zip(rows, streams):
+        if g is not None:
+            gc = compile_grammar(g, engine.module.cfg.vocab_size)
+            assert gc.accepts(out), \
+                f"grammar-constrained output invalid: {out}"
+
+
+def test_sampled_streams_invariant_under_eviction(engine):
+    """Forced preemption/recompute (4-page pool) re-derives every
+    sampled stream BITWISE: position-keyed draws + the counts table
+    reseeded from orig_prompt+out_tokens make eviction invisible."""
+    rows = _rows()
+    calm, _ = _serve(engine, rows, **ROOMY)
+    tight, sched = _serve(engine, rows, **TIGHT)
+    assert sched.metrics.preemptions > 0, \
+        "pool was sized to force eviction; none happened"
+    assert tight == calm, "eviction changed a sampled stream"
+    _check_grammar(rows, tight, engine)
+
+
+def test_sampled_streams_invariant_under_prefix_cache(engine):
+    """Prefix-cache hits serve the SAME sampled streams as cold
+    prefill: cached KV bytes are identical, and the PRNG stream never
+    depended on how the prompt was prefilled."""
+    rng = np.random.default_rng(12)
+    shared = rng.integers(0, 256, 16).astype(np.int32)
+    rows = [
+        (np.concatenate([shared, rng.integers(0, 256, 3).astype(np.int32)]),
+         8, SAMPLED, 7, None),
+        (np.concatenate([shared, rng.integers(0, 256, 2).astype(np.int32)]),
+         8, SAMPLED, 8, None),
+        (np.concatenate([shared[:8],
+                         np.frombuffer(b"x", np.uint8).astype(np.int32)]),
+         6, SAMPLED, 9, GRAMMAR),
+    ]
+    # one slot serializes the requests, so earlier finishers donate
+    # their prefix pages before the later admissions match them
+    one = dict(ROOMY, num_slots=1)
+    cold, _ = _serve(engine, rows, **one)
+    warm, sched = _serve(engine, rows, prefix_cache=True, **one)
+    assert sched.prefix_cache.tokens_reused > 0, "no prefix hit occurred"
+    assert warm == cold, "a prefix-cache hit changed a sampled stream"
+    _check_grammar(rows, warm, engine)
+
+
+def test_sampled_streams_invariant_under_horizon_and_overlap(engine):
+    """Fused-vs-unfused: decode horizon 1 (token-at-a-time) vs 8
+    (fused multi-token scans), overlap on/off — four executions, one
+    bitwise stream set."""
+    rows = _rows()
+    variants = [
+        _serve(engine, rows, decode_horizon_steps=h, overlap=ov,
+               **ROOMY)[0]
+        for h in (1, 8) for ov in (False, True)]
+    for v in variants[1:]:
+        assert v == variants[0], \
+            "horizon/overlap churn changed a sampled stream"
+    _check_grammar(rows, variants[0], engine)
+
+
+def test_sampled_streams_invariant_under_spec_fault_degrade(engine):
+    """Fault containment composes with sampling: a drafter whose every
+    proposal attempt faults degrades each request to normal decode
+    BEFORE any verify round, so the served streams equal the no-spec
+    run bitwise — and the degradation is observable, not silent."""
+    rows = _rows()
+    plain, _ = _serve(engine, rows, **ROOMY)
+    inj = faults.FaultInjector()
+    inj.on("serve.spec_verify", times=None,
+           exc=RuntimeError("injected drafter fault"))
+    with faults.injected(inj):
+        stormy, sched = _serve(engine, rows, spec_decode="ngram",
+                               spec_k=4, do_sample=True,
+                               temperature=0.9, **ROOMY)
+    assert sched.metrics.spec_degraded > 0, "faults never bit"
+    assert sched.metrics.spec_dispatches == 0
+    assert stormy == plain, \
+        "spec fault degradation changed a sampled stream"
+    _check_grammar(rows, stormy, engine)
+
+
+def test_grammar_all_outputs_valid_under_eviction_churn(engine):
+    """The 100%-validity oracle at volume: every one of 9 grammar-
+    constrained requests (three specs: regex, json_schema,
+    response_format) emits spec-valid output through a pool sized to
+    thrash, mixed with unconstrained sampled traffic.  json requests
+    self-terminate at DFA completion (no eos token exists for them)."""
+    rng = np.random.default_rng(13)
+    vocab = engine.module.cfg.vocab_size
+    specs = [
+        {"regex": "(ab|cd)+"},
+        {"json_schema": {"type": "object",
+                         "properties": {"ok": {"type": "boolean"}}}},
+        {"response_format": "json_object"},
+    ]
+    rows = []
+    for i in range(9):
+        g = specs[i % 3]
+        rows.append((rng.integers(0, 256, 5 + (i % 4)).astype(np.int32),
+                     12 if i % 3 == 0 else 24, SAMPLED, 1000 + i, g))
+    rows.append((rng.integers(0, 256, 6).astype(np.int32), 8, SAMPLED,
+                 55, None))   # unconstrained bystander
+    streams, sched = _serve(engine, rows, **TIGHT)
+    assert sched.metrics.preemptions > 0
+    assert sched.health()["grammar_requests"] == 9
+    assert sched.health()["grammar_violations"] == 0
+    for (p, m, s, seed, g), out in zip(rows, streams):
+        if g is None:
+            assert len(out) == 8
+            continue
+        gc = compile_grammar(g, vocab)
+        assert out and gc.accepts(out), f"{g}: invalid output {out!r}"
+
+
+# ------------------------------------------------------- mesh oracles
+
+MESH_CFG = dict(num_slots=8, num_pages=32, page_size=16,
+                max_pages_per_slot=4, prefill_chunk=8)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual CPU mesh")
+@pytest.mark.parametrize("model_ax,data_ax", [(1, 8), (2, 4)])
+def test_sampled_and_grammar_serving_on_mesh(model_ax, data_ax):
+    """The policy pipeline on a multi-chip mesh: per-slot policy lanes
+    shard with the slot family, so sampled/penalized/grammar batches
+    serve correctly on {1x8, 2x4} meshes — streams reproducible run to
+    run, greedy rows token-exact vs the same engine's generate(), and
+    grammar output 100% valid on-mesh."""
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32",
+        kv_cache_dtype="float32",
+        tensor_parallel={"tp_size": model_ax},
+        mesh={"data": data_ax, "model": model_ax})
+    eng.init_params()
+    rng = np.random.default_rng(14)
+    pg = rng.integers(0, 256, 6).astype(np.int32)
+    want = [int(t) for t in eng.generate(
+        pg[None], max_new_tokens=8, do_sample=False)[0, len(pg):]]
+    rows = [
+        (rng.integers(0, 256, 9).astype(np.int32), 8, SAMPLED, 21, None),
+        (rng.integers(0, 256, 7).astype(np.int32), 8, PENALIZED, 22,
+         None),
+        (rng.integers(0, 256, 5).astype(np.int32), 8, SAMPLED, 23,
+         GRAMMAR),
+        (pg, 8, None, None, None),
+    ]
+    a, _ = _serve(eng, rows, **MESH_CFG)
+    b, sched = _serve(eng, rows, **MESH_CFG)
+    assert a == b, "on-mesh sampled streams must be reproducible"
+    assert a[3] == want, "greedy row diverged on-mesh"
+    assert sched.health()["sampled_requests"] == 3
+    _check_grammar(rows, a, eng)

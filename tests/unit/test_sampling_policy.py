@@ -1,0 +1,416 @@
+"""Decoding-policy subsystem unit pins (deepspeed_tpu/serving/sampling):
+the on-device logit pipeline's documented contracts — exact top-p
+boundary semantics on a hand-computable vocab, the staged no-op
+identities that let greedy rows ride a mixed batch bit-exact, the
+position-keyed PRNG reproducibility rule — plus the scheduler-level
+guarantees: greedy-only traffic never touches the policy twins (legacy
+compile pins intact), mixed batches share ONE policy signature per
+horizon bucket across parameter churn, and sampled decoding composes
+with speculative decoding through the drafter capability gate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.serving import ServingScheduler
+from deepspeed_tpu.tracing import jit_cache_size
+from deepspeed_tpu.serving.sampling import (GREEDY, SamplingParams,
+                                            request_key)
+from deepspeed_tpu.serving.sampling.pipeline import (process_logits,
+                                                     sample_processed)
+from deepspeed_tpu.serving.spec_decode import Drafter, NgramDrafter
+
+# --------------------------------------------------------- pure helpers
+
+
+def _noop(n, vocab):
+    """All-no-op per-slot lanes for n slots."""
+    return dict(
+        counts=jnp.zeros((n, vocab), jnp.int32),
+        mask=jnp.ones((n, vocab), bool),
+        temps=jnp.zeros(n, jnp.float32),
+        top_ks=jnp.zeros(n, jnp.int32),
+        top_ps=jnp.ones(n, jnp.float32),
+        rep_pens=jnp.ones(n, jnp.float32),
+        pres_pens=jnp.zeros(n, jnp.float32),
+        freq_pens=jnp.zeros(n, jnp.float32))
+
+
+def _allowed(x):
+    """The token set one processed row still permits."""
+    return set(np.flatnonzero(np.isfinite(np.asarray(x))))
+
+
+# --------------------------------------------------- top-p boundary pin
+
+
+def test_top_p_boundary_semantics_exact_small_vocab():
+    """The pinned cutoff rule on a 4-token vocab with hand-computable
+    probabilities [0.4, 0.3, 0.2, 0.1]: ``cutoff_idx = sum(cum <
+    top_p)`` keeps the smallest prefix whose cumulative mass REACHES
+    top_p — the boundary token that crosses the threshold stays."""
+    probs = np.array([0.4, 0.3, 0.2, 0.1])
+    logits = jnp.asarray(np.log(probs))[None, :]
+    cases = {
+        # top_p -> expected surviving token set
+        0.05: {0},           # even one token overshoots: keep it anyway
+        0.4: {0},            # cum<0.4 -> 0 kept strictly below: {0}
+        0.41: {0, 1},        # 0.4 < p: token 1 needed to reach p
+        0.7: {0, 1},         # cum hits exactly 0.7 AT token 1
+        0.71: {0, 1, 2},
+        0.9999: {0, 1, 2, 3},
+        1.0: {0, 1, 2, 3},   # the documented no-op identity
+    }
+    for top_p, want in cases.items():
+        pol = _noop(1, 4)
+        pol["temps"] = jnp.ones(1, jnp.float32)
+        pol["top_ps"] = jnp.full(1, top_p, jnp.float32)
+        x = process_logits(logits, **pol)
+        assert _allowed(x[0]) == want, (top_p, _allowed(x[0]))
+
+
+def test_top_p_probability_ties_at_cutoff_all_kept():
+    """Uniform [0.25 x 4] with top_p=0.5: the cutoff index lands mid-
+    tie, and every token tying the cutoff logit survives (the rule
+    drops only tokens STRICTLY below the cutoff)."""
+    logits = jnp.zeros((1, 4))
+    pol = _noop(1, 4)
+    pol["temps"] = jnp.ones(1, jnp.float32)
+    pol["top_ps"] = jnp.full(1, 0.5, jnp.float32)
+    x = process_logits(logits, **pol)
+    assert _allowed(x[0]) == {0, 1, 2, 3}
+
+
+def test_top_p_matches_legacy_sampler_rule():
+    """The pipeline's top-p mask equals the `_sample_tokens` rule
+    (sort desc, softmax, cumsum, sum(cum < p)) recomputed in numpy on
+    random logits — the two implementations must never drift."""
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(5, 16)).astype(np.float32)
+    for top_p in (0.1, 0.35, 0.65, 0.9):
+        pol = _noop(5, 16)
+        pol["temps"] = jnp.ones(5, jnp.float32)
+        pol["top_ps"] = jnp.full(5, top_p, jnp.float32)
+        x = process_logits(jnp.asarray(logits), **pol)
+        for i in range(5):
+            srt = np.sort(logits[i])[::-1]
+            p = np.exp(srt - srt.max())
+            p /= p.sum()
+            cutoff = srt[min(int((np.cumsum(p) < top_p).sum()), 15)]
+            want = set(np.flatnonzero(logits[i] >= cutoff))
+            assert _allowed(x[i]) == want, (top_p, i)
+
+
+# ------------------------------------------------------ no-op identities
+
+
+def test_noop_params_pass_logits_through_bit_exact():
+    """All-no-op lanes (greedy temp=0, k=0, p=1, rep=1, pres=0,
+    freq=0, mask all-True) return the fp32 logits BIT-EXACT — even
+    with a populated counts table (penalty gates must not touch
+    untouched rows)."""
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(3, 32)).astype(np.float32)
+    pol = _noop(3, 32)
+    pol["counts"] = jnp.asarray(
+        rng.integers(0, 5, size=(3, 32)).astype(np.int32))
+    x = process_logits(jnp.asarray(logits), **pol)
+    np.testing.assert_array_equal(np.asarray(x), logits)
+
+
+def test_greedy_rows_bit_exact_in_mixed_batch_ties_to_lowest():
+    """A greedy row (temp=0) sharing a batch with penalized sampled
+    rows still argmaxes the ORIGINAL logits, ties breaking to the
+    lowest token id."""
+    logits = np.full((2, 8), -1.0, np.float32)
+    logits[0, 3] = logits[0, 5] = 2.0        # tie: argmax must pick 3
+    logits[1, 1] = 4.0
+    pol = _noop(2, 8)
+    pol["counts"] = jnp.asarray(
+        np.tile(np.arange(8, dtype=np.int32), (2, 1)))
+    # row 1 is heavily sampled+penalized; row 0 stays all-no-op greedy
+    pol["temps"] = jnp.asarray([0.0, 1.3], jnp.float32)
+    pol["top_ks"] = jnp.asarray([0, 4], jnp.int32)
+    pol["rep_pens"] = jnp.asarray([1.0, 1.5], jnp.float32)
+    pol["freq_pens"] = jnp.asarray([0.0, 0.7], jnp.float32)
+    x = process_logits(jnp.asarray(logits), **pol)
+    np.testing.assert_array_equal(np.asarray(x[0]), logits[0])
+    keys = jnp.asarray(np.stack([request_key(0), request_key(9)]))
+    toks = sample_processed(x, keys, jnp.int32(0), pol["temps"])
+    assert int(toks[0]) == 3
+
+
+def test_grammar_mask_survives_top_p_truncation():
+    """Regression: the grammar mask applies BEFORE top-k/top-p, so a
+    constrained row whose only allowed lane sits OUTSIDE the
+    unconstrained nucleus still samples that lane (mask-last left the
+    row all--inf and the categorical draw was garbage)."""
+    rng = np.random.default_rng(7)
+    logits = rng.normal(scale=2.0, size=(1, 256)).astype(np.float32)
+    allowed = int(np.argsort(logits[0])[3])   # a LOW-probability lane
+    mask = np.zeros((1, 256), bool)
+    mask[0, allowed] = True
+    pol = _noop(1, 256)
+    pol["mask"] = jnp.asarray(mask)
+    pol["temps"] = jnp.full(1, 0.9, jnp.float32)
+    pol["top_ps"] = jnp.full(1, 0.95, jnp.float32)
+    pol["top_ks"] = jnp.full(1, 40, jnp.int32)
+    x = process_logits(jnp.asarray(logits), **pol)
+    assert _allowed(x[0]) == {allowed}
+    keys = jnp.asarray(request_key(1))[None, :]
+    for i in range(4):
+        assert int(sample_processed(x, keys, jnp.int32(i),
+                                    pol["temps"])[0]) == allowed
+
+
+def test_penalties_exclude_seen_tokens_when_extreme():
+    """A huge presence penalty makes any seen token unsampleable —
+    the counts table is the penalty's source of truth."""
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(1, 16)).astype(np.float32))
+    counts = np.zeros((1, 16), np.int32)
+    counts[0, :8] = 1                        # tokens 0..7 already seen
+    pol = _noop(1, 16)
+    pol["counts"] = jnp.asarray(counts)
+    pol["temps"] = jnp.ones(1, jnp.float32)
+    pol["pres_pens"] = jnp.full(1, 1e9, jnp.float32)
+    x = process_logits(logits, **pol)
+    keys = jnp.asarray(request_key(5))[None, :]
+    for i in range(20):
+        tok = int(sample_processed(x, keys, jnp.int32(i),
+                                   pol["temps"])[0])
+        assert tok >= 8, f"sampled a presence-penalized token {tok}"
+
+
+def test_position_keyed_prng_reproducible():
+    """Same key + same position -> same token; the stream depends on
+    (seed, position) only, which is what makes replay/failover
+    bitwise."""
+    rng = np.random.default_rng(3)
+    x = process_logits(
+        jnp.asarray(rng.normal(size=(1, 64)).astype(np.float32)),
+        **{**_noop(1, 64), "temps": jnp.ones(1, jnp.float32)})
+    keys = jnp.asarray(request_key(1234))[None, :]
+    temps = jnp.ones(1, jnp.float32)
+    a = [int(sample_processed(x, keys, jnp.int32(i), temps)[0])
+         for i in range(8)]
+    b = [int(sample_processed(x, keys, jnp.int32(i), temps)[0])
+         for i in range(8)]
+    assert a == b
+    assert len(set(a)) > 1, "position folding must vary the stream"
+
+
+# -------------------------------------------------------- params object
+
+
+def test_sampling_params_wire_contract():
+    assert GREEDY.is_greedy and not GREEDY.needs_policy
+    assert GREEDY.label() == "greedy"
+    sp = SamplingParams.from_dict({"do_sample": True, "temperature": 0.8,
+                                   "top_k": 40}, defaults=GREEDY)
+    assert sp.needs_policy and sp.staged_temperature == 0.8
+    # do_sample with temperature 0 IS greedy (the pinned argmax rule)
+    assert SamplingParams(do_sample=True, temperature=0.0).is_greedy
+    # penalties alone need the policy path even when greedy
+    assert SamplingParams(repetition_penalty=1.2).needs_policy
+    with pytest.raises(ValueError, match="unknown sampling params"):
+        SamplingParams.from_dict({"temprature": 0.5})
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-1)
+    # round-trip
+    assert SamplingParams.from_dict(sp.to_dict()).to_dict() == sp.to_dict()
+    # request_key is PRNGKey(seed)'s raw buffer
+    k = request_key((7 << 32) | 11)
+    assert k.dtype == np.uint32 and list(k) == [7, 11]
+
+
+# -------------------------------------------------- scheduler-level pins
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32",
+        kv_cache_dtype="float32", mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+CFG = dict(num_slots=3, num_pages=16, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+
+SAMPLED = {"do_sample": True, "temperature": 0.8, "top_k": 40,
+           "top_p": 0.9}
+
+
+def _greedy_oracle(engine, prompts, max_new):
+    return [
+        [int(t) for t in
+         engine.generate(p[None], max_new_tokens=m, do_sample=False)[
+             0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+def test_greedy_traffic_rides_legacy_signatures(engine):
+    """Pure-greedy traffic under a greedy default never touches the
+    policy twins: tokens match generate() exactly and the policy
+    compile caches stay EMPTY (the legacy compile pins are preserved
+    byte-for-byte)."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 9, 7)]
+    want = _greedy_oracle(engine, prompts, [6, 6, 6])
+    sched = ServingScheduler(engine, **CFG)
+    reqs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+    assert jit_cache_size(
+        getattr(engine, "_paged_decode_policy_fn", None)) == 0, \
+        "greedy-only traffic compiled the policy twin"
+    h = sched.health()
+    assert h["decoding_policy"] == "greedy"
+    assert h["policy_dispatches"] == 0 and h["sampled_requests"] == 0
+
+
+def test_mixed_batch_one_policy_signature_across_param_churn(engine):
+    """Mixed greedy/sampled/penalized batches with WILDLY churning
+    parameters keep ``serving_decode_multi_compile_count()`` flat
+    after warmup: policy params are traced per-slot lanes, never jit
+    statics, so a new temperature/top-p/seed costs zero recompiles."""
+    rng = np.random.default_rng(1)
+
+    def wave(i):
+        sched = ServingScheduler(engine, **CFG)
+        prompts = [rng.integers(0, 256, 5 + i).astype(np.int32)
+                   for _ in range(3)]
+        rows = [None,
+                {"do_sample": True, "temperature": 0.5 + 0.1 * i,
+                 "top_k": 10 * (i + 1), "top_p": 0.8 + 0.01 * i},
+                {"do_sample": True, "temperature": 1.0 + 0.2 * i,
+                 "repetition_penalty": 1.0 + 0.1 * i,
+                 "frequency_penalty": 0.1 * i}]
+        reqs = [sched.submit(p, max_new_tokens=6, sampling=s,
+                             seed=100 * i + j)
+                for j, (p, s) in enumerate(zip(prompts, rows))]
+        got = sched.run()
+        assert all(len(got[r.rid]) == 6 for r in reqs)
+        assert sched.health()["policy_dispatches"] > 0
+
+    wave(0)
+    warm = engine.serving_decode_multi_compile_count()
+    for i in range(1, 4):
+        wave(i)
+    assert engine.serving_decode_multi_compile_count() == warm, \
+        "parameter churn recompiled the policy path"
+
+
+def test_sampled_request_seed_reproducible_and_greedy_row_exact(engine):
+    """One mixed batch: the greedy row matches generate() token-exact
+    while riding the policy path; the sampled row reproduces bitwise
+    under the same seed and diverges under a different one."""
+    rng = np.random.default_rng(2)
+    pg, ps = (rng.integers(0, 256, n).astype(np.int32) for n in (5, 9))
+    want = _greedy_oracle(engine, [pg], [6])[0]
+
+    def run(seed):
+        sched = ServingScheduler(engine, **CFG)
+        rg = sched.submit(pg, max_new_tokens=6)
+        rs = sched.submit(ps, max_new_tokens=6, sampling=SAMPLED,
+                          seed=seed)
+        got = sched.run()
+        assert got[rg.rid] == want, "greedy row diverged on policy path"
+        return got[rs.rid]
+
+    assert run(42) == run(42)
+    assert run(42) != run(43) or run(42) != run(44)
+
+
+# ------------------------------------------- sampled + spec composition
+
+
+class ConstantDrafter(Drafter):
+    """Always proposes; opts into lossless sampled verification.
+    Guarantees verify rounds actually run (ngram matching on a random
+    sampled stream is too hit-or-miss to pin spec engagement on)."""
+    name = "const"
+    supports_sampling = True
+
+    def propose(self, items):
+        return {slot: [7] * k for slot, _req, k in items}
+
+
+def test_sampled_composes_with_spec_decode(engine):
+    """The PR's gate removal: sampled requests and speculative decoding
+    run together when the drafter opts in.  Spec stays armed under a
+    sampled scheduler-wide default, verify rounds actually run, and
+    every request finishes with its full budget."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, n).astype(np.int32) for n in (5, 9)]
+    sched = ServingScheduler(engine, spec_drafter=ConstantDrafter(),
+                             spec_k=4, do_sample=True, temperature=0.7,
+                             **CFG)
+    assert sched._spec is not None, \
+        "sampled default must NOT disable a sampling-capable drafter"
+    reqs = [sched.submit(p, max_new_tokens=12, seed=7 + i)
+            for i, p in enumerate(prompts)]
+    got = sched.run()
+    assert all(len(got[r.rid]) == 12 for r in reqs)
+    assert sched.metrics.spec_dispatches > 0, "spec never engaged"
+    assert sched.health()["policy_dispatches"] > 0
+    assert sched.kv.pool.pages_in_use == 0
+
+
+def test_spec_gate_is_drafter_capability_not_greedy(engine):
+    """A drafter WITHOUT supports_sampling is disabled under a sampled
+    default (the old behavior, now opt-out), and skipped per-request
+    for sampled slots under a greedy default."""
+    class LegacyDrafter(Drafter):
+        supports_sampling = False
+
+        def propose(self, items):
+            return {slot: [0] * k for slot, _, k in items}
+
+    sched = ServingScheduler(engine, spec_drafter=LegacyDrafter(),
+                             do_sample=True, temperature=0.7, **CFG)
+    assert sched._spec is None
+    assert "supports_sampling" in sched.spec_mode
+    # greedy default: the legacy drafter still serves greedy requests
+    sched2 = ServingScheduler(engine, spec_drafter=LegacyDrafter(), **CFG)
+    assert sched2._spec is not None
+    assert getattr(NgramDrafter, "supports_sampling", False) is True
+
+
+def test_sampled_spec_stream_reproducible_and_greedy_token_exact(engine):
+    """Position-keyed draws make a sampled spec-on stream fully
+    deterministic: same seed + same drafter -> the identical stream,
+    run to run.  And greedy rows riding the same verify rounds stay
+    TOKEN-EXACT vs generate() (the argmax accept rule) — speculation
+    is a pure speedup for them even in a sampled batch.  (Whether the
+    sampled stream matches the unspeculated DISTRIBUTION is the
+    frequency-oracle suite's job, not a bitwise claim.)"""
+    rng = np.random.default_rng(4)
+    ps, pg = (rng.integers(0, 256, n).astype(np.int32) for n in (7, 5))
+    want = _greedy_oracle(engine, [pg], [10])[0]
+
+    def run():
+        sched = ServingScheduler(engine, spec_drafter=ConstantDrafter(),
+                                 spec_k=4, **CFG)
+        rs = sched.submit(ps, max_new_tokens=10, sampling=SAMPLED,
+                          seed=99)
+        rg = sched.submit(pg, max_new_tokens=10)
+        got = sched.run()
+        assert sched.metrics.spec_dispatches > 0
+        return got[rs.rid], got[rg.rid]
+
+    s1, g1 = run()
+    s2, g2 = run()
+    assert s1 == s2, "sampled spec-on stream must be reproducible"
+    assert g1 == want and g2 == want, \
+        "greedy row in a sampled spec batch diverged from generate()"

@@ -85,6 +85,22 @@ kill``                (``serving/cluster/replica.py``, both     ``replica``
                       its pages return to the pool and the
                       request requeues for unified serving,
                       token-exact either way
+``cluster.router_    entry of ``ClusterRouter.step``, before    ``step``
+kill``                anything else runs — a raised exception
+                      IS the ROUTER's death (nothing after
+                      the raise executes, exactly like a
+                      process crash between pumps).  Under a
+                      :class:`RouterSupervisor` the standby
+                      acquires the next lease epoch, replays
+                      the journal WAL tail, fences the fleet
+                      and resumes: every request completes
+                      exactly-once, sampled streams bitwise
+                      identical to a kill-free run.  A
+                      ``sleep`` action instead models a
+                      STALLED primary: the lease expires,
+                      the standby takes over, and the woken
+                      zombie's dispatches/tokens/WAL appends
+                      are all fenced
 ====================  =======================================  ==========
 
 Usage::

@@ -38,10 +38,16 @@ from deepspeed_tpu.serving.scheduler import (CANCELLED,  # noqa: F401
                                              ServingScheduler)
 from deepspeed_tpu.serving.cluster import (ClusterRouter,  # noqa: F401
                                            DisaggGroup,
+                                           FileWalSink,
+                                           Lease,
                                            LocalReplica,
+                                           MemoryWalSink,
                                            ProcessReplica,
                                            ReplicaKilled,
                                            RequestJournal,
+                                           RouterSupervisor,
+                                           StaleEpoch,
                                            make_disaggregated_group,
                                            make_local_fleet)
-from deepspeed_tpu.serving.metrics import ClusterMetrics  # noqa: F401
+from deepspeed_tpu.serving.metrics import (ClusterMetrics,  # noqa: F401
+                                           HaMetrics)

@@ -21,17 +21,30 @@ above ``ServingScheduler``:
   chains to decode workers (``take_slot_pages`` ->
   ``attach_handoff``), degrading gracefully to unified serving when no
   prefill worker is healthy.
+* Router HA (``ha.py`` + ``wal.py``) — the router itself is
+  replaceable: every journal mutation is write-ahead logged through a
+  pluggable sink, a :class:`~deepspeed_tpu.serving.cluster.ha.Lease`
+  with monotonic epochs fences dispatch, and a
+  :class:`~deepspeed_tpu.serving.cluster.ha.RouterSupervisor` promotes
+  a standby on router death or lease expiry by replaying the WAL tail
+  — exactly-once client output held across the takeover.
 
-See ``docs/resilience.md`` ("Cluster failure model") for the exact
-at-most-once/at-least-once split and the failover timings.
+See ``docs/resilience.md`` ("Cluster failure model" and "Router HA")
+for the exact at-most-once/at-least-once split and the fencing
+guarantees.
 """
 
+from deepspeed_tpu.serving.cluster.ha import (Lease,  # noqa: F401
+                                              RouterSupervisor)
 from deepspeed_tpu.serving.cluster.journal import (JournalEntry,  # noqa: F401
                                                    RequestJournal)
 from deepspeed_tpu.serving.cluster.replica import (LocalReplica,  # noqa: F401
                                                    ProcessReplica,
-                                                   ReplicaKilled)
+                                                   ReplicaKilled,
+                                                   StaleEpoch)
 from deepspeed_tpu.serving.cluster.router import (ClusterRouter,  # noqa: F401
                                                   DisaggGroup,
                                                   make_disaggregated_group,
                                                   make_local_fleet)
+from deepspeed_tpu.serving.cluster.wal import (FileWalSink,  # noqa: F401
+                                               MemoryWalSink)

@@ -1,0 +1,290 @@
+"""Router high availability: lease-fenced standby takeover over the
+journal WAL.
+
+The journal already makes every *replica* replaceable; this module
+makes the ROUTER replaceable — the last single point of failure in the
+cluster tier.  The design is the classic WAL + lease + fencing-token
+triple (the same shape as HDFS NameNode HA or a Raft leader change,
+scaled to this in-process harness):
+
+* every journal mutation is write-ahead logged through a shared sink
+  (``cluster/wal.py``) *before* it takes effect — in particular before
+  a token reaches the client;
+* a :class:`Lease` with monotonically increasing epochs names the one
+  router allowed to dispatch.  The epoch rides every replica-facing
+  call and every WAL append as a fencing token;
+* on primary death (``cluster.router_kill`` fault, an uncontained
+  router exception) or lease expiry (a stalled primary that missed its
+  renewal), the :class:`RouterSupervisor` promotes a standby: acquire
+  the next epoch, replay the WAL tail into a bit-identical journal,
+  fence the fleet (replicas cancel work dispatched under older epochs
+  and reject stale-epoch calls), re-adopt in-flight entries through
+  the router's own ``_replay`` path, re-drive journaled-but-undispatched
+  handoff packets, and resume pumping.
+
+What the client sees: nothing.  Admissions are idempotent (rids),
+delivered tokens are in the WAL so the heir never re-emits them
+(emitted tokens fold into the resubmitted prompt — the preemption
+trick), and the PR-16 policy fields replay so sampled/grammar streams
+continue bitwise.  A zombie primary that keeps running can neither
+dispatch (replicas raise ``StaleEpoch``), deliver (its token sinks
+drop once the lease moved, and the WAL fences the append regardless),
+nor corrupt the log (``fenced_writes`` counts its attempts).
+"""
+
+import time
+
+from deepspeed_tpu.serving.cluster import journal as jn
+from deepspeed_tpu.serving.cluster.journal import RequestJournal
+from deepspeed_tpu.serving.cluster.replica import DEAD
+from deepspeed_tpu.serving.cluster.router import ClusterRouter, _Packet
+from deepspeed_tpu.serving.cluster.wal import MemoryWalSink
+from deepspeed_tpu.serving.metrics import HaMetrics
+
+__all__ = ["Lease", "RouterKilled", "RouterSupervisor"]
+
+
+class RouterKilled(RuntimeError):
+    """The primary router died mid-pump (chaos fault or uncontained
+    router bug).  Raised only for callers running WITHOUT a
+    RouterSupervisor; under one, it is the takeover trigger."""
+
+
+class Lease:
+    """Monotonic-epoch dispatch lease.
+
+    ``acquire()`` mints the next epoch and names a new holder;
+    ``renew()`` extends the current holder's term but FAILS once the
+    lease expired or a newer epoch exists — a stalled primary that
+    wakes up after its term cannot un-depose the heir.  The epoch never
+    decreases: it is the fencing token everything downstream compares
+    against.
+    """
+
+    def __init__(self, ttl_s=1.0, clock=time.monotonic):
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self.current_epoch = 0
+        self.holder = None
+        self.expires_at = 0.0
+
+    def acquire(self, holder):
+        self.current_epoch += 1
+        self.holder = holder
+        self.expires_at = self._clock() + self.ttl_s
+        return self.current_epoch
+
+    def renew(self, epoch):
+        if epoch != self.current_epoch:
+            return False               # deposed: a newer epoch exists
+        if self._clock() > self.expires_at:
+            return False               # too late: the term lapsed
+        self.expires_at = self._clock() + self.ttl_s
+        return True
+
+    def expired(self):
+        return self._clock() > self.expires_at
+
+
+class RouterSupervisor:
+    """Primary + standby routers over one WAL; promotes on death.
+
+    The supervisor owns what must SURVIVE a router: the WAL sink, the
+    lease, the client ``on_token`` callbacks (rebound onto the heir's
+    reconstructed entries), and rid assignment.  Clients submit and
+    pump through the supervisor; ``entry(rid)`` is the live view of a
+    request across any number of takeovers (the underlying entry
+    object changes when a standby replays the WAL).
+    """
+
+    def __init__(self, replicas, *, wal=None, lease_ttl_s=30.0,
+                 monitor=None, gauge_every=64, **router_kw):
+        self.replicas = list(replicas)
+        self.wal = wal if wal is not None else MemoryWalSink()
+        self.lease = Lease(ttl_s=lease_ttl_s)
+        self.monitor = monitor
+        self.ha = HaMetrics(monitor)
+        self.gauge_every = int(gauge_every)
+        self._router_kw = dict(router_kw)
+        self._router_kw.setdefault("monitor", monitor)
+        self._sinks = {}           # rid -> client on_token (survives HA)
+        self._next_rid = 0
+        self.failovers = 0
+        self.fenced_token_total = 0   # sink-level drops across routers
+        self.takeover_reasons = []
+        self._routers_minted = 0
+        self.router = self._mint_router(RequestJournal(
+            wal=self.wal, epoch=self.lease.acquire("router-0")))
+
+    # --------------------------------------------------------- plumbing
+    def _mint_router(self, journal):
+        self._routers_minted += 1
+        journal.attach_wal(self.wal, self.lease.current_epoch)
+        r = ClusterRouter(self.replicas, journal=journal,
+                          epoch=self.lease.current_epoch,
+                          lease=self.lease, **self._router_kw)
+        self.ha.record_gauges(max(1, r.step_idx),
+                              self.lease.current_epoch,
+                              self.wal.fenced_writes,
+                              self.wal.records_appended)
+        return r
+
+    @property
+    def journal(self):
+        return self.router.journal
+
+    @property
+    def epoch(self):
+        return self.router.epoch
+
+    def entry(self, rid):
+        """The CURRENT journal's view of a request — stable across
+        takeovers (entry objects are rebuilt from the WAL)."""
+        return self.router.journal.entries.get(rid)
+
+    # ----------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               on_token=None, deadline_s=None, rid=None, sampling=None,
+               seed=None, grammar=None):
+        if rid is None:
+            rid = f"ha-{self._next_rid}"
+            self._next_rid += 1
+        if on_token is not None:
+            self._sinks[rid] = on_token
+        return self.router.submit(
+            prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, on_token=on_token,
+            deadline_s=deadline_s, rid=rid, sampling=sampling,
+            seed=seed, grammar=grammar)
+
+    def cancel(self, rid):
+        return self.router.cancel(rid)
+
+    # ------------------------------------------------------------- pump
+    def step(self):
+        """One supervised pump.  A raise out of the primary's step (the
+        ``cluster.router_kill`` chaos point, or any uncontained router
+        bug) IS the router death; lease expiry catches the stalled-
+        not-dead case.  Either way the standby takes over and the pump
+        continues without losing the iteration."""
+        try:
+            live = self.router.step()
+        except Exception as e:
+            self._takeover(f"router died: {type(e).__name__}: {e}")
+            return self.router.step()
+        if self.lease.expired() or \
+                self.lease.current_epoch != self.router.epoch:
+            self._takeover("lease expired")
+            return self.router.step()
+        if self.gauge_every and \
+                self.router.step_idx % self.gauge_every == 0:
+            self.ha.record_gauges(self.router.step_idx, self.epoch,
+                                  self.wal.fenced_writes,
+                                  self.wal.records_appended)
+        return live
+
+    def run(self, max_steps=100000):
+        """Pump until every journaled request is terminal; returns
+        ``{rid: emitted}`` for the FINISHED ones (from the CURRENT
+        journal — WAL replay carries pre-takeover history across)."""
+        for _ in range(max_steps):
+            if not self.step():
+                break
+            if not any(rep.state != DEAD and rep.has_work()
+                       for rep in self.replicas) and \
+                    not self.router._packets:
+                time.sleep(0.002)
+        return {e.rid: list(e.emitted)
+                for e in self.router.journal.entries.values()
+                if e.state == jn.FINISHED}
+
+    # --------------------------------------------------------- takeover
+    def _takeover(self, reason):
+        old = self.router
+        self.fenced_token_total += old.fenced_tokens
+        self.failovers += 1
+        self.takeover_reasons.append(reason)
+        epoch = self.lease.acquire(f"router-{self._routers_minted}")
+        # 1. rebuild the journal from the WAL tail (snapshot + records)
+        snapshot, records = self.wal.replay_stream()
+        journal = RequestJournal.replay(records, snapshot=snapshot)
+        # 2. rebind the surviving client sinks onto the heir's entries
+        for rid, entry in journal.entries.items():
+            entry.on_token = self._sinks.get(rid)
+        # 3. fence the fleet: stale-epoch work is cancelled at the
+        # replicas, stale-epoch calls rejected from here on
+        for rep in self.replicas:
+            if hasattr(rep, "fence") and rep.state != DEAD:
+                rep.fence(epoch)
+        router = self._mint_router(journal)
+        router.step_idx = old.step_idx     # chaos/trace continuity
+        # 4. re-adopt in-flight entries through the standard replay
+        # path (folds emitted tokens, honours cancel, finalizes
+        # already-satisfied streams) and re-drive journaled handoff
+        # packets that never dispatched
+        stranded = []
+        groups = {rep.group.name: rep.group
+                  for rep in self.replicas if rep.group is not None}
+        for entry in list(journal.live()):
+            if entry.state == jn.ROUTED:
+                stranded.append(entry.rid)
+                router._replay(entry, dead_replica=entry.replica)
+            elif entry.state == jn.HANDOFF:
+                stranded.append(entry.rid)
+                pkt = journal.pending_packets.get(entry.rid)
+                group = None if pkt is None else groups.get(pkt["group"])
+                if group is None:
+                    entry.next_try = 0.0
+                    journal.requeue(entry, error="handoff group lost "
+                                                 "across takeover")
+                else:
+                    router._packets.append(_Packet(
+                        entry, group, list(pkt["prompt"]),
+                        list(pkt["pages"]), pkt["length"],
+                        pkt["first_tok"], group.pool))
+        tracer = self._router_kw.get("tracer")
+        if tracer is not None:
+            tracer.instant(
+                "router_takeover", cat="failover",
+                args={"epoch": epoch, "reason": reason,
+                      "stranded": stranded,
+                      "wal_records": self.wal.records_appended})
+        self.ha.record_takeover(max(1, old.step_idx), epoch,
+                                self.wal.fenced_writes,
+                                self.wal.records_appended)
+        self.router = router
+
+    # ----------------------------------------------------------- facade
+    def drain_all(self, grace_s=None, shed_queued=True):
+        return self.router.drain_all(grace_s=grace_s,
+                                     shed_queued=shed_queued)
+
+    def audit(self, raise_on_error=True):
+        return self.router.audit(raise_on_error=raise_on_error)
+
+    def comm_ledger(self):
+        return self.router.comm_ledger()
+
+    def fleet_trace(self):
+        return self.router.fleet_trace()
+
+    def dump_trace(self, path):
+        return self.router.dump_trace(path)
+
+    def health(self):
+        """The router's fleet snapshot plus the ``ha_*`` layer: lease
+        epoch, takeovers, WAL cursor and fencing counters — the fields
+        the router-chaos CI job asserts on."""
+        h = self.router.health()
+        h.update({
+            "ha_enabled": True,
+            "ha_epoch": self.lease.current_epoch,
+            "ha_holder": self.lease.holder,
+            "ha_failovers": self.failovers,
+            "ha_fenced_writes": self.wal.fenced_writes,
+            "ha_fenced_tokens": self.fenced_token_total +
+            self.router.fenced_tokens,
+            "ha_wal_records": self.wal.records_appended,
+            "ha_wal_position": self.wal.position(),
+        })
+        return h

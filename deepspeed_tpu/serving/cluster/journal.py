@@ -17,13 +17,28 @@ holds the work — so failover is pure bookkeeping:
   after the entry went terminal (a straggler event from a dying
   replica can never duplicate output).
 
+**Write-ahead log.**  When constructed with a ``wal`` sink (see
+``cluster/wal.py``) every mutation is journaled as one record *before*
+it is applied — in particular a token record is written before the
+token is delivered, so a standby replaying the stream reconstructs
+exactly the client-visible state.  Appends carry the journal's
+``epoch``; a sink that has seen a newer epoch rejects the append and
+the mutation does NOT happen (``fenced`` flips, the deposed router
+stops).  :meth:`RequestJournal.replay` rebuilds a journal from a
+``(snapshot, records)`` stream bit-identically over every field in
+:meth:`JournalEntry.to_record` — including the PR-16 decoding-policy
+fields (``sampling``/``seed``/``grammar``) that make sampled streams
+continue bitwise after a takeover.
+
 The journal is bounded: terminal entries rotate out after
 ``terminal_history`` (live entries are never evicted — they are the
-replay state).  ``dump()`` writes the whole thing as JSON for CI
-artifacts and post-mortems.
+replay state).  ``dump()`` writes the whole thing as JSON — to a
+``.tmp`` then renamed (crash-safe, like checkpoints) with the WAL
+position in the header — for CI artifacts and post-mortems.
 """
 
 import json
+import os
 import time
 from collections import OrderedDict
 
@@ -39,8 +54,8 @@ class JournalEntry:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "deadline_abs", "on_token", "emitted", "state", "error",
                  "attempts", "replays", "replica", "replica_history",
-                 "handle", "next_try", "t_submit", "t_first", "t_last",
-                 "cancel_requested", "trace_flow",
+                 "replica_inc", "handle", "next_try", "t_submit",
+                 "t_first", "t_last", "cancel_requested", "trace_flow",
                  "sampling", "seed", "grammar")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
@@ -61,6 +76,10 @@ class JournalEntry:
         self.replays = 0           # failover resubmissions
         self.replica = None        # current owner replica id
         self.replica_history = []  # every replica that ever held it
+        self.replica_inc = 0       # owner's incarnation at dispatch time:
+                                   # a sink minted for incarnation N of a
+                                   # replica is deaf after restart N+1, so
+                                   # a flapping replica can't double-emit
         self.handle = None         # replica-side request handle
         self.next_try = 0.0        # monotonic gate for backoff retries
         self.t_first = None        # first delivered token (cluster TTFT)
@@ -114,17 +133,194 @@ class JournalEntry:
             "grammar": self.grammar,
         }
 
+    def to_record(self):
+        """The replayable state — every field a WAL round-trip must
+        reproduce bit-identically.  Excludes process-local handles
+        (``on_token``/``handle``/``trace_flow``) and the latency clocks
+        (``t_first``/``t_last``/``next_try``), which restart with the
+        adopting router."""
+        return {
+            "rid": self.rid, "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "eos_token_id": self.eos_token_id,
+            "t_submit": self.t_submit, "deadline_abs": self.deadline_abs,
+            "emitted": list(self.emitted), "state": self.state,
+            "error": self.error, "attempts": self.attempts,
+            "replays": self.replays, "replica": self.replica,
+            "replica_history": list(self.replica_history),
+            "replica_inc": self.replica_inc,
+            "cancel_requested": self.cancel_requested,
+            "sampling": self.sampling, "seed": self.seed,
+            "grammar": self.grammar,
+        }
+
+    @classmethod
+    def from_record(cls, rec):
+        e = cls(rec["rid"], rec["prompt"], rec["max_new_tokens"],
+                rec.get("eos_token_id"), sampling=rec.get("sampling"),
+                seed=rec.get("seed"), grammar=rec.get("grammar"))
+        e.t_submit = rec.get("t_submit", e.t_submit)
+        e.deadline_abs = rec.get("deadline_abs")
+        e.emitted = [int(t) for t in rec.get("emitted", [])]
+        e.state = rec.get("state", QUEUED)
+        e.error = rec.get("error")
+        e.attempts = int(rec.get("attempts", 0))
+        e.replays = int(rec.get("replays", 0))
+        e.replica = rec.get("replica")
+        e.replica_history = list(rec.get("replica_history", []))
+        e.replica_inc = int(rec.get("replica_inc", 0))
+        e.cancel_requested = bool(rec.get("cancel_requested", False))
+        return e
+
 
 class RequestJournal:
-    """rid-keyed journal with idempotent admission and bounded terminal
-    retention."""
+    """rid-keyed journal with idempotent admission, bounded terminal
+    retention, and (optional) write-ahead logging of every mutation."""
 
-    def __init__(self, terminal_history=4096):
+    def __init__(self, terminal_history=4096, wal=None, epoch=0,
+                 snapshot_every=512):
         self.entries = OrderedDict()      # rid -> entry (live + recent)
         self.terminal_history = int(terminal_history)
         self._terminal_count = 0
         self._auto_rid = 0
+        self.wal = wal
+        self.epoch = int(epoch)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.wal_records = 0              # accepted appends by THIS writer
+        self.fenced = False               # a newer epoch owns the WAL
+        self._since_snapshot = 0
+        self._checkpoint_due = False
+        # handoff packets journaled but not yet re-dispatched, rid ->
+        # wire record — a takeover re-drives these (pages are plain ids;
+        # the adopting router resolves pool/group from its own fleet)
+        self.pending_packets = {}
 
+    # ------------------------------------------------------ WAL core
+    def _wal(self, record):
+        """Write-ahead append.  True = accepted (apply the mutation),
+        False = fenced by a newer epoch (the mutation MUST NOT apply —
+        exactly-once output is enforced right here).
+
+        Auto-checkpoints are DEFERRED to the start of the next append:
+        _wal runs before its record's mutation applies, so a snapshot
+        taken here would miss the in-flight record — and compaction
+        would then drop that record from the log entirely."""
+        if self.wal is None:
+            return True
+        if self._checkpoint_due:
+            self.checkpoint()
+        if not self.wal.append(record, epoch=self.epoch):
+            self.fenced = True
+            return False
+        self.wal_records += 1
+        self._since_snapshot += 1
+        if self._since_snapshot >= self.snapshot_every:
+            self._checkpoint_due = True
+        return True
+
+    def state_snapshot(self):
+        """Full journal state for WAL snapshots (compaction points).
+        ``pending_packets`` must ride along: a journaled-but-undispatched
+        handoff packet whose record was compacted away would otherwise
+        be unrecoverable by the adopting router."""
+        return {"auto_rid": self._auto_rid,
+                "terminal_count": self._terminal_count,
+                "pending_packets": {rid: dict(rec) for rid, rec
+                                    in self.pending_packets.items()},
+                "entries": [e.to_record() for e in self.entries.values()]}
+
+    def checkpoint(self):
+        """Write a WAL snapshot now (also called automatically every
+        ``snapshot_every`` records)."""
+        if self.wal is None:
+            return False
+        ok = self.wal.snapshot(self.state_snapshot(), epoch=self.epoch)
+        self._checkpoint_due = False
+        if ok:
+            self._since_snapshot = 0
+        else:
+            self.fenced = True
+        return ok
+
+    @classmethod
+    def replay(cls, records, snapshot=None, terminal_history=4096):
+        """Reconstruct a journal from a WAL stream: apply ``snapshot``
+        (if any), then each record in order.  ``on_token`` sinks and
+        replica handles are process-local and come back ``None`` — the
+        adopting supervisor rebinds them.  The result round-trips:
+        ``to_record()`` of every entry is bit-identical to the
+        writer's."""
+        j = cls(terminal_history=terminal_history)
+        if snapshot:
+            j._auto_rid = int(snapshot.get("auto_rid", 0))
+            j._terminal_count = int(snapshot.get("terminal_count", 0))
+            for rid, rec in snapshot.get("pending_packets",
+                                         {}).items():
+                j.pending_packets[rid] = dict(rec)
+            for rec in snapshot.get("entries", []):
+                e = JournalEntry.from_record(rec)
+                j.entries[e.rid] = e
+        for rec in records:
+            j._apply(rec)
+        return j
+
+    def attach_wal(self, wal, epoch):
+        """Adopt a WAL as the new writer at ``epoch`` — the takeover
+        path: a journal reconstructed by :meth:`replay` starts logging
+        its own mutations (the old primary's appends are now fenced)."""
+        self.wal = wal
+        self.epoch = int(epoch)
+        self.fenced = False
+        self._since_snapshot = 0
+        self._checkpoint_due = False
+
+    def _apply(self, rec):
+        """Apply one WAL record to local state (no re-logging)."""
+        op = rec.get("op")
+        if op == "admit":
+            e = JournalEntry.from_record(rec)
+            self.entries[e.rid] = e
+            self._auto_rid = max(self._auto_rid,
+                                 int(rec.get("auto_rid", 0)))
+            return
+        e = self.entries.get(rec.get("rid"))
+        if e is None:
+            return                       # rotated out: stale terminal rid
+        if op == "dispatch":
+            e.state = ROUTED
+            e.replica = rec["replica"]
+            e.replica_inc = int(rec.get("inc", 0))
+            e.replica_history.append(rec["replica"])
+            e.attempts = int(rec.get("attempts", e.attempts))
+        elif op == "token":
+            e.emitted.append(int(rec["t"]))
+        elif op == "handoff":
+            e.state = HANDOFF
+            e.replica = None
+            # strip the sink's epoch wrap: the stored packet must be
+            # bit-identical to what the writer journaled
+            self.pending_packets[e.rid] = {k: v for k, v in rec.items()
+                                           if k != "e"}
+        elif op == "requeue":
+            e.state = QUEUED
+            e.replica = None
+            e.attempts = int(rec.get("attempts", e.attempts))
+            e.replays = int(rec.get("replays", e.replays))
+            e.error = rec.get("error", e.error)
+            self.pending_packets.pop(e.rid, None)
+        elif op == "cancel":
+            e.cancel_requested = True
+        elif op == "finalize":
+            e.state = rec["state"]
+            if rec.get("error") is not None:
+                e.error = rec["error"]
+            e.handle = None
+            e.replica = None
+            self.pending_packets.pop(e.rid, None)
+            self._terminal_count += 1
+            self._rotate()
+
+    # ------------------------------------------------- mutation API
     def admit(self, prompt, max_new_tokens, eos_token_id=None,
               on_token=None, deadline_s=None, rid=None, sampling=None,
               seed=None, grammar=None):
@@ -138,14 +334,21 @@ class RequestJournal:
         entry = JournalEntry(rid, prompt, max_new_tokens, eos_token_id,
                              on_token, deadline_s, sampling=sampling,
                              seed=seed, grammar=grammar)
+        self._wal(dict(entry.to_record(), op="admit",
+                       auto_rid=self._auto_rid))
         self.entries[rid] = entry
         return entry, True
 
     def token(self, entry, tok):
         """The ONLY path tokens take to the client.  Terminal entries
         swallow stragglers (exactly-once output); live entries append
-        and forward."""
+        and forward — after the WAL accepts the record.  A fenced
+        append means a newer router owns this stream: the token is
+        dropped here, never delivered twice."""
         if entry.state in TERMINAL:
+            return
+        if not self._wal({"op": "token", "rid": entry.rid,
+                          "t": int(tok)}):
             return
         entry.emitted.append(int(tok))
         entry.t_last = time.monotonic()
@@ -154,15 +357,70 @@ class RequestJournal:
         if entry.on_token is not None:
             entry.on_token(entry, int(tok))
 
+    def dispatch(self, entry, replica_id, incarnation=0):
+        """Record that ``replica_id`` (at ``incarnation``) now owns the
+        entry."""
+        if not self._wal({"op": "dispatch", "rid": entry.rid,
+                          "replica": replica_id, "inc": int(incarnation),
+                          "attempts": entry.attempts}):
+            return
+        entry.state = ROUTED
+        entry.replica = replica_id
+        entry.replica_inc = int(incarnation)
+        entry.replica_history.append(replica_id)
+        self.pending_packets.pop(entry.rid, None)
+
+    def handoff(self, entry, group, prompt, pages, length, first_tok):
+        """Record a prefill->decode handoff packet awaiting dispatch.
+        ``pages`` are plain page ids — the pool object is resolved by
+        whoever (re)drives the packet."""
+        rec = {"op": "handoff", "rid": entry.rid, "group": group,
+               "prompt": [int(t) for t in prompt],
+               "pages": [int(p) for p in pages], "length": int(length),
+               "first_tok": int(first_tok)}
+        if not self._wal(rec):
+            return
+        entry.state = HANDOFF
+        entry.replica = None
+        self.pending_packets[entry.rid] = rec
+
+    def requeue(self, entry, error=None):
+        """Return the entry to the routable queue (failover replay,
+        handoff degrade, backpressure backoff).  Counters are journaled
+        at their CURRENT values — bump ``attempts``/``replays`` before
+        calling."""
+        if error is not None:
+            entry.error = error
+        if not self._wal({"op": "requeue", "rid": entry.rid,
+                          "attempts": entry.attempts,
+                          "replays": entry.replays,
+                          "error": entry.error}):
+            return
+        entry.state = QUEUED
+        entry.replica = None
+        self.pending_packets.pop(entry.rid, None)
+
+    def mark_cancel(self, entry):
+        if entry.cancel_requested or entry.state in TERMINAL:
+            return
+        if not self._wal({"op": "cancel", "rid": entry.rid}):
+            return
+        entry.cancel_requested = True
+
     def finalize(self, entry, state, error=None):
+        if not self._wal({"op": "finalize", "rid": entry.rid,
+                          "state": state, "error": error}):
+            return
         entry.state = state
         if error is not None:
             entry.error = error
         entry.handle = None
         entry.replica = None
+        self.pending_packets.pop(entry.rid, None)
         self._terminal_count += 1
         self._rotate()
 
+    # ----------------------------------------------------- queries
     def _rotate(self):
         """Drop the oldest terminal entries past the retention bound.
         Live entries are replay state and never rotate."""
@@ -187,12 +445,47 @@ class RequestJournal:
             out[e.state] = out.get(e.state, 0) + 1
         return out
 
+    def audit(self):
+        """Invariant sweep; returns a list of violations (empty =
+        clean).  The chaos/flap tests pin this stays empty under
+        failover, revival, and router takeover."""
+        problems = []
+        for e in self.entries.values():
+            if len(e.emitted) > e.max_new_tokens:
+                problems.append(f"{e.rid}: emitted {len(e.emitted)} > "
+                                f"budget {e.max_new_tokens}")
+            if e.state in TERMINAL and e.replica is not None:
+                problems.append(f"{e.rid}: terminal but owned by "
+                                f"{e.replica}")
+            if e.state in TERMINAL and e.handle is not None:
+                problems.append(f"{e.rid}: terminal with live handle")
+            if e.state == ROUTED and e.replica is None:
+                problems.append(f"{e.rid}: routed with no owner")
+        owners = {}
+        for e in self.entries.values():
+            if e.state == ROUTED:
+                owners.setdefault((e.replica, e.rid), 0)
+                owners[(e.replica, e.rid)] += 1
+        for (rep, rid), n in owners.items():
+            if n > 1:
+                problems.append(f"{rid}: adopted {n}x by {rep}")
+        return problems
+
     def dump(self, path):
         """CI artifact / post-mortem: every entry's snapshot plus the
-        state histogram."""
-        with open(path, "w") as f:
-            json.dump({"counts": self.counts(),
-                       "entries": [e.snapshot()
-                                   for e in self.entries.values()]},
-                      f, indent=2)
+        state histogram and the WAL position.  Crash-safe: written to
+        ``<path>.tmp`` then renamed, the checkpoint engine's atomicity
+        rule."""
+        payload = {"counts": self.counts(),
+                   "epoch": self.epoch,
+                   "wal_position": None if self.wal is None else
+                                   self.wal.position(),
+                   "entries": [e.snapshot()
+                               for e in self.entries.values()]}
+        tmp = str(path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

@@ -43,6 +43,35 @@ class ReplicaKilled(RuntimeError):
     """A replica crashed, was killed, or stopped heartbeating."""
 
 
+class StaleEpoch(RuntimeError):
+    """A replica-facing call carried an epoch older than the replica's
+    fence — the CALLER is a deposed (zombie) router, not the replica.
+    Raised instead of doing the work; the zombie must stop dispatching.
+    Deliberately NOT a :class:`ReplicaKilled`: the replica is fine."""
+
+
+def _fence_check(rep, epoch):
+    """Shared epoch gate: ``None`` means the caller is not running
+    under HA (legacy single-router path — no fencing).  A newer epoch
+    advances the fence (the first dispatch from a new primary fences
+    everything older); a stale one raises."""
+    if epoch is None:
+        return
+    epoch = int(epoch)
+    if epoch < rep.fence_epoch:
+        rep.fenced_calls += 1
+        sched = getattr(rep, "sched", None)
+        if sched is not None:
+            sched.ha_fenced += 1
+        raise StaleEpoch(
+            f"{rep.id}: epoch {epoch} < fence {rep.fence_epoch}")
+    if epoch > rep.fence_epoch:
+        rep.fence_epoch = epoch
+        sched = getattr(rep, "sched", None)
+        if sched is not None:
+            sched.ha_epoch = epoch
+
+
 class LocalReplica:
     """An in-process ServingScheduler behind the replica interface."""
 
@@ -57,6 +86,12 @@ class LocalReplica:
         self.death_reason = None
         self.missed_beats = 0
         self.restarts = 0
+        self.incarnation = 0       # bumped per restart: entries + token
+                                   # sinks record (replica, incarnation)
+                                   # so a flapping/revived replica can't
+                                   # be double-adopted or double-emit
+        self.fence_epoch = 0       # highest router epoch seen (HA)
+        self.fenced_calls = 0      # stale-epoch calls rejected
         self.last_health = None
         self._handoff_sink = None
         # per-replica span tracer (serving/trace.py), owned by the
@@ -101,29 +136,51 @@ class LocalReplica:
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0):
+               sample_offset=0, epoch=None):
+        _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
-        return self.sched.submit(prompt, max_new_tokens,
-                                 eos_token_id=eos_token_id,
-                                 on_token=on_token, deadline_s=deadline_s,
-                                 handoff=handoff, trace_ctx=trace_ctx,
-                                 sampling=sampling, seed=seed,
-                                 grammar=grammar,
-                                 sample_offset=sample_offset)
+        req = self.sched.submit(prompt, max_new_tokens,
+                                eos_token_id=eos_token_id,
+                                on_token=on_token, deadline_s=deadline_s,
+                                handoff=handoff, trace_ctx=trace_ctx,
+                                sampling=sampling, seed=seed,
+                                grammar=grammar,
+                                sample_offset=sample_offset)
+        req._fence_epoch = epoch
+        return req
 
     def attach(self, prompt, pages, length, first_tok, *, max_new_tokens,
                eos_token_id=None, deadline_s=None, on_token=None,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0):
+               sample_offset=0, epoch=None):
+        _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
-        return self.sched.attach_handoff(
+        req = self.sched.attach_handoff(
             prompt, pages, length, first_tok,
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
             on_token=on_token, deadline_s=deadline_s,
             trace_ctx=trace_ctx, sampling=sampling, seed=seed,
             grammar=grammar, sample_offset=sample_offset)
+        req._fence_epoch = epoch
+        return req
+
+    def fence(self, epoch):
+        """Takeover hygiene: raise the fence so stale-epoch calls are
+        rejected, and cancel any in-flight request dispatched under an
+        older epoch (its tokens belong to a deposed router's sinks,
+        which drop them — cancelling reclaims the slots/pages)."""
+        epoch = int(epoch)
+        self.fence_epoch = max(self.fence_epoch, epoch)
+        if self.sched is None:
+            return
+        self.sched.ha_epoch = self.fence_epoch
+        for req in list(self.sched.requests.values()):
+            tag = getattr(req, "_fence_epoch", None)
+            if tag is None or tag < epoch:
+                req.cancel()
+                self.sched.ha_fenced += 1
 
     def set_handoff_sink(self, cb):
         """Router wiring for prefill workers: where finished-prompt KV
@@ -165,7 +222,7 @@ class LocalReplica:
             bool(s._pending_attach) or \
             any(r is not None for r in s.slot_req)
 
-    def step(self, step_idx):
+    def step(self, step_idx, epoch=None):
         """One scheduler iteration.  The ``cluster.replica_kill`` fault
         point fires first — an armed raise here IS the crash: the
         scheduler is dropped wholesale and :class:`ReplicaKilled`
@@ -176,6 +233,7 @@ class LocalReplica:
         dies, never the tier."""
         if self.state == DEAD:
             return False
+        _fence_check(self, epoch)
         try:
             faults.fire("cluster.replica_kill", step=step_idx,
                         replica=self.id)
@@ -191,9 +249,10 @@ class LocalReplica:
                      f"{type(e).__name__}: {e}")
             raise ReplicaKilled(self.death_reason) from e
 
-    def heartbeat(self):
+    def heartbeat(self, epoch=None):
         """Health snapshot, or :class:`ReplicaKilled` — the router's
         death-detection signal."""
+        _fence_check(self, epoch)
         if self.state == DEAD:
             raise ReplicaKilled(f"{self.id} dead: {self.death_reason}")
         self.last_health = self.sched.health()
@@ -272,10 +331,13 @@ class LocalReplica:
                 self.sched.compile_watchdog is not None:
             self.sched.compile_watchdog.flight_recorder = \
                 self._comm_flight
+        if self.fence_epoch:
+            self.sched.ha_epoch = self.fence_epoch
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
         self.restarts += 1
+        self.incarnation += 1
 
 
 class _RemoteHandle:
@@ -322,6 +384,9 @@ class ProcessReplica:
         self.death_reason = None
         self.missed_beats = 0
         self.restarts = 0
+        self.incarnation = 0
+        self.fence_epoch = 0
+        self.fenced_calls = 0
         self.last_health = None
         self.term_grace_s = float(term_grace_s)
         self.hb_timeout_s = float(hb_timeout_s)
@@ -470,9 +535,10 @@ class ProcessReplica:
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0):
+               sample_offset=0, epoch=None):
         if handoff:
             raise ValueError("process replicas serve unified only")
+        _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
         rid = f"w{self._next_rid}"
@@ -494,6 +560,12 @@ class ProcessReplica:
             op["grammar"] = dict(grammar)
         if sample_offset:
             op["sample_offset"] = int(sample_offset)
+        if epoch is not None:
+            # the epoch rides the wire too: even if a zombie router
+            # slips past the in-process fence (it cannot here, but a
+            # network transport could reorder), the WORKER rejects the
+            # stale dispatch — defense in depth at the protocol layer
+            op["epoch"] = int(epoch)
         if trace_ctx is not None:
             # the trace id crosses the process boundary with the
             # request, so worker-side spans carry the journal rid
@@ -519,9 +591,20 @@ class ProcessReplica:
         worker."""
         return False
 
-    def step(self, step_idx):
+    def fence(self, epoch):
+        """Raise the local fence AND ship it to the worker, which
+        cancels in-flight requests dispatched under older epochs."""
+        epoch = int(epoch)
+        self.fence_epoch = max(self.fence_epoch, epoch)
+        try:
+            self._send({"op": "fence", "epoch": self.fence_epoch})
+        except Exception:
+            pass   # dying worker: heartbeats will declare the death
+
+    def step(self, step_idx, epoch=None):
         if self.state == DEAD:
             return False
+        _fence_check(self, epoch)
         try:
             faults.fire("cluster.replica_kill", step=step_idx,
                         replica=self.id)
@@ -532,7 +615,8 @@ class ProcessReplica:
         self._pump_events()
         return bool(self._handles)
 
-    def heartbeat(self):
+    def heartbeat(self, epoch=None):
+        _fence_check(self, epoch)
         if self.state == DEAD:
             raise ReplicaKilled(f"{self.id} dead: {self.death_reason}")
         self._pump_events()
@@ -598,7 +682,10 @@ class ProcessReplica:
         self._handles.clear()
         self._spawn()
         self.wait_ready()
+        if self.fence_epoch:
+            self.fence(self.fence_epoch)
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
         self.restarts += 1
+        self.incarnation += 1

@@ -45,7 +45,8 @@ from deepspeed_tpu.serving.cluster import journal as jn
 from deepspeed_tpu.serving.cluster.journal import RequestJournal
 from deepspeed_tpu.serving.cluster.replica import (DEAD, DRAINING, UP,
                                                    LocalReplica,
-                                                   ReplicaKilled)
+                                                   ReplicaKilled,
+                                                   StaleEpoch)
 from deepspeed_tpu.serving.metrics import ClusterMetrics
 from deepspeed_tpu.serving.page_manager import PagePool
 from deepspeed_tpu.serving.scheduler import ServingScheduler, _PoolsRef
@@ -90,7 +91,8 @@ class ClusterRouter:
     def __init__(self, replicas, *, routing="prefix", retry_max=3,
                  retry_backoff_s=0.02, heartbeat_misses=3, monitor=None,
                  seed=0, term_grace_s=10.0, tracer=None,
-                 flight_recorder=None):
+                 flight_recorder=None, journal=None, wal=None,
+                 epoch=None, lease=None):
         if routing not in ("prefix", "round_robin"):
             raise ValueError(f"unknown routing policy {routing!r}")
         self.replicas = list(replicas)
@@ -99,7 +101,21 @@ class ClusterRouter:
         self.retry_backoff_s = float(retry_backoff_s)
         self.heartbeat_misses = int(heartbeat_misses)
         self.term_grace_s = float(term_grace_s)
-        self.journal = RequestJournal()
+        # Router HA (cluster/ha.py): `epoch` tags every replica-facing
+        # call and every WAL append; `lease` is the shared authority a
+        # RouterSupervisor moves between primaries.  Both None = the
+        # legacy single-router mode, fencing entirely off.
+        self.epoch = epoch
+        self.lease = lease
+        self.fenced_dispatches = 0   # replica-side StaleEpoch rejections
+        self.fenced_tokens = 0       # sink-side stale-epoch token drops
+        self.stale_sink_tokens = 0   # ownership-fence drops (flapping)
+        if journal is not None:
+            self.journal = journal
+        else:
+            self.journal = RequestJournal(wal=wal,
+                                          epoch=0 if epoch is None
+                                          else int(epoch))
         self.metrics = ClusterMetrics(monitor)
         self.step_idx = 0
         self._rr = 0
@@ -174,7 +190,7 @@ class ClusterRouter:
         entry = self.journal.entries.get(rid)
         if entry is None or entry.state in jn.TERMINAL:
             return False
-        entry.cancel_requested = True
+        self.journal.mark_cancel(entry)
         if entry.state == jn.QUEUED:
             self._finalize(entry, jn.CANCELLED, "cancelled in queue")
         elif entry.state == jn.ROUTED and entry.handle is not None:
@@ -185,8 +201,18 @@ class ClusterRouter:
     # ------------------------------------------------------------- pump
     def step(self):
         """One router pump; returns True while any journaled work is
-        live."""
+        live.  The ``cluster.router_kill`` fault point fires first — an
+        armed raise here IS the router's death, propagating to the
+        RouterSupervisor (or the caller) exactly as a process crash
+        would: nothing after the raise runs, the WAL holds everything
+        acknowledged so far."""
         self.step_idx += 1
+        faults.fire("cluster.router_kill", step=self.step_idx)
+        if self.lease is not None:
+            # a renewal that fails (expired, or a newer epoch holds the
+            # lease) means this router is deposed; keep pumping — every
+            # write is fenced — but the supervisor will notice
+            self.lease.renew(self.epoch)
         now = time.monotonic()
         self._check_replicas()
         self._dispatch_handoffs(now)
@@ -195,7 +221,10 @@ class ClusterRouter:
             if rep.state == DEAD:
                 continue
             try:
-                rep.step(self.step_idx)
+                rep.step(self.step_idx, epoch=self.epoch)
+            except StaleEpoch:
+                # WE are the zombie, not the replica: never a failover
+                self.fenced_dispatches += 1
             except ReplicaKilled:
                 self._on_death(rep)
             except Exception:   # an uncontained replica error is a death
@@ -226,8 +255,13 @@ class ClusterRouter:
                     self._on_death(rep)
                 continue
             try:
-                rep.heartbeat()
+                rep.heartbeat(epoch=self.epoch)
                 rep.missed_beats = 0
+            except StaleEpoch:
+                # a deposed router's heartbeat is not a replica problem:
+                # counting it as a miss would let a zombie KILL a healthy
+                # replica the new primary is serving through
+                self.fenced_dispatches += 1
             except Exception:
                 rep.missed_beats += 1
                 self.metrics.heartbeat_misses += 1
@@ -244,8 +278,12 @@ class ClusterRouter:
                 "missed heartbeats")
         self.metrics.failovers += 1
         self.metrics.event(self.step_idx, "failover")
+        # incarnation-matched: entries routed to a LATER incarnation of
+        # this id (revived replica, flap race) are NOT stranded — a
+        # stale death signal must never re-adopt live work
         stranded = [e for e in self.journal.live()
-                    if e.state == jn.ROUTED and e.replica == rep.id]
+                    if e.state == jn.ROUTED and e.replica == rep.id and
+                    e.replica_inc == getattr(rep, "incarnation", 0)]
         if self.tracer is not None:
             self.tracer.instant(
                 "replica_death", cat="failover", process=str(rep.id),
@@ -276,9 +314,16 @@ class ClusterRouter:
         if entry.finished_by_emitted():
             self._finalize(entry, jn.FINISHED)
             return
-        entry.state = jn.QUEUED
+        if entry.cancel_requested:
+            # cancel raced the failover: the client asked out before the
+            # death — resurrecting the request onto a survivor would
+            # serve work nobody wants; terminal idempotently instead
+            self._finalize(entry, jn.CANCELLED,
+                           "cancelled during failover replay")
+            return
         entry.replays += 1
         entry.next_try = 0.0
+        self.journal.requeue(entry)
         self.metrics.replays += 1
         self.metrics.replayed_tokens += len(entry.emitted)
         self.metrics.event(self.step_idx, "replay")
@@ -339,6 +384,7 @@ class ClusterRouter:
                            f"cluster capacity: {self.retry_max} "
                            f"admission retries exhausted ({reason})")
             return
+        self.journal.requeue(entry)
         # exponential backoff with jitter: synchronized retry bursts
         # are how one full replica becomes every replica's problem
         delay = self.retry_backoff_s * (2 ** (entry.attempts - 1))
@@ -372,7 +418,7 @@ class ClusterRouter:
                     prompt, entry.remaining_new,
                     eos_token_id=entry.eos_token_id,
                     deadline_s=deadline_s,
-                    on_token=self._make_token_sink(entry),
+                    on_token=self._make_token_sink(entry, rep),
                     handoff=handoff,
                     trace_ctx=None if self.tracer is None else
                     {"trace_id": entry.rid, "attempt": entry.replays},
@@ -382,7 +428,13 @@ class ClusterRouter:
                     # prompt suffix to replay through the grammar cursor
                     sampling=entry.sampling, seed=entry.seed,
                     grammar=entry.grammar,
-                    sample_offset=len(entry.emitted))
+                    sample_offset=len(entry.emitted), epoch=self.epoch)
+            except StaleEpoch:
+                # this router is deposed: the replica refused the
+                # dispatch.  Leave the entry alone — the NEW primary's
+                # journal owns it now; ours is a fenced shadow.
+                self.fenced_dispatches += 1
+                return
             except ReplicaKilled:
                 continue    # heartbeat pass will handle the body
             except ValueError as e:
@@ -396,9 +448,8 @@ class ClusterRouter:
             except Exception as e:   # QueueFull et al: backpressure
                 self._backoff(entry, now, f"{type(e).__name__}")
                 continue
-            entry.state = jn.ROUTED
-            entry.replica = rep.id
-            entry.replica_history.append(rep.id)
+            self.journal.dispatch(entry, rep.id,
+                                  getattr(rep, "incarnation", 0))
             entry.handle = handle
             self._by_handle[id(handle)] = entry
             self.metrics.routed += 1
@@ -417,10 +468,29 @@ class ClusterRouter:
                           "replays": entry.replays,
                           "handoff": handoff})
 
-    def _make_token_sink(self, entry):
-        journal = self.journal
+    def _make_token_sink(self, entry, rep):
+        """Token path with two fences in front of the journal:
+
+        * **ownership** — the sink is minted for (replica, incarnation)
+          at dispatch time; once the entry is replayed elsewhere (or
+          the replica restarts) the pair no longer matches and a late
+          token from the old stream is dropped — a flapping replica
+          cannot double-emit;
+        * **epoch** — under HA, a sink minted by a deposed router drops
+          tokens once the lease moved on (fast path; the WAL append
+          inside ``journal.token`` is the authority and would fence it
+          regardless).
+        """
+        journal, lease, epoch = self.journal, self.lease, self.epoch
+        owner = (rep.id, getattr(rep, "incarnation", 0))
 
         def sink(_req, tok):
+            if lease is not None and lease.current_epoch != epoch:
+                self.fenced_tokens += 1
+                return
+            if (entry.replica, entry.replica_inc) != owner:
+                self.stale_sink_tokens += 1
+                return
             journal.token(entry, tok)
         return sink
 
@@ -431,9 +501,10 @@ class ClusterRouter:
             if entry is None:   # not a routed request (defensive)
                 rep.sched.kv.pool.free(pages)
                 return
-            entry.state = jn.HANDOFF
-            entry.replica = None
             entry.handle = None
+            self.journal.handoff(entry, rep.group.name,
+                                 list(req.orig_prompt), pages, length,
+                                 first_tok)
             self._packets.append(
                 _Packet(entry, rep.group, list(req.orig_prompt), pages,
                         length, first_tok, rep.sched.kv.pool))
@@ -445,6 +516,14 @@ class ClusterRouter:
         worker, attach refusal — frees the pages and requeues the
         request for unified serving: a handoff can be retried or
         degraded, never lost."""
+        if self.lease is not None and \
+                self.lease.current_epoch != self.epoch:
+            # deposed: the packets (and their POOL PAGES) belong to the
+            # new primary's re-driven copies — freeing or attaching them
+            # here would corrupt shared state the fence exists to protect
+            self.fenced_dispatches += len(self._packets)
+            self._packets.clear()
+            return
         for _ in range(len(self._packets)):
             pkt = self._packets.popleft()
             entry = pkt.entry
@@ -482,7 +561,7 @@ class ClusterRouter:
                     eos_token_id=entry.eos_token_id,
                     deadline_s=None if entry.deadline_abs is None
                     else max(0.001, entry.deadline_abs - now),
-                    on_token=self._make_token_sink(entry),
+                    on_token=self._make_token_sink(entry, rep),
                     trace_ctx=None if self.tracer is None else
                     {"trace_id": entry.rid, "attempt": entry.replays},
                     # the boundary token (already journal-emitted) rides
@@ -492,14 +571,17 @@ class ClusterRouter:
                     # across the handoff
                     sampling=entry.sampling, seed=entry.seed,
                     grammar=entry.grammar,
-                    sample_offset=max(0, len(entry.emitted) - 1))
+                    sample_offset=max(0, len(entry.emitted) - 1),
+                    epoch=self.epoch)
+            except StaleEpoch:
+                self.fenced_dispatches += 1
+                return             # deposed: pages belong to the heir
             except Exception:
                 pkt.pool.free(pkt.pages)
                 self._requeue_unified(entry, "attach failed")
                 continue
-            entry.state = jn.ROUTED
-            entry.replica = rep.id
-            entry.replica_history.append(rep.id)
+            self.journal.dispatch(entry, rep.id,
+                                  getattr(rep, "incarnation", 0))
             entry.handle = handle
             self._by_handle[id(handle)] = entry
             self.metrics.handoffs += 1
@@ -509,9 +591,10 @@ class ClusterRouter:
         if entry.finished_by_emitted():
             self._finalize(entry, jn.FINISHED)
             return
-        entry.state = jn.QUEUED
         entry.next_try = 0.0
-        entry.error = reason   # transient note; cleared on finish
+        # `reason` rides entry.error as a transient note (cleared on
+        # finish) and lands in the WAL requeue record
+        self.journal.requeue(entry, error=reason)
         self.metrics.event(self.step_idx, "handoff_degrade")
 
     # ---------------------------------------------------------- collect
@@ -543,7 +626,6 @@ class ClusterRouter:
                     if entry.finished_by_emitted():
                         self._finalize(entry, jn.FINISHED)
                     else:
-                        entry.state = jn.QUEUED
                         self._backoff(entry, now, f"replica shed: {err}")
 
     def _finalize(self, entry, state, error=None):
@@ -603,7 +685,17 @@ class ClusterRouter:
 
     def restart_replica(self, rep, term_grace_s=None):
         """Post-death recovery: bring a dead replica back with a fresh
-        scheduler/process and rejoin it to the routing pool."""
+        scheduler/process and rejoin it to the routing pool.  Calling
+        this on a replica that is NOT dead (operator restart, flap
+        recovery) first replays its in-flight entries — the fresh
+        scheduler won't know them, and stranding them in ROUTED would
+        hang the journal forever."""
+        if rep.state != DEAD:
+            inc = getattr(rep, "incarnation", 0)
+            for entry in [e for e in self.journal.live()
+                          if e.state == jn.ROUTED and
+                          e.replica == rep.id and e.replica_inc == inc]:
+                self._replay(entry, dead_replica=rep.id)
         rep.restart(term_grace_s=self.term_grace_s if term_grace_s is None
                     else term_grace_s)
         rep._death_handled = False
@@ -819,6 +911,13 @@ class ClusterRouter:
             "aggregate_comm_bytes_per_step":
                 comm_bytes if comm_known else None,
             "aggregate_steady_recompiles": steady_recompiles,
+            "epoch": self.epoch,
+            "fenced_dispatches": self.fenced_dispatches,
+            "fenced_tokens": self.fenced_tokens,
+            "stale_sink_tokens": self.stale_sink_tokens,
+            "wal_records": self.journal.wal_records,
+            "wal_position": None if self.journal.wal is None
+            else self.journal.wal.position(),
             **self.metrics.summary(),
         }
 

@@ -1,0 +1,294 @@
+"""Journal write-ahead log: pluggable, epoch-fenced record sinks.
+
+The :class:`~deepspeed_tpu.serving.cluster.journal.RequestJournal` is
+the cluster tier's source of truth for exactly-once client output.  To
+make the *router* replaceable, every journal mutation is first written
+to a WAL sink as one JSON record; a standby that tails the stream can
+rebuild the journal bit-identically (``RequestJournal.replay``) and
+take over mid-flight.
+
+Two sinks share one contract:
+
+* :class:`MemoryWalSink` — an in-process record list, the test double
+  and the default for ``RouterSupervisor`` (primary and standby live
+  in one process, so the "stream" is just shared memory);
+* :class:`FileWalSink` — crash-safe JSONL segments on disk.  Records
+  append to ``wal-NNNNNN.jsonl`` (flushed per record, fsync'd on
+  rotation/close, or per record with ``fsync_records=True``);
+  snapshots write ``snapshot-NNNNNN.json`` via tmp+rename (the same
+  atomicity rule the checkpoint engine pins) and rotate the live
+  segment, so recovery is *newest valid snapshot + the segments at or
+  after it*.  A torn tail (the classic half-written last line of a
+  crash) is detected and ignored, never parsed into garbage.
+
+**Epoch fencing.**  Every append carries the writer's epoch.  A sink
+remembers the highest epoch it has ever seen and *drops* (returns
+``False`` for) any append from a lower one, counting it in
+``fenced_writes``.  The WAL is therefore the authority that makes
+exactly-once output survive a zombie primary: a deposed router's
+``journal.token`` hits the fence and the mutation — including client
+delivery — never happens.
+"""
+
+import json
+import os
+
+__all__ = ["MemoryWalSink", "FileWalSink"]
+
+
+class _WalSinkBase:
+    """Shared epoch-fence + counters; subclasses store the bytes."""
+
+    def __init__(self):
+        self.max_epoch = 0         # highest writer epoch ever accepted
+        self.fenced_writes = 0     # stale-epoch appends dropped
+        self.records_appended = 0  # accepted appends (lifetime)
+        self.snapshots_taken = 0
+
+    def _admit(self, epoch):
+        epoch = int(epoch)
+        if epoch < self.max_epoch:
+            self.fenced_writes += 1
+            return False
+        self.max_epoch = epoch
+        return True
+
+    # -- subclass surface ------------------------------------------
+    def append(self, record, epoch=0):
+        """Append one journal record.  Returns True when accepted,
+        False when fenced (the caller must NOT apply the mutation)."""
+        raise NotImplementedError
+
+    def snapshot(self, state, epoch=0):
+        """Write a compaction point; records before it are no longer
+        needed for recovery.  Fenced like append."""
+        raise NotImplementedError
+
+    def replay_stream(self):
+        """``(snapshot_state_or_None, records_after_snapshot)`` — the
+        minimal recovery input for ``RequestJournal.replay``."""
+        raise NotImplementedError
+
+    def position(self):
+        """Durable cursor for dump headers: segment + in-segment
+        offset + lifetime record count."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MemoryWalSink(_WalSinkBase):
+    """In-process WAL: a snapshot slot plus the records after it."""
+
+    def __init__(self):
+        super().__init__()
+        self._snapshot = None
+        self._records = []
+        self._segment = 0          # bumped per snapshot, mirrors file
+
+    def append(self, record, epoch=0):
+        if not self._admit(epoch):
+            return False
+        self._records.append(dict(record, e=int(epoch)))
+        self.records_appended += 1
+        return True
+
+    def snapshot(self, state, epoch=0):
+        if not self._admit(epoch):
+            return False
+        self._snapshot = json.loads(json.dumps(state))  # deep, json-clean
+        self._records = []
+        self._segment += 1
+        self.snapshots_taken += 1
+        return True
+
+    def replay_stream(self):
+        return self._snapshot, list(self._records)
+
+    def position(self):
+        return {"segment": self._segment, "offset": len(self._records),
+                "records": self.records_appended}
+
+
+class FileWalSink(_WalSinkBase):
+    """Crash-safe JSONL WAL under one directory.
+
+    Layout::
+
+        wal-000000.jsonl            # records, oldest segment
+        snapshot-000001.json        # state as of segment boundary 1
+        wal-000001.jsonl            # records after that snapshot
+
+    Recovery: load the newest parseable ``snapshot-N.json``, then apply
+    ``wal-M.jsonl`` for every M >= N in order, stopping a segment at
+    the first torn (unparseable) line.  Old segments/snapshots are
+    pruned opportunistically after each snapshot.
+    """
+
+    def __init__(self, root, fsync_records=False, keep_segments=2):
+        super().__init__()
+        self.root = str(root)
+        self.fsync_records = bool(fsync_records)
+        self.keep_segments = max(1, int(keep_segments))
+        self.torn_records = 0
+        os.makedirs(self.root, exist_ok=True)
+        segs = self._segments()
+        snap = self._latest_snapshot_idx()
+        self._seg_idx = max(segs[-1] if segs else 0, snap)
+        self._seg_off = 0
+        self._fh = None
+        # resume appending after any valid tail of the live segment
+        if os.path.exists(self._seg_path(self._seg_idx)):
+            good, _ = self._read_segment(self._seg_idx)
+            self._seg_off = len(good)
+
+    # ------------------------------------------------------- naming
+    def _seg_path(self, idx):
+        return os.path.join(self.root, f"wal-{idx:06d}.jsonl")
+
+    def _snap_path(self, idx):
+        return os.path.join(self.root, f"snapshot-{idx:06d}.json")
+
+    def _segments(self):
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("wal-") and name.endswith(".jsonl"):
+                try:
+                    out.append(int(name[4:-6]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _latest_snapshot_idx(self):
+        best = 0
+        for name in os.listdir(self.root):
+            if name.startswith("snapshot-") and name.endswith(".json"):
+                try:
+                    idx = int(name[9:-5])
+                except ValueError:
+                    continue
+                try:
+                    with open(os.path.join(self.root, name)) as f:
+                        json.load(f)
+                except (OSError, ValueError):
+                    continue            # torn snapshot: ignore
+                best = max(best, idx)
+        return best
+
+    # ------------------------------------------------------ writing
+    def _handle(self):
+        if self._fh is None:
+            self._fh = open(self._seg_path(self._seg_idx), "a")
+        return self._fh
+
+    def append(self, record, epoch=0):
+        if not self._admit(epoch):
+            return False
+        fh = self._handle()
+        fh.write(json.dumps(dict(record, e=int(epoch)),
+                            separators=(",", ":")) + "\n")
+        fh.flush()
+        if self.fsync_records:
+            os.fsync(fh.fileno())
+        self._seg_off += 1
+        self.records_appended += 1
+        return True
+
+    def snapshot(self, state, epoch=0):
+        if not self._admit(epoch):
+            return False
+        nxt = self._seg_idx + 1
+        tmp = self._snap_path(nxt) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path(nxt))
+        # seal the old segment durably, then rotate
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
+        self._seg_idx = nxt
+        self._seg_off = 0
+        self.snapshots_taken += 1
+        self._fsync_dir()
+        self._prune()
+        return True
+
+    def _fsync_dir(self):
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass                       # not supported everywhere
+
+    def _prune(self):
+        """Drop segments/snapshots no recovery path can need."""
+        floor = self._seg_idx - self.keep_segments
+        for idx in self._segments():
+            if idx < floor:
+                try:
+                    os.remove(self._seg_path(idx))
+                except OSError:
+                    pass
+        for name in list(os.listdir(self.root)):
+            if name.startswith("snapshot-") and name.endswith(".json"):
+                try:
+                    if int(name[9:-5]) < self._seg_idx:
+                        os.remove(os.path.join(self.root, name))
+                except (ValueError, OSError):
+                    pass
+
+    # ------------------------------------------------------ reading
+    def _read_segment(self, idx):
+        """(records, torn) — stops at the first unparseable line; a
+        torn record makes everything after it unreachable (the crash-
+        consistency rule: never apply past a hole)."""
+        path = self._seg_path(idx)
+        records, torn = [], 0
+        if not os.path.exists(path):
+            return records, torn
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    torn = 1
+                    break
+        return records, torn
+
+    def replay_stream(self):
+        snap_idx = self._latest_snapshot_idx()
+        snapshot = None
+        if os.path.exists(self._snap_path(snap_idx)):
+            with open(self._snap_path(snap_idx)) as f:
+                snapshot = json.load(f)
+        records = []
+        self.torn_records = 0
+        for idx in [i for i in self._segments() if i >= snap_idx]:
+            recs, torn = self._read_segment(idx)
+            records.extend(recs)
+            self.torn_records += torn
+            if torn:
+                break                  # nothing after a hole is safe
+        return snapshot, records
+
+    def position(self):
+        return {"segment": self._seg_idx, "offset": self._seg_off,
+                "records": self.records_appended}
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None

@@ -10,6 +10,15 @@ stdin ops (one JSON object per line):
   {"op": "cancel", "rid": ...}
   {"op": "drain"}            # stop admitting, finish in-flight
   {"op": "trace"}            # enable span tracing at runtime
+  {"op": "fence", "epoch": N}  # router-HA fence: reject ops carrying a
+                               # lower epoch, cancel in-flight requests
+                               # dispatched under one (their tokens
+                               # belong to a deposed router)
+
+Ops may carry "epoch": N (router-HA).  A submit whose epoch is below
+the worker's fence is REJECTED on the wire with a "fenced" done event
+— the in-process check in ProcessReplica is the fast path, this is the
+authority a reordering transport cannot bypass.
 
 stdout events (one JSON object per line, flushed immediately — a token
 the router never read is a token the router will replay, so buffering
@@ -147,6 +156,7 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, lambda *a: term.update(flag=True))
 
     live = {}          # wire rid -> scheduler Request
+    fence = {"epoch": 0}   # highest router epoch seen on the wire
     eof = False
     last_hb = 0.0
     _emit({"ev": "ready"})
@@ -191,7 +201,20 @@ def main(argv=None):
                 continue
             op = json.loads(line)
             kind = op.get("op")
+            op_epoch = op.get("epoch")
+            if op_epoch is not None and op_epoch > fence["epoch"]:
+                fence["epoch"] = int(op_epoch)
+                sched.ha_epoch = fence["epoch"]
             if kind == "submit":
+                if op_epoch is not None and op_epoch < fence["epoch"]:
+                    # stale-epoch dispatch: a deposed router's late op.
+                    # Reject on the wire — never admitted, never echoed
+                    sched.ha_fenced += 1
+                    _emit({"ev": "done", "rid": op["rid"],
+                           "status": "fenced", "tokens": [],
+                           "error": f"epoch {op_epoch} < fence "
+                                    f"{fence['epoch']}"})
+                    continue
                 try:
                     req = sched.submit(
                         op["prompt"], op.get("max_new_tokens", 32),
@@ -208,6 +231,7 @@ def main(argv=None):
                            "error": f"{type(e).__name__}: {e}"})
                     continue
                 req._wire_rid = op["rid"]
+                req._fence_epoch = op_epoch
                 if req.state in TERMINAL:   # max_new_tokens=0 parity
                     report(req)
                 else:
@@ -216,6 +240,15 @@ def main(argv=None):
                 req = live.get(op.get("rid"))
                 if req is not None:
                     req.cancel()
+            elif kind == "fence":
+                # cancel everything dispatched under an older epoch:
+                # those tokens would be dropped by the new router's
+                # journal anyway, so reclaim the slots/pages now
+                for req in list(live.values()):
+                    tag = getattr(req, "_fence_epoch", None)
+                    if tag is None or tag < fence["epoch"]:
+                        req.cancel()
+                        sched.ha_fenced += 1
             elif kind == "drain":
                 sched.begin_drain(shed_waiting=False)
             elif kind == "trace":

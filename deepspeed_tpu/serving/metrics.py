@@ -494,3 +494,36 @@ class ClusterMetrics:
             "handoffs": self.handoffs,
             "degraded_routes": self.degraded_routes,
         }
+
+
+class HaMetrics:
+    """Router-HA observability (cluster/ha.RouterSupervisor): takeover
+    counts and fencing gauges, separate from :class:`ClusterMetrics`
+    because they outlive any single router — a takeover retires the
+    primary's metrics object but the supervisor's survive.  Events ride
+    ``write_events`` under ``router/``."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.failovers = 0         # standby takeovers (router deaths)
+        self.epoch = 0             # current lease epoch
+        self.fenced_writes = 0     # WAL appends rejected from old epochs
+        self.wal_records = 0       # WAL records accepted (lifetime)
+
+    def gauge(self, step, tag, value):
+        if self.monitor is not None:
+            self.monitor.write_events(clamp_min_step(
+                [(f"router/{tag}", value, step)], warn=False))
+
+    def record_takeover(self, step, epoch, fenced_writes, wal_records):
+        self.failovers += 1
+        self.record_gauges(step, epoch, fenced_writes, wal_records)
+
+    def record_gauges(self, step, epoch, fenced_writes, wal_records):
+        self.epoch = int(epoch)
+        self.fenced_writes = int(fenced_writes)
+        self.wal_records = int(wal_records)
+        self.gauge(step, "failovers", self.failovers)
+        self.gauge(step, "epoch", self.epoch)
+        self.gauge(step, "fenced_writes", self.fenced_writes)
+        self.gauge(step, "wal_records", self.wal_records)

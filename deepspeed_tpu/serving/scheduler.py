@@ -429,6 +429,11 @@ class ServingScheduler:
         # serviceable deadline-bearing requests after every cold start
         self._step_window = deque(maxlen=16)
         self._last_error = None
+        # Router-HA fence state, set by the owning replica/worker:
+        # the highest router epoch this scheduler has served under and
+        # how many stale-epoch dispatches/requests were fenced off
+        self.ha_epoch = None
+        self.ha_fenced = 0
         self.sampling = dict(do_sample=do_sample, temperature=temperature,
                              top_k=top_k, top_p=top_p)
         # Decoding-policy subsystem (serving/sampling/): `self.sampling`
@@ -2477,6 +2482,8 @@ class ServingScheduler:
             "preemptions": m.preemptions,
             "tokens_emitted": m.tokens_emitted,
             "last_error": self._last_error,
+            "ha_epoch": self.ha_epoch,
+            "ha_fenced": self.ha_fenced,
         }
 
     def summary(self):

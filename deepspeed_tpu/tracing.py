@@ -156,6 +156,11 @@ EVENT_TAXONOMY = {
     "cluster/handoff_degrade": "handoff failed; requeued unified",
     "cluster/drain": "replica drain completed",
     "cluster/restart": "replica restarted",
+    # ------------------------------------------------ router HA (HaMetrics)
+    "router/failovers": "cumulative router takeovers (standby promoted)",
+    "router/epoch": "current lease epoch (the fencing token)",
+    "router/fenced_writes": "WAL appends rejected from stale epochs",
+    "router/wal_records": "records accepted by the journal WAL",
     # ------------------------------------------------ training gauges
     "train/step_time_ms": "mean optimizer-step wall time per gauge window",
     "train/samples_per_s": "ThroughputTimer window samples/sec",

@@ -63,6 +63,8 @@ def _ours_curve(batches):
     return losses
 
 
+@pytest.mark.slow   # ~15s; the long-run torch-parity convergence
+# oracle — per-model tier-1 training smokes stay in tests/unit/models
 def test_convergence_tracks_torch_oracle():
     batches, H = _batches()
     ours = _ours_curve(batches)

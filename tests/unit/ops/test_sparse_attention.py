@@ -111,6 +111,8 @@ def test_sparse_self_attention_wrapper():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
 
 
+@pytest.mark.slow   # ~8s; the 8k long-run — the short-seq oracles
+# above pin the same kernel path in tier-1
 def test_long_sequence_8k_oracle():
     """VERDICT item 9 'oracle tests at 8k seq': 8192 tokens, 1 head."""
     cfg = BSLongformerSparsityConfig(1, block=512,

@@ -147,6 +147,8 @@ def test_1f1b_microbatch_count_invariance():
         np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4), g2, g4)
 
 
+@pytest.mark.slow   # ~8s; the in-flight memory-bound property —
+# engine-trains-with-1f1b keeps the schedule itself in tier-1
 def test_1f1b_in_flight_is_bounded():
     """The ring buffer (in-flight activations per stage) is sized 2S-1 —
     independent of the microbatch count (the 1F1B property; VERDICT's

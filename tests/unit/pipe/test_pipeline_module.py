@@ -71,6 +71,8 @@ def test_pipeline_tied_embeddings_no_lm_head():
     assert "lm_head" in v2["params"]["head"]
 
 
+@pytest.mark.slow   # ~10s; rng-plumbing check — forward-match /
+# trains-with-engine / loss-match keep the pipeline core in tier-1
 def test_pipeline_dropout_rng_used():
     """dropout>0: two forwards with different rngs differ, deterministic
     eval does not (the rngs/deterministic plumbing through shard_map)."""

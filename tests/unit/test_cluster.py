@@ -482,6 +482,11 @@ HEALTH_SCHEMA = {
     "preemptions": (int,),
     "tokens_emitted": (int,),
     "last_error": (str, type(None)),
+    # router HA (PR 17): the fencing state the owning replica/worker
+    # stamps — the lease epoch this scheduler last saw, and how many
+    # stale-epoch calls it rejected/cancelled
+    "ha_epoch": (int, type(None)),
+    "ha_fenced": (int,),
 }
 
 
@@ -579,6 +584,66 @@ def test_process_replica_sigkill_zero_lost(engine):
         for e, w in zip(entries, want):
             assert e.state == "finished", (e.rid, e.state, e.error)
             assert got[e.rid] == w, (e.rid, e.replica_history)
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+@pytest.mark.slow
+def test_process_replica_revival_no_double_adopt(engine):
+    """Heartbeat-flap pin, process flavor: a SIGKILLed ProcessReplica is
+    REVIVED via restart_replica after its in-flight work already
+    replayed to the survivor.  The revived worker (a fresh incarnation)
+    must not be double-adopted: requests in flight at the kill finish
+    exactly once token-exact, fresh post-revival traffic is served, and
+    the journal audit stays clean throughout."""
+    from deepspeed_tpu.serving import ProcessReplica
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 256, 5).astype(np.int32) for _ in range(4)]
+    max_new = [24, 24, 24, 24]
+    want = _oracle(engine, prompts, max_new)
+    reps = [ProcessReplica(f"proc{i}", model="gpt2-tiny",
+                           term_grace_s=5.0) for i in range(2)]
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        router = ClusterRouter(reps, heartbeat_misses=1)
+        entries = [router.submit(p, max_new_tokens=m, rid=f"r{i}")
+                   for i, (p, m) in enumerate(zip(prompts, max_new))]
+        import time as _time
+        deadline = _time.monotonic() + 600
+        while _time.monotonic() < deadline:
+            router.step()
+            if sum(len(e.emitted) for e in entries) >= 2:
+                break
+            _time.sleep(0.05)
+        victim = next(r for r in reps if r.load() > 0)
+        inc0 = victim.incarnation
+        victim.kill()
+        got = router.run(max_steps=200000)
+        assert router.journal.audit() == []
+        # revive the killed worker: fresh process, bumped incarnation
+        router.restart_replica(victim)
+        victim.wait_ready()
+        assert victim.incarnation == inc0 + 1
+        assert victim.state == "up"
+        # the finished streams stay exactly-once (no late double-emit
+        # from the revived id) and fresh traffic is served
+        for e, w in zip(entries, want):
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert got[e.rid] == w, (e.rid, e.replica_history)
+        more = [router.submit(p, max_new_tokens=8, rid=f"post{i}")
+                for i, p in enumerate(prompts[:2])]
+        got2 = router.run(max_steps=200000)
+        for e in more:
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert len(got2[e.rid]) == 8
+        for e, w in zip(entries, want):
+            assert e.emitted == w, "revival double-emitted into an " \
+                                   "already-finished stream"
+        assert router.journal.audit() == []
+        assert router.health()["restarts"] == 1
     finally:
         for rep in reps:
             rep.die("test teardown")

@@ -335,6 +335,8 @@ def test_checkpoint_missing_dir_warns_not_crashes(tmp_path):
     assert path is None
 
 
+@pytest.mark.slow   # ~14s; the loader-iterator variant —
+# train_loop/gas-window tests keep the train_batch core in tier-1
 def test_train_batch_with_loader():
     import flax.linen  # noqa
     from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny

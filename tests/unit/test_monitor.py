@@ -168,6 +168,8 @@ _CLUSTER_TAGS = ("heartbeat_miss", "failover", "replay", "retry",
 
 
 def test_event_taxonomy_pins_every_emitted_name():
+    from deepspeed_tpu.serving.metrics import HaMetrics
+
     rb = RingBufferMonitor(maxlen=4096)
     _drive_all_serving_events(ServingMetrics(rb))
     cm = ClusterMetrics(rb)
@@ -175,6 +177,9 @@ def test_event_taxonomy_pins_every_emitted_name():
         cm.event(1, tag)
     for state in ("finished", "failed", "shed", "cancelled"):
         cm.record_terminal(1, state)
+    ha = HaMetrics(rb)
+    ha.record_gauges(1, epoch=1, fenced_writes=0, wal_records=3)
+    ha.record_takeover(2, epoch=2, fenced_writes=1, wal_records=5)
     emitted = {tag for tag, _, _ in rb.events}
     unknown = emitted - set(EVENT_TAXONOMY)
     assert not unknown, (

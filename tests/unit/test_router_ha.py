@@ -1,0 +1,553 @@
+"""Router HA (deepspeed_tpu/serving/cluster/{wal,ha}.py): durable
+journal WAL, epoch-fenced standby takeover, and the router-death chaos
+harness.
+
+The acceptance oracle mirrors PR-8's replica-failover oracle one tier
+up: with mixed greedy/sampled/grammar/spec traffic in flight, killing
+the ROUTER at sampled pump indices completes every request through the
+promoted standby with the EXACT client streams an undisturbed run
+serves — zero lost, zero duplicated, sampled streams bitwise — and a
+zombie primary that keeps running is fenced at every surface it can
+touch (replica dispatch, token sink, WAL append).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import (ClusterRouter, FileWalSink, Lease,
+                                   MemoryWalSink, RequestJournal,
+                                   RouterSupervisor, StaleEpoch,
+                                   make_disaggregated_group,
+                                   make_local_fleet)
+from deepspeed_tpu.serving.cluster import journal as jn
+
+CFG = dict(num_slots=3, num_pages=16, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+# ------------------------------------------------------ WAL round-trip
+
+
+def _drive_journal(wal):
+    """Exercise every journal mutation through ``wal`` and return the
+    journal: admit (greedy + sampled/grammar), dispatch, tokens,
+    handoff packet, requeue, cancel, finalize."""
+    j = RequestJournal(wal=wal, epoch=1, snapshot_every=7)
+    a = j.admit([1, 2, 3], 4, rid="a")[0]
+    b = j.admit([4, 5], 6, rid="b",
+                sampling={"do_sample": True, "temperature": 0.9},
+                seed=77, grammar={"regex": "(ab)+"})[0]
+    c = j.admit([9], 3, rid="c")[0]
+    d = j.admit([7, 7], 5, rid="d", eos_token_id=0)[0]
+    j.dispatch(a, "replica0", 0)
+    j.token(a, 11)
+    j.token(a, 12)
+    j.dispatch(b, "replica1", 2)
+    j.token(b, 21)
+    j.handoff(c, "disagg", [9], [3, 4], 1, 30)
+    j.dispatch(d, "replica0", 0)
+    j.requeue(d, error="replica crash")       # failover requeue
+    j.mark_cancel(b)
+    j.finalize(a, jn.FINISHED)
+    return j
+
+
+def test_wal_memory_roundtrip_bit_identical():
+    """replay(records) reconstructs the journal bit-identically — the
+    to_record() image of every entry, the auto-rid cursor, the pending
+    handoff packet, the PR-16 sampling/seed/grammar fields."""
+    wal = MemoryWalSink()
+    j = _drive_journal(wal)
+    snap, records = wal.replay_stream()
+    j2 = RequestJournal.replay(records, snapshot=snap)
+    assert j2.state_snapshot() == j.state_snapshot()
+    assert j2.pending_packets == j.pending_packets
+    b2 = j2.entries["b"]
+    assert b2.sampling == {"do_sample": True, "temperature": 0.9}
+    assert b2.seed == 77 and b2.grammar == {"regex": "(ab)+"}
+    assert b2.cancel_requested and b2.replica_inc == 2
+    assert j2.entries["d"].state == jn.QUEUED
+    assert j2.entries["d"].error == "replica crash"
+    # a second replay of the same stream is also identical (replay is
+    # deterministic, not merely convergent)
+    assert RequestJournal.replay(records,
+                                 snapshot=snap).state_snapshot() == \
+        j.state_snapshot()
+
+
+def test_wal_file_roundtrip_reopen_and_torn_tail(tmp_path):
+    """The crash-safe file sink: snapshots rotate segments, a REOPENED
+    sink replays the same stream, a torn tail (half-written last line,
+    the crash-mid-write case) is tolerated — replay stops at the tear
+    instead of refusing the log."""
+    root = tmp_path / "wal"
+    wal = FileWalSink(str(root), fsync_records=True)
+    j = _drive_journal(wal)
+    j.checkpoint()                      # snapshot -> segment rotation
+    j.token(j.entries["d"], 40)         # post-snapshot tail record
+    wal.close()
+
+    wal2 = FileWalSink(str(root))
+    snap, records = wal2.replay_stream()
+    assert snap is not None, "checkpoint must have landed a snapshot"
+    j2 = RequestJournal.replay(records, snapshot=snap)
+    assert j2.state_snapshot() == j.state_snapshot()
+    wal2.close()
+
+    # torn tail: append garbage to the newest segment
+    segs = sorted(root.glob("wal-*.jsonl"))
+    with open(segs[-1], "a") as f:
+        f.write('{"op": "token", "rid": "d", "t": 99')   # no newline
+    wal3 = FileWalSink(str(root))
+    snap3, records3 = wal3.replay_stream()
+    j3 = RequestJournal.replay(records3, snapshot=snap3)
+    assert j3.state_snapshot() == j.state_snapshot(), \
+        "a torn final record must be dropped, not poison the replay"
+    assert wal3.torn_records >= 1
+    wal3.close()
+
+
+def test_journal_dump_crash_safe_with_wal_position(tmp_path):
+    """dump() writes tmp+rename (no torn dump is ever visible) and the
+    header carries the WAL cursor so a post-mortem can correlate the
+    dump with the exact log position."""
+    wal = FileWalSink(str(tmp_path / "wal"))
+    j = _drive_journal(wal)
+    out = tmp_path / "journal.json"
+    j.dump(str(out))
+    assert not (tmp_path / "journal.json.tmp").exists()
+    payload = json.loads(out.read_text())
+    pos = payload["wal_position"]
+    assert pos["records"] == wal.position()["records"] > 0
+    assert payload["epoch"] == 1
+    assert {e["rid"] for e in payload["entries"]} == {"a", "b", "c", "d"}
+    wal.close()
+
+
+# ------------------------------------------------- router-death chaos
+
+
+def _mixed_rows(rng):
+    """Greedy + sampled + grammar-constrained traffic (the PR-16
+    policies whose streams must continue BITWISE across a takeover)."""
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (12, 7, 9, 5)]
+    rows = [
+        dict(sampling=None, seed=None),
+        dict(sampling={"do_sample": True, "temperature": 0.9,
+                       "top_p": 0.95}, seed=101),
+        dict(sampling={"do_sample": True, "temperature": 1.1,
+                       "top_k": 50, "repetition_penalty": 1.2}, seed=202),
+        dict(sampling={"do_sample": True}, seed=303,
+             grammar={"regex": "(ab|cd)+"}),
+    ]
+    max_new = [6, 8, 7, 10]
+    return prompts, rows, max_new
+
+
+def _serve_ha(engine, kill_step, prompts, rows, max_new, spec=False,
+              require_fire=True):
+    fleet_kw = dict(CFG)
+    if spec:
+        fleet_kw.update(spec_decode="ngram", spec_k=4)
+    reps = make_local_fleet(engine, 2, **fleet_kw)
+    sup = RouterSupervisor(reps, wal=MemoryWalSink(), lease_ttl_s=60.0)
+    inj = faults.FaultInjector(seed=0)
+    plan = None
+    if kill_step is not None:
+        plan = inj.on("cluster.router_kill", step=kill_step,
+                      exc=RuntimeError("router crash"))
+    streams = {}
+    with faults.injected(inj):
+        for i, (p, row, m) in enumerate(zip(prompts, rows, max_new)):
+            rid = f"r{i}"
+            streams[rid] = []
+            sup.submit(p, max_new_tokens=m, rid=rid,
+                       on_token=(lambda r: lambda _q, t:
+                                 streams[r].append(int(t)))(rid), **row)
+        got = sup.run()
+    if kill_step is not None and require_fire:
+        assert plan.fired == 1, \
+            f"kill@{kill_step} never landed (workload too short)"
+    if plan is not None and plan.fired:
+        assert sup.failovers >= 1
+    for i in range(len(prompts)):
+        e = sup.entry(f"r{i}")
+        assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        assert streams[e.rid] == got[e.rid], \
+            (e.rid, "client stream != journal record")
+    sup.audit()
+    return [got[f"r{i}"] for i in range(len(prompts))], sup
+
+
+def test_router_kill_chaos_sweep_exactly_once_bitwise(engine):
+    """THE acceptance oracle: kill the router at every early pump index
+    (admission, first dispatch, mid-stream — the whole live window of
+    this workload) under mixed greedy/sampled/grammar traffic.  Every
+    request reaches FINISHED through the promoted standby, the client
+    token streams are BITWISE identical to the kill-free run (exactly
+    once: nothing lost, nothing duplicated, sampled continuations
+    stream-exact), and the fleet page audit stays clean."""
+    from deepspeed_tpu.serving.sampling import compile_grammar
+
+    rng = np.random.default_rng(3)
+    prompts, rows, max_new = _mixed_rows(rng)
+    calm, _ = _serve_ha(engine, None, prompts, rows, max_new)
+    g = compile_grammar({"regex": "(ab|cd)+"},
+                        engine.module.cfg.vocab_size)
+    assert g.accepts(calm[3])
+    import os
+    kill_steps = (1, 2, 3)
+    extra = os.environ.get("DS_CHAOS_STEPS")      # CI widens the sweep
+    if extra:
+        kill_steps = tuple(sorted({*kill_steps,
+                                   *map(int, extra.split(","))}))
+    for kill in kill_steps:
+        # env-widened indices past the workload's live window may not
+        # fire — the bitwise oracle still must hold either way
+        stormy, sup = _serve_ha(engine, kill, prompts, rows, max_new,
+                                require_fire=kill <= 3)
+        assert stormy == calm, \
+            f"kill@{kill}: streams diverged from the kill-free run"
+        h = sup.health()
+        if sup.failovers:
+            assert h["ha_failovers"] == sup.failovers >= 1
+            assert h["ha_epoch"] >= 2 and h["ha_wal_records"] > 0
+
+
+@pytest.mark.slow   # ~3s; spec x HA composition — the mixed-policy
+# chaos sweep keeps router-death in tier-1 (CI chaos job runs all)
+def test_router_kill_with_spec_decode_traffic(engine):
+    """Spec-decode traffic rides the same oracle: drafts/verify state
+    is replica-local and replayable, so a router kill mid-stream still
+    produces the greedy-exact streams."""
+    rng = np.random.default_rng(4)
+    motif = rng.integers(0, 256, 4).astype(np.int32)
+    prompts = [np.concatenate([np.tile(motif, 3),
+                               rng.integers(0, 256, 4).astype(np.int32)])
+               for _ in range(3)]
+    rows = [dict(sampling=None, seed=None)] * 3
+    max_new = [12, 10, 12]
+    calm, _ = _serve_ha(engine, None, prompts, rows, max_new, spec=True)
+    stormy, sup = _serve_ha(engine, 2, prompts, rows, max_new, spec=True)
+    assert stormy == calm
+
+
+@pytest.mark.slow   # ~3s; disagg x HA composition (CI chaos job
+# runs the whole file without the tier-1 filter)
+def test_router_kill_mid_handoff_disaggregated(engine):
+    """Mid-handoff router death: prefill hands a KV chain off, the
+    packet is journaled but the router dies before (or while) the
+    decode dispatch runs.  The standby re-drives the journaled packet
+    from its own fleet — every request token-exact vs the calm
+    disaggregated run, shared pool clean."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, 9).astype(np.int32) for _ in range(3)]
+    max_new = [6, 7, 5]
+
+    def serve(kill_step):
+        reps = make_disaggregated_group(
+            engine, num_prefill=1, num_decode=1, num_pages=32,
+            page_size=16, num_slots=3, max_pages_per_slot=8,
+            prefill_chunk=8)
+        sup = RouterSupervisor(reps, wal=MemoryWalSink(),
+                               lease_ttl_s=60.0)
+        inj = faults.FaultInjector(seed=0)
+        plan = None
+        if kill_step is not None:
+            plan = inj.on("cluster.router_kill", step=kill_step,
+                          exc=RuntimeError("router crash"))
+        with faults.injected(inj):
+            for i, (p, m) in enumerate(zip(prompts, max_new)):
+                sup.submit(p, max_new_tokens=m, rid=f"r{i}")
+            got = sup.run()
+        if kill_step is not None:
+            assert plan.fired == 1
+        for i in range(len(prompts)):
+            e = sup.entry(f"r{i}")
+            assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        sup.audit()
+        pool = reps[0].group.pool
+        cached = sum(r.sched.prefix_cache.cached_pages
+                     for r in reps if r.sched is not None
+                     and r.sched.prefix_cache is not None)
+        assert pool.pages_in_use == cached, "takeover leaked pool pages"
+        return [got[f"r{i}"] for i in range(len(prompts))]
+
+    calm = serve(None)
+    for kill in (2, 3):          # the steps bracketing handoff dispatch
+        assert serve(kill) == calm, f"kill@{kill} diverged"
+
+
+# ------------------------------------------------------ zombie fencing
+
+
+def test_zombie_primary_fenced_everywhere(engine):
+    """The fenced-zombie acceptance test: after a takeover the DEPOSED
+    router object keeps running (a partitioned primary that never saw
+    the new lease).  Every surface it can touch must reject it:
+    replica dispatch raises StaleEpoch (counted, never a failover),
+    its token sinks drop (client sees no duplicate), and its WAL
+    appends are fenced (the log stays the heir's history)."""
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(0, 256, 8).astype(np.int32) for _ in range(3)]
+    reps = make_local_fleet(engine, 2, **CFG)
+    sup = RouterSupervisor(reps, wal=MemoryWalSink(), lease_ttl_s=60.0)
+    streams = {}
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.router_kill", step=2,
+                  exc=RuntimeError("router crash"))
+    with faults.injected(inj):
+        for i, p in enumerate(prompts):
+            rid = f"r{i}"
+            streams[rid] = []
+            sup.submit(p, max_new_tokens=6, rid=rid,
+                       on_token=(lambda r: lambda _q, t:
+                                 streams[r].append(int(t)))(rid))
+        zombie = sup.router
+        while sup.failovers == 0:
+            sup.step()
+        assert plan.fired == 1 and sup.router is not zombie
+        heir = sup.router
+
+        # 1. WAL append fence: the zombie journal's own mutations are
+        # rejected at the log — including the TOKEN path, so the client
+        # callback must NOT fire (exactly-once)
+        z_entry = next(e for e in zombie.journal.entries.values()
+                       if e.state not in jn.TERMINAL)
+        fenced_before = sup.wal.fenced_writes
+        before = list(streams[z_entry.rid])
+        zombie.journal.token(z_entry, 999)
+        assert sup.wal.fenced_writes > fenced_before
+        assert streams[z_entry.rid] == before, \
+            "a fenced token must never reach the client"
+        assert zombie.journal.fenced is True
+
+        # 2. replica dispatch fence: pumping the zombie raises
+        # StaleEpoch at every replica — counted as fenced dispatches,
+        # never treated as replica deaths
+        failovers_before = heir.metrics.failovers
+        zombie.step()
+        assert zombie.fenced_dispatches > 0
+        assert heir.metrics.failovers == failovers_before
+        assert all(rep.state != "dead" for rep in reps)
+        assert any(rep.fenced_calls > 0 for rep in reps)
+
+        # 3. token-sink lease fence: a sink the zombie minted drops on
+        # the lease fast-path
+        sink = zombie._make_token_sink(z_entry, reps[0])
+        sink(None, 123)
+        assert zombie.fenced_tokens >= 1
+        assert streams[z_entry.rid] == before
+
+        # the heir completes everything exactly-once regardless
+        got = sup.run()
+    for i in range(len(prompts)):
+        e = sup.entry(f"r{i}")
+        assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        assert streams[e.rid] == got[e.rid]
+        assert 999 not in e.emitted and 123 not in e.emitted
+    h = sup.health()
+    assert h["ha_fenced_writes"] >= 1
+    # scheduler-level ha_* health: replicas saw the heir's epoch and
+    # counted the zombie's fenced calls
+    for rep in reps:
+        rh = rep.sched.health()
+        assert rh["ha_epoch"] == sup.epoch
+        assert rh["ha_fenced"] >= 0
+
+
+@pytest.mark.slow   # wall-clock sleeps (a stalled primary must
+# really outlive its ttl); the fake-clock lease test stays tier-1
+def test_lease_expiry_promotes_standby(engine):
+    """The stalled-not-dead primary: a router that hangs past its lease
+    TTL (sleep action at the kill point — no exception) is deposed when
+    it comes back; the supervisor promotes the standby and finishes the
+    work under the new epoch."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, 8).astype(np.int32) for _ in range(3)]
+    reps = make_local_fleet(engine, 2, **CFG)
+    sup = RouterSupervisor(reps, wal=MemoryWalSink(), lease_ttl_s=0.08)
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.router_kill", step=2,
+                  action=lambda ctx: time.sleep(0.25))
+    with faults.injected(inj):
+        for i, p in enumerate(prompts):
+            sup.submit(p, max_new_tokens=6, rid=f"r{i}")
+        got = sup.run()
+    assert plan.fired == 1
+    assert sup.failovers >= 1
+    assert any("lease expired" in r for r in sup.takeover_reasons)
+    for i in range(len(prompts)):
+        e = sup.entry(f"r{i}")
+        assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        assert len(got[e.rid]) == 6
+    sup.audit()
+
+
+def test_lease_epoch_monotonic_and_renewal_rules():
+    t = [0.0]
+    lease = Lease(ttl_s=1.0, clock=lambda: t[0])
+    e1 = lease.acquire("a")
+    assert e1 == 1 and lease.renew(e1)
+    t[0] = 2.5                       # past expiry
+    assert not lease.renew(e1), "an expired holder cannot renew"
+    e2 = lease.acquire("b")
+    assert e2 == 2
+    assert not lease.renew(e1), "a deposed epoch cannot renew"
+    assert lease.renew(e2)
+
+
+# --------------------------------------------------- cancel vs failover
+
+
+def test_cancel_raced_with_router_failover(engine):
+    """cancel() raced against a router kill: the cancel is journaled
+    before the death, the standby's replay must honour it — terminal
+    CANCELLED exactly once, never resurrected onto a survivor — while
+    the untouched requests finish normally."""
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(0, 256, 8).astype(np.int32) for _ in range(3)]
+    reps = make_local_fleet(engine, 2, **CFG)
+    sup = RouterSupervisor(reps, wal=MemoryWalSink(), lease_ttl_s=60.0)
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.router_kill", step=2,
+                  exc=RuntimeError("router crash"))
+    with faults.injected(inj):
+        for i, p in enumerate(prompts):
+            sup.submit(p, max_new_tokens=48, rid=f"r{i}")
+        sup.step()                      # dispatch everything
+        assert sup.cancel("r1") is True
+        sup.run()                       # kill fires at step 2, takeover
+    assert plan.fired == 1 and sup.failovers >= 1
+    e = sup.entry("r1")
+    assert e.state == jn.CANCELLED, (e.state, e.error)
+    assert e.cancel_requested is True
+    for rid in ("r0", "r2"):
+        assert sup.entry(rid).state == jn.FINISHED, \
+            (rid, sup.entry(rid).state, sup.entry(rid).error)
+    # idempotent terminal state: cancelling again after takeover is a
+    # no-op, and another takeover-free replay keeps it CANCELLED
+    assert sup.cancel("r1") is False
+    snap, records = sup.wal.replay_stream()
+    j2 = RequestJournal.replay(records, snapshot=snap)
+    assert j2.entries["r1"].state == jn.CANCELLED
+    sup.audit()
+
+
+# ------------------------------------------------- flap / double-adopt
+
+
+def test_heartbeat_flap_no_double_adopt(engine):
+    """Heartbeat flapping: a replica declared dead on missed beats is
+    revived via restart_replica while its former entries already
+    replayed to a survivor.  The revived replica must NOT be
+    double-adopted — every stream is BITWISE the undisturbed fleet's
+    (ownership is (replica, incarnation)-fenced at the sinks, so a
+    flap can't double-emit) and the journal audit stays clean."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 256, 8).astype(np.int32) for _ in range(4)]
+
+    calm_router = ClusterRouter(make_local_fleet(engine, 2, **CFG))
+    for i, p in enumerate(prompts):
+        calm_router.submit(p, max_new_tokens=10, rid=f"r{i}")
+    calm = calm_router.run()
+
+    reps = make_local_fleet(engine, 2, **CFG)
+    router = ClusterRouter(reps, heartbeat_misses=2)
+    streams = {}
+    for i, p in enumerate(prompts):
+        rid = f"r{i}"
+        streams[rid] = []
+        router.submit(p, max_new_tokens=10, rid=rid,
+                      on_token=(lambda r: lambda _q, t:
+                                streams[r].append(int(t)))(rid))
+    router.step()                        # dispatch across the fleet
+    flaky = reps[0]
+    orig_hb, inc0 = flaky.heartbeat, flaky.incarnation
+
+    def bad_heartbeat(epoch=None):
+        raise RuntimeError("network partition")
+    flaky.heartbeat = bad_heartbeat
+    while flaky.state != "dead":        # miss beats -> declared dead
+        router.step()
+    flaky.heartbeat = orig_hb            # partition heals
+    router.restart_replica(flaky)        # operator revives it
+    assert flaky.incarnation == inc0 + 1
+    got = router.run()
+    for i in range(len(prompts)):
+        e = router.journal.entries[f"r{i}"]
+        assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        assert streams[e.rid] == got[e.rid] == calm[e.rid], \
+            (e.rid, "flap double-emitted or diverged")
+    assert router.journal.audit() == []
+    assert router.health()["restarts"] == 1
+
+
+def test_live_restart_replays_in_flight(engine):
+    """restart_replica on a replica that is NOT dead (operator restart
+    mid-flap) must first replay its in-flight entries — the fresh
+    scheduler knows nothing of them; stranding them ROUTED would hang
+    the journal forever."""
+    rng = np.random.default_rng(10)
+    prompts = [rng.integers(0, 256, 8).astype(np.int32) for _ in range(3)]
+    reps = make_local_fleet(engine, 2, **CFG)
+    router = ClusterRouter(reps)
+    for i, p in enumerate(prompts):
+        router.submit(p, max_new_tokens=6, rid=f"r{i}")
+    router.step()
+    victim = next(r for r in reps if r.load() > 0)
+    router.restart_replica(victim)       # live restart, state == UP
+    got = router.run(max_steps=2000)
+    for i in range(len(prompts)):
+        e = router.journal.entries[f"r{i}"]
+        assert e.state == jn.FINISHED, (e.rid, e.state, e.error)
+        assert len(got[e.rid]) == 6
+    assert router.journal.audit() == []
+
+
+# ----------------------------------------------------- file-WAL chaos
+
+
+def test_router_kill_with_file_wal(engine, tmp_path):
+    """The chaos oracle over the DURABLE sink: a takeover replaying
+    from fsync'd JSONL segments (not the in-memory list) still serves
+    every stream bitwise, and the post-run dump correlates with the
+    final WAL cursor."""
+    rng = np.random.default_rng(12)
+    prompts, rows, max_new = _mixed_rows(rng)
+    calm, _ = _serve_ha(engine, None, prompts, rows, max_new)
+
+    reps = make_local_fleet(engine, 2, **CFG)
+    wal = FileWalSink(str(tmp_path / "wal"), fsync_records=False)
+    sup = RouterSupervisor(reps, wal=wal, lease_ttl_s=60.0)
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.router_kill", step=2,
+                  exc=RuntimeError("router crash"))
+    with faults.injected(inj):
+        for i, (p, row, m) in enumerate(zip(prompts, rows, max_new)):
+            sup.submit(p, max_new_tokens=m, rid=f"r{i}", **row)
+        got = sup.run()
+    assert plan.fired == 1 and sup.failovers >= 1
+    assert [got[f"r{i}"] for i in range(len(prompts))] == calm
+    dump = tmp_path / "journal.json"
+    sup.journal.dump(str(dump))
+    payload = json.loads(dump.read_text())
+    assert payload["wal_position"]["records"] == \
+        wal.position()["records"]
+    wal.close()

@@ -242,6 +242,8 @@ def test_sampled_streams_invariant_under_prefix_cache(engine):
     _check_grammar(rows, warm, engine)
 
 
+@pytest.mark.slow   # four full serves; eviction + prefix-cache
+# invariance above are the tier-1 stream-invariance representatives
 def test_sampled_streams_invariant_under_horizon_and_overlap(engine):
     """Fused-vs-unfused: decode horizon 1 (token-at-a-time) vs 8
     (fused multi-token scans), overlap on/off — four executions, one
@@ -257,6 +259,8 @@ def test_sampled_streams_invariant_under_horizon_and_overlap(engine):
     _check_grammar(rows, variants[0], engine)
 
 
+@pytest.mark.slow   # spec composition also pinned (cheaper) in
+# test_sampling_policy's spec test; degrade path in test_spec_decode
 def test_sampled_streams_invariant_under_spec_fault_degrade(engine):
     """Fault containment composes with sampling: a drafter whose every
     proposal attempt faults degrades each request to normal decode
@@ -317,6 +321,9 @@ MESH_CFG = dict(num_slots=8, num_pages=32, page_size=16,
                 max_pages_per_slot=4, prefill_chunk=8)
 
 
+@pytest.mark.slow   # ~8s/shape; sampling x mesh composition — the
+# policy lanes are slot-family arrays, sharded like every other
+# per-slot lane test_serving_mesh pins in tier-1
 @pytest.mark.skipif(len(jax.devices()) < 8,
                     reason="needs the 8-device virtual CPU mesh")
 @pytest.mark.parametrize("model_ax,data_ax", [(1, 8), (2, 4)])

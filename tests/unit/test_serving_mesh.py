@@ -442,6 +442,8 @@ def llama_int8_ref_tokens(llama_ref):
     return toks, got[r2.rid]
 
 
+@pytest.mark.slow   # ~14s/shape; mha+gqa above keep the shard_map
+# dispatch in tier-1, and int8 parity rides test_kv_quant's mesh leg
 @pytest.mark.parametrize("model_ax,data_ax", KERNEL_MESHES)
 def test_shard_map_kernel_int8_token_exact(kernel_engines,
                                            llama_ref,

@@ -84,6 +84,8 @@ def test_native_to_universal_resume_across_zero_stage(tmp_path):
     assert np.isfinite(l1) and l1 < l0 + 0.5
 
 
+@pytest.mark.slow   # ~10s; bitwise resume across zero stages above
+# already proves the moments survive — this is the leaf-level audit
 def test_universal_moments_roundtrip(tmp_path):
     """An offload-source universal checkpoint carries Adam moments; the
     resumed dense engine's opt_state receives them."""

@@ -147,6 +147,22 @@ CHECKS = [
      "mesh_sweep.sweep.1x8.kernel_vs_reference", "info", None),
     ("mesh kernel tokens/s (2x4)",
      "mesh_sweep.sweep.2x4.kernel.tokens_per_sec", "info", None),
+    # sequence-parallel long-context rows (PR 18): on CPU every rank of
+    # the 'sequence' axis shares the host's cores, so these numbers
+    # bound DISPATCH/orchestration overhead (the sp leg runs ~axis-size
+    # x fewer, wider prefill dispatches), not chip scaling — and the
+    # 64k chunked baseline is a labeled power-law extrapolation (a
+    # measured run costs ~1h on a 1-core rig).  Info, never gating,
+    # until a TPU round lands like-for-like in the same JSON paths
+    ("long-context TTFT sp/chunked @16k (CPU: dispatch bound)",
+     "long_context.curve.16384.ttft_ratio", "info", None),
+    ("long-context TTFT sp/extrapolated-chunked @64k",
+     "long_context.curve.65536.ttft_ratio_vs_extrapolated", "info",
+     None),
+    ("long-context sp TTFT @64k (ms, CPU rig)",
+     "long_context.curve.65536.seq_parallel.ttft_ms_p50", "info", None),
+    ("long-context sp prefill compiles (whole curve)",
+     "long_context.seq_prefill_compiles", "info", None),
 ]
 
 TRACING_OVERHEAD_CEILING = 0.05   # the committed <5% contract

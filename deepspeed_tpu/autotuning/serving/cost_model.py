@@ -78,7 +78,21 @@ DEFAULT_KNOBS = {
     # ds_serve flag, never varied inside a measured search)
     "kv_dtype": "float32",
     "weight_dtype": None,              # None = follow the engine dtype
+    # sequence-parallel prefill routing (PR 18): prompts with at least
+    # this many pending tokens take the sequence-sharded prefill path
+    # (0 = off).  Priced by the prefill term below; inert without a
+    # live sequence axis (the scheduler degrades, and the model's
+    # `sequence_axis_size` live signal defaults to 1).
+    "seq_parallel_threshold": 0,
+    "prefill_reserve_frac": None,      # scheduler default: whole pool
 }
+
+# dispatch overhead billed in token-equivalents for the TTFT prefill
+# term: on the committed CPU rig each prefill chunk pays a host
+# round-trip worth roughly one default chunk of compute (the
+# horizon-amortization fit makes the same dispatch-dominance claim for
+# decode).  Only the RATIO between candidates matters for ranking.
+_DISPATCH_TOKEN_EQUIV = 16.0
 
 # nominal interconnect bandwidth for the comm term (bytes/s per
 # device).  TPU v4 ICI order of magnitude; only the RATIO between
@@ -300,6 +314,26 @@ class ServingCostModel:
         gain = (self._spec_speedup_ref - 1.0) * min(max(t, 0.0), 1.0)
         return 1.0 + gain
 
+    def _prefill_work(self, k, unique):
+        """Decompose a prompt's prefill into (dispatches, per-device
+        compute tokens, routed): the chunked loop pays one dispatch per
+        ``prefill_chunk`` tokens; sequence-parallel routing widens the
+        chunk to ``prefill_chunk x axis_size`` AND spreads the
+        attention/MLP compute over the axis — both effects are what
+        bends TTFT sub-linear for long prompts.  The axis size is a
+        LIVE signal (``sequence_axis_size``, from the engine's resolved
+        plan); it defaults to 1, so the term is honest on a rig without
+        a sequence axis — routing there is a scheduler degrade, and the
+        model prices it as one."""
+        chunk = max(1, int(k["prefill_chunk"]))
+        seq = max(1, int(self.live.get("sequence_axis_size", 1)))
+        thr = int(k.get("seq_parallel_threshold") or 0)
+        routed = thr > 0 and seq > 1 and unique >= thr
+        eff = chunk * seq if routed else chunk
+        dispatches = -(-int(max(1.0, unique)) // eff)
+        compute = float(unique) / (seq if routed else 1)
+        return dispatches, compute, routed
+
     def _page_demand(self, k):
         """Expected steady-state page demand: live slots x mean pages
         resident per request (mid-decode), plus the prefix cache's
@@ -363,7 +397,14 @@ class ServingCostModel:
         if prefix > 1.0:
             unique = max(1.0, unique - self.mix.shared_fraction *
                          self.mix.shared_prefix_len)
-        ttft = self._ttft_ref_ms * (unique / self._prompt_ref) * \
+        # prefill decomposition: dispatch overhead x chunk count plus
+        # per-device compute, against the same decomposition of the
+        # committed reference mix (mean prompt 13.5 = one chunk = one
+        # dispatch)
+        disp, compute, routed = self._prefill_work(k, unique)
+        ref = _DISPATCH_TOKEN_EQUIV * 1.0 + self._prompt_ref
+        prefill_scale = (_DISPATCH_TOKEN_EQUIV * disp + compute) / ref
+        ttft = self._ttft_ref_ms * prefill_scale * \
             (self._horizon_tokens_per_s(8) / max(rate, 1e-9)) ** 0.5
         # page-seconds per request: resident pages x predicted service
         # time (decode budget / per-slot token rate) — the PR-11
@@ -386,7 +427,9 @@ class ServingCostModel:
                       "comm_factor": round(comm, 4),
                       "kv_quant_factor": round(kvq, 3),
                       "page_bytes": self.page_bytes(k),
-                      "page_demand": demand},
+                      "page_demand": demand,
+                      "prefill_dispatches": disp,
+                      "seq_parallel_routed": routed},
         }
 
     # ----------------------------------------------- seed-tuner contract

@@ -52,6 +52,14 @@ DEFAULT_SERVING_SPACE = {
     # weight_dtype is deliberately NOT searched — it is engine state,
     # priced + emitted as a ds_serve flag instead.
     "kv_dtype": ["float32", "int8"],
+    # long-context prefill knobs (PR 18): the chunk width, and whether
+    # prompts above the threshold route through sequence-parallel
+    # prefill.  On a mesh with no sequence axis the threshold candidate
+    # prices identically to 0 (the cost model's prefill term gates on
+    # the live `sequence_axis_size` signal), so it never costs a
+    # measurement slot there.
+    "prefill_chunk": [16, 32],
+    "seq_parallel_threshold": [0, 256],
 }
 
 
@@ -114,6 +122,12 @@ def ds_serve_args(knobs):
     parts.append(f"--spec-decode {mode if mode not in (None, False) else 'off'}")
     if mode not in (None, False, "off"):
         parts.append(f"--spec-k {k['spec_k']}")
+    if k["seq_parallel_threshold"]:
+        parts.append(
+            f"--seq-parallel-threshold {k['seq_parallel_threshold']}")
+    if k["prefill_reserve_frac"] is not None:
+        parts.append(
+            f"--prefill-reserve-frac {k['prefill_reserve_frac']}")
     if k["kv_dtype"] not in (None, "float32"):
         parts.append(f"--kv-dtype {k['kv_dtype']}")
     if k["weight_dtype"] is not None:
@@ -174,6 +188,8 @@ class ServingAutotuner(Autotuner):
             page_size=k["page_size"],
             max_pages_per_slot=k["max_pages_per_slot"],
             prefill_chunk=k["prefill_chunk"],
+            seq_parallel_threshold=k["seq_parallel_threshold"],
+            prefill_reserve_frac=k["prefill_reserve_frac"],
             decode_horizon_steps=k["decode_horizon_steps"],
             overlap=k["overlap"], prefix_cache=k["prefix_cache"],
             prefix_cache_pages=k["prefix_cache_pages"],

@@ -32,7 +32,8 @@ from deepspeed_tpu.parallel.topology import make_mesh
 from deepspeed_tpu.serving.sampling import pipeline as policy_pipeline
 from deepspeed_tpu.serving.sharding import (ServingShardingConfig,
                                             config_scope,
-                                            pool_bytes_per_device)
+                                            pool_bytes_per_device,
+                                            resolve_sequence_plan)
 from deepspeed_tpu.tracing import jit_cache_size
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -255,6 +256,7 @@ class InferenceEngine:
                     f"serving slot sharding -> {fresh.slot_axis or 'replicated'}"
                     f" for num_slots={num_slots}; rebuilding serving fns")
                 self._paged_prefill_fn = None
+                self._paged_prefill_sp_fn = None
                 self._paged_decode_fn = None
                 self._paged_decode_multi_fn = None
                 self._paged_verify_fn = None
@@ -786,6 +788,27 @@ class InferenceEngine:
             # only position a scheduler ever samples from)
             return logits[0, 0], {"layers": cache["layers"]}
 
+        seq_plan = self.seq_parallel_plan()
+
+        def prefill_sp(params, ids, slot, n_valid, page_table, lengths,
+                       pools):
+            # sequence-parallel twin of prefill: identical signature and
+            # paged landing, but the cache carries the static
+            # seq_axis/seq_impl markers (plain Python strings at trace
+            # time — the dict is built INSIDE the traced closure, same
+            # mechanism as the "slot" marker), so the model runs the
+            # chunk's attention distributed over the sequence axis.
+            # ids arrive sequence-sharded on dim 1 (the staging in
+            # prefill_sequence_parallel), which is what makes GSPMD
+            # shard the whole per-token pipeline and gather the KV
+            # scatter over the axis
+            cache = dict(pools, page_table=page_table, lengths=lengths,
+                         slot=slot, n_valid=n_valid,
+                         seq_axis=seq_plan.axis, seq_impl=seq_plan.impl)
+            logits, cache = module.apply({"params": materialize(params)},
+                                         ids, cache=cache)
+            return logits[0, 0], {"layers": cache["layers"]}
+
         def decode(params, toks, active, page_table, lengths, pools, rng,
                    do_sample, temperature, top_k, top_p):
             cache = dict(pools, page_table=page_table, lengths=lengths,
@@ -1037,6 +1060,13 @@ class InferenceEngine:
         slot, block, pool = shd.slot, shd.block, shd.pool
         self._paged_prefill_fn = jax.jit(prefill, donate_argnums=(6,),
                                          out_shardings=(shd.logits, pool))
+        # the sequence-parallel twin only exists when the mesh has a
+        # usable sequence axis (resolve_sequence_plan); its pools /
+        # logits round-trip is pinned identically, so landed pages and
+        # boundary logits are drop-in for everything downstream
+        self._paged_prefill_sp_fn = jax.jit(
+            prefill_sp, donate_argnums=(6,),
+            out_shardings=(shd.logits, pool)) if seq_plan.usable else None
         self._paged_decode_fn = jax.jit(decode, donate_argnums=(5,),
                                         static_argnums=(7, 8, 9, 10),
                                         out_shardings=(slot, pool))
@@ -1265,6 +1295,61 @@ class InferenceEngine:
         with self._serving_scope():
             return self._dispatch("prefill", self._paged_prefill_fn,
                                   *args)
+
+    def seq_parallel_plan(self):
+        """The resolved sequence-parallel prefill plan for this engine's
+        mesh + model (``serving.sharding.resolve_sequence_plan``),
+        cached — the scheduler reads it once at construction to decide
+        whether a ``seq_parallel_threshold`` can route anywhere, and
+        health() surfaces it."""
+        if getattr(self, "_seq_plan", None) is None:
+            heads, kv_heads = self._model_head_counts()
+            self._seq_plan = resolve_sequence_plan(
+                self.mesh, self.serving_sharding,
+                num_heads=heads or 1, num_kv_heads=kv_heads or 1)
+        return self._seq_plan
+
+    def prefill_sequence_parallel(self, ids_chunk, slot, n_valid,
+                                  page_table, lengths, pools):
+        """Sequence-parallel twin of :meth:`prefill_into_slots`: same
+        arguments, same ``(boundary logits [vocab], new pools)`` return,
+        same paged landing — but ``ids_chunk`` stages SHARDED over the
+        sequence mesh axis, the per-token pipeline (embedding, rotary,
+        MLP) runs 1/P-sized per device under GSPMD, and the chunk's
+        attention runs through the Ulysses all-to-all (or ring
+        ppermute) transport per the resolved plan.  The chunk length
+        must be a multiple of the axis size (the scheduler's power-of-
+        two chunk buckets >= the axis size guarantee it).  Pages land
+        in the standard pool, so decode / prefix-cache donation / COW /
+        spec verify / handoff downstream never notice which path
+        prefilled them."""
+        assert self.params is not None, "set_params/init_params first"
+        plan = self.seq_parallel_plan()
+        assert plan.usable, \
+            f"no usable sequence axis on this mesh: {plan.reason}"
+        chunk = int(np.shape(ids_chunk)[1])
+        assert chunk % plan.size == 0, \
+            (f"chunk length {chunk} must be a multiple of the "
+             f"'{plan.axis}' axis size {plan.size}")
+        shd = self._serving_shardings(num_slots=int(np.shape(lengths)[0]))
+        if getattr(self, "_paged_prefill_sp_fn", None) is None:
+            self._build_serving_fns()
+        rep, slot_sh, blk = shd.replicated, shd.slot, shd.block
+        seq_sh = NamedSharding(self.mesh, P(None, plan.axis))
+        ids_chunk, slot, n_valid, page_table, lengths = \
+            self._stage_host_inputs([
+                (ids_chunk, np.int32, seq_sh), (slot, np.int32, rep),
+                (n_valid, np.int32, rep), (page_table, np.int32, blk),
+                (lengths, np.int32, slot_sh)])
+        args = (self.params, ids_chunk, slot, n_valid, page_table,
+                lengths, pools)
+        if self._comm_capture is not None:
+            self._capture_comm_sig(
+                "seq_prefill", f"seq_prefill[chunk={chunk}]",
+                "_paged_prefill_sp_fn", args)
+        with self._serving_scope():
+            return self._dispatch("seq_prefill",
+                                  self._paged_prefill_sp_fn, *args)
 
     def decode_step(self, toks, active, page_table, lengths, pools,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
@@ -1598,6 +1683,14 @@ class InferenceEngine:
                               top_p)
         out = [int(t) for t in np.asarray(jax.device_get(toks))]
         return out[0] if single else out
+
+    def serving_seq_prefill_compile_count(self):
+        """Compiled signatures behind prefill_sequence_parallel —
+        bounded by the scheduler's chunk bucket set (one per distinct
+        chunk length), never by request churn: slot / n_valid /
+        positions are traced data, the chunk length is the only shape
+        in the signature."""
+        return jit_cache_size(getattr(self, "_paged_prefill_sp_fn", None))
 
     def serving_decode_compile_count(self):
         """Number of compiled signatures behind decode_step (the

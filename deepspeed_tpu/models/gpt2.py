@@ -181,14 +181,38 @@ class SelfAttention(nn.Module):
                 # legacy path
                 pools_out = paged_write(cache, page_ids, pos % ps,
                                         k[0], v[0])
-                k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
-                                              q.dtype)
-                mask = k_pos[None, None, :] <= positions[:, :, None]
-                bias = jnp.where(mask, 0.0,
-                                 jnp.finfo(jnp.float32).min)[:, None]
-                if alibi is not None:
-                    bias = bias + alibi
-                out = decode_attention(q, k_slot, v_slot, bias=bias)
+                seq_ax = cache.get("seq_axis")
+                if seq_ax is not None:
+                    # sequence-parallel prefill (static trace-time
+                    # marker — the engine's seq-parallel closure builds
+                    # the cache with it): the paged_write above already
+                    # landed the chunk's KV — with ids sequence-sharded,
+                    # GSPMD all-gathers k/v over the axis for the pool
+                    # scatter, the collective the comm ledger prices —
+                    # and attention runs distributed over the axis
+                    # against the pool gather.  Pages in the pool are
+                    # identical to the chunked path's, so decode/COW/
+                    # donation/handoff downstream never notice.
+                    assert alibi is None, \
+                        "sequence-parallel prefill does not support alibi"
+                    from deepspeed_tpu import comm as dist
+                    from deepspeed_tpu.sequence.prefill import (
+                        paged_prefill_attention)
+                    k_pref, v_pref = paged_gather(pools_out,
+                                                  pt[slot][None], q.dtype)
+                    out = paged_prefill_attention(
+                        q, k, v, k_pref, v_pref, positions[0, 0],
+                        dist.get_mesh(), axis=seq_ax,
+                        impl=cache["seq_impl"])
+                else:
+                    k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
+                                                  q.dtype)
+                    mask = k_pos[None, None, :] <= positions[:, :, None]
+                    bias = jnp.where(mask, 0.0,
+                                     jnp.finfo(jnp.float32).min)[:, None]
+                    if alibi is not None:
+                        bias = bias + alibi
+                    out = decode_attention(q, k_slot, v_slot, bias=bias)
             elif "widths" in cache:
                 # teacher-forced multi-token verify (speculative decode):
                 # b == slots, l == K+1 candidate tokens per slot. Column
@@ -524,7 +548,8 @@ class GPT2(nn.Module):
                 if paged:
                     layer_cache = dict(layer_cache,
                                        page_table=cache["page_table"])
-                    for key in ("slot", "n_valid", "active", "widths"):
+                    for key in ("slot", "n_valid", "active", "widths",
+                                "seq_axis", "seq_impl"):
                         if key in cache:
                             layer_cache[key] = cache[key]
                 pk = None if pld_keeps is None else pld_keeps[i]

@@ -121,13 +121,35 @@ class LlamaAttention(nn.Module):
                 page_ids = jnp.where(valid, pt[slot, pos // ps], num_pages)
                 pools_out = paged_write(cache, page_ids, pos % ps,
                                         k[0], v[0])
-                k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
-                                              q.dtype)
-                k_pos = jnp.arange(max_len)
-                mask = k_pos[None, None, :] <= positions[:, :, None]
-                bias = jnp.where(mask, 0.0,
-                                 jnp.finfo(jnp.float32).min)[:, None]
-                out = decode_attention(q, k_slot, v_slot, bias=bias)
+                seq_ax = cache.get("seq_axis")
+                if seq_ax is not None:
+                    # sequence-parallel prefill (static trace-time
+                    # marker, same contract as models/gpt2.py): the
+                    # write above already landed the chunk's KV in the
+                    # standard pool; attention runs distributed over
+                    # the sequence axis against the pool gather.  The
+                    # distributed transports take full-head k/v, so GQA
+                    # pools expand to h heads HERE only — the pool
+                    # itself stays grouped
+                    from deepspeed_tpu import comm as dist
+                    from deepspeed_tpu.sequence.prefill import (
+                        paged_prefill_attention)
+                    k_pref, v_pref = paged_gather(pools_out,
+                                                  pt[slot][None], q.dtype)
+                    rep = h // kv_h
+                    out = paged_prefill_attention(
+                        q, _repeat_kv(k, rep), _repeat_kv(v, rep),
+                        _repeat_kv(k_pref, rep), _repeat_kv(v_pref, rep),
+                        positions[0, 0], dist.get_mesh(), axis=seq_ax,
+                        impl=cache["seq_impl"])
+                else:
+                    k_slot, v_slot = paged_gather(pools_out, pt[slot][None],
+                                                  q.dtype)
+                    k_pos = jnp.arange(max_len)
+                    mask = k_pos[None, None, :] <= positions[:, :, None]
+                    bias = jnp.where(mask, 0.0,
+                                     jnp.finfo(jnp.float32).min)[:, None]
+                    out = decode_attention(q, k_slot, v_slot, bias=bias)
             elif "widths" in cache:
                 # teacher-forced multi-token verify (speculative decode):
                 # b == slots, l == K+1 candidate tokens; column j of
@@ -292,7 +314,8 @@ class Llama(nn.Module):
             if paged:
                 layer_cache = dict(layer_cache,
                                    page_table=cache["page_table"])
-                for key in ("slot", "n_valid", "active", "widths"):
+                for key in ("slot", "n_valid", "active", "widths",
+                            "seq_axis", "seq_impl"):
                     if key in cache:
                         layer_cache[key] = cache[key]
             x, new_c = block(cfg, name=f"layers_{i}")(x, positions,

@@ -55,10 +55,17 @@ def _flash_chunk(q, k, v, *, causal, scale):
     return out.astype(jnp.float32), lse
 
 
-def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
+def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None,
+                         init=None):
     """Per-shard body (call under shard_map, sequence-sharded on dim 1).
 
     q/k/v: [b, chunk, h, d] local chunks. Returns [b, chunk, h, d].
+
+    ``init`` optionally seeds the online-softmax carries ``(m, l, acc)``
+    (shapes [b, chunk, h] / [b, chunk, h] / [b, chunk, h, d], fp32) with
+    statistics of an already-attended block — the sequence-parallel
+    prefill path folds the paged PREFIX in this way, so the ring only
+    hops the fresh chunk.  The carries must be derived from q (vma).
 
     Each hop's chunk-vs-chunk product runs through the Pallas flash
     kernel (fp32 softmax statistics in VMEM; no [chunk, chunk] fp32
@@ -138,13 +145,17 @@ def ring_attention_local(q, k, v, axis_name, *, causal=True, scale=None):
         m, l, acc = merge(m, l, acc, o_i, lse_i)
         return (m, l, acc, k_nxt, v_nxt), None
 
-    # derive initial carries from q so they inherit its device-varying axes
-    # (a plain jnp.zeros would be "unvarying" and trip shard_map's scan
-    # carry type check whenever extra mesh axes like `data` are manual)
-    svar = 0.0 * q[..., 0].astype(jnp.float32)            # [b, c, h]
-    m0 = jnp.full((b, chunk, h), NEG_INF, jnp.float32) + svar
-    l0 = svar
-    acc0 = jnp.zeros((b, chunk, h, d), jnp.float32) + svar[..., None]
+    if init is None:
+        # derive initial carries from q so they inherit its device-varying
+        # axes (a plain jnp.zeros would be "unvarying" and trip shard_map's
+        # scan carry type check whenever extra mesh axes like `data` are
+        # manual)
+        svar = 0.0 * q[..., 0].astype(jnp.float32)        # [b, c, h]
+        m0 = jnp.full((b, chunk, h), NEG_INF, jnp.float32) + svar
+        l0 = svar
+        acc0 = jnp.zeros((b, chunk, h, d), jnp.float32) + svar[..., None]
+    else:
+        m0, l0, acc0 = init
     # n-1 hop-and-accumulate steps, then a final accumulate with no hop
     # (the last ppermute's result would be thrown away)
     (m, l, acc, k_last, v_last), _ = lax.scan(
@@ -163,6 +174,59 @@ def _bhd_spec(mesh, q_shape, axis):
         return ax if ax in mesh.shape and mesh.shape[ax] > 1 and \
             dim % mesh.shape[ax] == 0 else None
     return P(use("data", q_shape[0]), axis, use("model", q_shape[2]), None)
+
+
+def ring_prefill_attention_local(q, k, v, k_pref, v_pref, prefix_len,
+                                 axis_name, *, scale=None):
+    """Per-shard body for one sequence-parallel PREFILL chunk, ring
+    transport (heads need not divide the axis).
+
+    q/k/v: [b, L/P, h, d] — the chunk, sequence-sharded on dim 1;
+    k_pref/v_pref: [b, maxT, h, d] — the paged-pool gather, replicated
+    over the sequence axis (every rank attends ALL its local heads
+    against the full prefix); prefix_len: valid prefix rows.
+
+    The prefix is a prologue, not a hop: its online-softmax statistics
+    (m, l, acc) seed the ring carries, then the chunk hops the ring
+    exactly like :func:`ring_attention_local`.  The prefix sits entirely
+    BEHIND every query (chunk absolute positions start at prefix_len),
+    so its only mask is ``col < prefix_len`` — which also excludes the
+    chunk's own just-written pool rows.  ``prefix_len == 0`` degrades
+    for free: the all-masked prologue yields m = NEG_INF carries, the
+    exact empty seed the ring uses, and the merge's ``live`` guard
+    zeroes the fake mass."""
+    b, c, h, d = q.shape
+    scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
+    maxT = k_pref.shape[1]
+    logits_p = jnp.einsum("bqhd,bkhd->bhqk", q, k_pref,
+                          preferred_element_type=jnp.float32) * scale_
+    live = (jnp.arange(maxT) < prefix_len)[None, None, None, :]
+    logits_p = jnp.where(live, logits_p, NEG_INF)
+    mh = logits_p.max(axis=-1)                            # [b, h, c]
+    live_q = mh > NEG_INF / 2
+    p = jnp.where(live_q[..., None],
+                  jnp.exp(logits_p - mh[..., None]), 0.0)
+    l0 = p.sum(axis=-1)                                   # [b, h, c]
+    acc0 = jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v_pref.astype(jnp.float32))         # [b, c, h, d]
+    init = (mh.transpose(0, 2, 1), l0.transpose(0, 2, 1), acc0)
+    return ring_attention_local(q, k, v, axis_name, causal=True,
+                                scale=scale, init=init)
+
+
+def ring_prefill_attention(q, k, v, k_pref, v_pref, prefix_len, mesh, *,
+                           axis="sequence", scale=None):
+    """Sequence-parallel prefill chunk attention against a paged prefix,
+    ring transport.  q/k/v [b, L, h, d] (L shards over ``axis``);
+    k_pref/v_pref [b, maxT, h, d] stay sequence-replicated."""
+    spec = _bhd_spec(mesh, q.shape, axis)
+    pspec = P(spec[0], None, spec[2], None)
+    fn = functools.partial(ring_prefill_attention_local, axis_name=axis,
+                           scale=scale)
+    sharded = jax.shard_map(fn, mesh=mesh,
+                            in_specs=(spec, spec, spec, pspec, pspec, P()),
+                            out_specs=spec)
+    return sharded(q, k, v, k_pref, v_pref, prefix_len)
 
 
 def ring_attention_sharded(q, k, v, mesh, *, axis="sequence", causal=True,

@@ -7,9 +7,12 @@ mesh axis.
 """
 
 from deepspeed_tpu.ops.attention.ring import (ring_attention_local,  # noqa: F401
-                                              ring_attention_sharded)
+                                              ring_attention_sharded,
+                                              ring_prefill_attention)
 from deepspeed_tpu.ops.attention.ulysses import (  # noqa: F401
-    ulysses_attention_local, ulysses_attention_sharded)
+    ulysses_attention_local, ulysses_attention_sharded,
+    ulysses_prefill_attention)
+from deepspeed_tpu.sequence.prefill import paged_prefill_attention  # noqa: F401
 
 
 class DistributedAttention:

@@ -1,0 +1,50 @@
+"""Sequence-parallel PREFILL attention: transport dispatch.
+
+One entry point for the serving models' paged prefill branch: given the
+fresh chunk q/k/v (sequence-sharded), the paged-pool gather of the
+prefix (sequence-replicated), and the resolved transport, run the chunk
+attention distributed over the ``sequence`` mesh axis.
+
+Transport selection lives in ``serving.sharding.resolve_sequence_plan``
+(the scheduler/engine resolve it ONCE, at serving setup); this module
+only dispatches on the already-chosen ``impl`` string, which reaches
+the jitted model code as a static trace-time cache value:
+
+* ``"ulysses"`` — all-to-all head-scatter/seq-gather
+  (:func:`~deepspeed_tpu.ops.attention.ulysses.ulysses_prefill_attention`):
+  each rank runs full-chunk attention on a head subset; requires
+  heads-per-model-shard % axis size == 0.
+* ``"ring"`` — ppermute hops
+  (:func:`~deepspeed_tpu.ops.attention.ring.ring_prefill_attention`):
+  the prefix seeds the online-softmax carries and the chunk hops the
+  ring; any head count rides the axis.
+
+Both land their KV through the standard ``paged_write`` contract in the
+model code BEFORE this call — pages in the pool are the source of truth
+and everything downstream (decode, prefix-cache donation, COW, spec
+verify, handoff) is unchanged.
+"""
+
+from deepspeed_tpu.ops.attention.ring import ring_prefill_attention
+from deepspeed_tpu.ops.attention.ulysses import ulysses_prefill_attention
+
+
+def paged_prefill_attention(q, k, v, k_pref, v_pref, prefix_len, mesh, *,
+                            axis="sequence", impl="ulysses", scale=None):
+    """Distributed chunk-vs-[prefix|chunk] attention.
+
+    q/k/v: [b, L, h, d] the chunk (L shards over ``axis``);
+    k_pref/v_pref: [b, maxT, h, d] the paged-pool gather (GQA callers
+    expand kv heads to h first); prefix_len: traced scalar count of
+    valid prefix rows.  Returns [b, L, h, d], sequence-sharded like q.
+    """
+    if impl == "ulysses":
+        return ulysses_prefill_attention(q, k, v, k_pref, v_pref,
+                                         prefix_len, mesh, axis=axis,
+                                         scale=scale)
+    if impl == "ring":
+        return ring_prefill_attention(q, k, v, k_pref, v_pref,
+                                      prefix_len, mesh, axis=axis,
+                                      scale=scale)
+    raise ValueError(f"unknown sequence-parallel impl {impl!r} "
+                     "(expected 'ulysses' or 'ring')")

@@ -57,6 +57,12 @@ class ServingMetrics:
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
         self.handoffs = 0              # prefill->decode KV chains handed
         self.handoff_tokens = 0        # prefilled positions transferred
+        # sequence-parallel prefill (long-context routing)
+        self.seq_prefill_routed = 0    # prompts routed onto the sp path
+        self.seq_prefill_chunks = 0    # sp chunk dispatches
+        self.seq_prefill_tokens = 0    # prompt tokens landed via sp chunks
+        self.seq_prefill_degraded = 0  # long prompts kept on chunked path
+        self.seq_prefill_shed = 0      # prompts shed on the reserve cap
         # decoding-policy subsystem (serving/sampling/)
         self.sampled_requests = 0      # intakes with a sampled policy
         self.grammar_requests = 0      # intakes carrying a grammar
@@ -136,6 +142,36 @@ class ServingMetrics:
                 ("serving/prefix_cache/prefill_tokens_saved",
                  self.prefill_tokens_saved, step),
             ])
+
+    def record_seq_prefill_route(self, step, prompt_tokens, reserved_pages):
+        """One admission routed onto the sequence-parallel prefill path:
+        the full ``reserved_pages`` page chain is held up front so the
+        wide chunks never stall mid-prompt on allocation."""
+        self.seq_prefill_routed += 1
+        self._write([
+            ("serving/seq_prefill/routed", prompt_tokens, step),
+            ("serving/seq_prefill/reserved_pages", reserved_pages, step),
+        ])
+
+    def record_seq_prefill_chunk(self, step, tokens):
+        self.seq_prefill_chunks += 1
+        self.seq_prefill_tokens += tokens
+        self._write([("serving/seq_prefill/chunk_tokens", tokens, step)])
+
+    def record_seq_prefill_degrade(self, step):
+        """A prompt crossed the length threshold but stayed on the
+        chunked path (no usable sequence axis, or the up-front page
+        reservation self-preempted)."""
+        self.seq_prefill_degraded += 1
+        self._write([("serving/seq_prefill/degraded", 1, step)])
+
+    def record_seq_prefill_shed(self, step, pages_needed):
+        """A long prompt's up-front reservation exceeded the per-request
+        cap (prefill_reserve_frac) and the request was shed with reason
+        rather than allowed to starve concurrent short requests."""
+        self.seq_prefill_shed += 1
+        self._write([
+            ("serving/seq_prefill/shed_reserve_cap", pages_needed, step)])
 
     def record_cache_eviction(self, step, pages):
         """Cached pages drained back to the free list under pool
@@ -419,6 +455,11 @@ class ServingMetrics:
             "spec_degraded": self.spec_degraded,
             "handoffs": self.handoffs,
             "handoff_tokens": self.handoff_tokens,
+            "seq_prefill_routed": self.seq_prefill_routed,
+            "seq_prefill_chunks": self.seq_prefill_chunks,
+            "seq_prefill_tokens": self.seq_prefill_tokens,
+            "seq_prefill_degraded": self.seq_prefill_degraded,
+            "seq_prefill_shed": self.seq_prefill_shed,
             "sampled_requests": self.sampled_requests,
             "grammar_requests": self.grammar_requests,
             "policy_dispatches": self.policy_dispatches,

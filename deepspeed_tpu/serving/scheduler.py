@@ -249,7 +249,9 @@ class ServingScheduler:
     """Continuous-batching serving loop over an ``InferenceEngine``."""
 
     def __init__(self, engine, *, num_slots=8, num_pages=64, page_size=None,
-                 max_pages_per_slot=None, prefill_chunk=16, max_queue=256,
+                 max_pages_per_slot=None, prefill_chunk=16,
+                 seq_parallel_threshold=None, prefill_reserve_frac=None,
+                 max_queue=256,
                  monitor=None, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, completed_history=4096, decode_horizon_steps=8,
                  overlap=True, prefix_cache=False, prefix_cache_pages=None,
@@ -472,6 +474,46 @@ class ServingScheduler:
             b = min(b * 2, self.decode_horizon_steps)
             buckets.add(b)
         self.horizon_buckets = sorted(buckets)
+        # ---- sequence-parallel prefill routing (long-context path) ----
+        # prompts with >= seq_parallel_threshold tokens left to prefill
+        # route through engine.prefill_sequence_parallel: the chunk
+        # shards over the mesh's `sequence` axis, so one step retires
+        # axis_size x the per-device chunk rows.  The transport
+        # (ulysses vs ring) was resolved ONCE by the engine against the
+        # mesh + model (sharding.resolve_sequence_plan); an unusable
+        # axis degrades every routed prompt to the chunked loop with a
+        # `serving/seq_prefill/degraded` breadcrumb instead of failing.
+        # Chunk lengths quantize to power-of-two multiples of the axis
+        # size up to prefill_chunk * axis_size, so the compile count is
+        # pinned by the bucket set exactly like decode horizons.
+        self.seq_parallel_threshold = int(seq_parallel_threshold or 0)
+        self.seq_plan = None
+        self.sp_chunk_buckets = []
+        self._sp_degrade_reason = None
+        if self.seq_parallel_threshold > 0:
+            plan = getattr(engine, "seq_parallel_plan", lambda: None)()
+            if plan is not None and plan.usable:
+                self.seq_plan = plan
+                buckets, b = {plan.size}, plan.size
+                top = self.prefill_chunk * plan.size
+                while b < top:
+                    b = min(b * 2, top)
+                    buckets.add(b)
+                self.sp_chunk_buckets = sorted(buckets)
+            else:
+                self._sp_degrade_reason = None if plan is None \
+                    else plan.reason
+        # fairness: cap the pages ONE prefilling request may pre-reserve
+        # up front to this fraction of the pool (None = num_pages — the
+        # admission-time free-pages check is then the only gate).  A
+        # routed prompt whose full chain exceeds the cap is shed with
+        # an explicit reason instead of starving every waiting admission
+        # behind a monopolized pool.
+        self.prefill_reserve_frac = None if prefill_reserve_frac is None \
+            else float(prefill_reserve_frac)
+        self.prefill_reserve_cap = self.kv.pool.num_pages \
+            if self.prefill_reserve_frac is None else \
+            max(1, int(self.kv.pool.num_pages * self.prefill_reserve_frac))
         self.overlap = bool(overlap)
         self._inflight = deque()       # dispatched horizons, FIFO, depth<=2
         self._zombies = set()          # slots terminated host-side while a
@@ -973,7 +1015,13 @@ class ServingScheduler:
                 req.prompt, limit=len(req.prompt) - 1)
             pending = max(1, pending - len(full) * self.kv.page_size
                           - plen)
-        prefill = -(-pending // self.prefill_chunk)
+        chunk = self.prefill_chunk
+        if self.seq_plan is not None and self.seq_parallel_threshold > 0 \
+                and pending >= self.seq_parallel_threshold:
+            # priced at the widest sp bucket: routed prompts retire
+            # axis_size x prefill_chunk tokens per step
+            chunk = self.sp_chunk_buckets[-1]
+        prefill = -(-pending // chunk)
         horizons = -(-max(1, req.remaining_new) // self.decode_horizon_steps)
         return prefill + horizons
 
@@ -1193,6 +1241,8 @@ class ServingScheduler:
                     # ONE request, never the admission loop
                     self._close_slot(slot, FAILED,
                                      f"{type(e).__name__}: {e}")
+            if self.slot_req[slot] is req:
+                self._route_seq_parallel(slot, req)
 
     def _attach_prefix(self, slot, req, hit):
         """Map a matched cached chain into the admitted slot: full pages
@@ -1245,6 +1295,65 @@ class ServingScheduler:
                                           len(req.prompt)})
         self.metrics.record_prefix(self.step_idx, cached, len(req.prompt))
 
+    def _route_seq_parallel(self, slot, req):
+        """Admission-time routing onto the sequence-parallel prefill
+        path.  A routed prompt pre-reserves its FULL page chain up
+        front: the wide sharded chunks retire ``axis_size`` pages of KV
+        per dispatch, and an allocation stall mid-chunk would waste the
+        whole collective.  Reservation is fairness-capped
+        (``prefill_reserve_frac``): a prompt whose chain exceeds the
+        cap is shed with an explicit reason, because holding most of
+        the pool through a long prefill starves every short request
+        behind it.  Degrades (no usable axis, reservation
+        self-preempted) fall back to the chunked loop with a
+        breadcrumb — routing is an optimization, never a correctness
+        gate."""
+        req.seq_parallel = False
+        pending = len(req.prompt) - req.prefill_pos
+        if self.seq_parallel_threshold <= 0 \
+                or pending < self.seq_parallel_threshold:
+            return
+        if self.seq_plan is None:
+            self.metrics.record_seq_prefill_degrade(self.step_idx)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "seq_prefill_degrade", track=slot, rid=req.trace_rid,
+                    args={"reason": self._sp_degrade_reason})
+            return
+        need = self.kv.pages_needed(slot, len(req.prompt))
+        if need > self.prefill_reserve_cap:
+            self.metrics.record_seq_prefill_shed(self.step_idx, need)
+            self._close_slot(
+                slot, SHED,
+                f"seq-parallel reserve cap: prompt needs {need} pages, "
+                f"cap is {self.prefill_reserve_cap} of "
+                f"{self.kv.pool.num_pages}")
+            return
+        try:
+            if not self._grow_or_evict(slot, len(req.prompt)):
+                # reservation pressure evicted THIS request; it is back
+                # in the waiting queue and will re-route on re-admission
+                return
+        except (PagePoolExhausted, ValueError) as e:
+            self._close_slot(slot, SHED, f"page capacity: {e}")
+            return
+        req.seq_parallel = True
+        self.metrics.record_seq_prefill_route(self.step_idx, pending, need)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "seq_prefill_route", track=slot, rid=req.trace_rid,
+                args={"tokens": pending, "reserved_pages": need,
+                      "impl": self.seq_plan.impl})
+
+    def _sp_chunk(self, pending):
+        """Smallest sp chunk bucket covering ``pending`` tokens (the
+        largest bucket when none does) — same quantization idea as the
+        decode-horizon buckets, pinning one jit signature per bucket."""
+        for b in self.sp_chunk_buckets:
+            if b >= pending:
+                return b
+        return self.sp_chunk_buckets[-1]
+
     def _prefill(self):
         """One prompt chunk per prefilling slot.  The per-slot body is
         attributable to ONE request, so containment wraps it: a
@@ -1257,20 +1366,30 @@ class ServingScheduler:
             if req is None or req.state != PREFILL:
                 continue
             try:
+                sp = getattr(req, "seq_parallel", False) \
+                    and self.seq_plan is not None
+                width = self._sp_chunk(len(req.prompt) - req.prefill_pos) \
+                    if sp else self.prefill_chunk
                 chunk = req.prompt[req.prefill_pos:
-                                   req.prefill_pos + self.prefill_chunk]
+                                   req.prefill_pos + width]
                 n_valid = len(chunk)
                 if not self._grow_or_evict(slot, req.prefill_pos + n_valid):
                     continue      # self-preempted: back in the queue
-                ids = np.zeros((1, self.prefill_chunk), np.int32)
+                ids = np.zeros((1, width), np.int32)
                 ids[0, :n_valid] = chunk
                 with self.tracer.span(
                         "prefill_chunk", track=slot, rid=req.trace_rid,
-                        args={"tokens": n_valid, "pos": req.prefill_pos}
+                        args={"tokens": n_valid, "pos": req.prefill_pos,
+                              "seq_parallel": sp}
                         if self.tracer.enabled else None):
-                    logits, self.pools = self.engine.prefill_into_slots(
+                    fn = self.engine.prefill_sequence_parallel if sp \
+                        else self.engine.prefill_into_slots
+                    logits, self.pools = fn(
                         ids, slot, n_valid, self.kv.table, self.lengths,
                         self.pools)
+                if sp:
+                    self.metrics.record_seq_prefill_chunk(self.step_idx,
+                                                          n_valid)
                 self.lengths[slot] += n_valid
                 req.prefill_pos += n_valid
                 if req.prefill_pos == len(req.prompt):
@@ -2424,6 +2543,21 @@ class ServingScheduler:
             "decode_horizon_steps": self.decode_horizon_steps,
             "horizon_buckets": list(self.horizon_buckets),
             "overlap": self.overlap,
+            # sequence-parallel prefill: the resolved transport (or why
+            # it degraded), the routing threshold, and the fairness cap
+            # on up-front page reservations
+            "seq_parallel_threshold": self.seq_parallel_threshold,
+            "seq_parallel_axis": None if self.seq_plan is None
+            else self.seq_plan.axis,
+            "seq_parallel_impl": None if self.seq_plan is None
+            else self.seq_plan.impl,
+            "seq_parallel_degrade_reason": self._sp_degrade_reason,
+            "sp_chunk_buckets": list(self.sp_chunk_buckets),
+            "prefill_reserve_cap": self.prefill_reserve_cap,
+            "seq_prefill_routed": m.seq_prefill_routed,
+            "seq_prefill_chunks": m.seq_prefill_chunks,
+            "seq_prefill_degraded": m.seq_prefill_degraded,
+            "seq_prefill_shed": m.seq_prefill_shed,
             # decoding-policy subsystem: the scheduler-wide default
             # policy label, and how much of the traffic actually used
             # per-request sampling / grammar constraints

@@ -52,6 +52,7 @@ SERVING_AXIS_RULES = (
     ("slots", "data"),
     ("pages", None),
     ("vocab", "model"),
+    ("sequence", "sequence"),
 )
 
 
@@ -135,6 +136,56 @@ class ServingShardingConfig:
         return ServingShardings(mesh=mesh, config=self, kv_axis=kv_ax,
                                 slot_axis=slot_ax, page_axis=page_ax,
                                 vocab_axis=vocab_ax)
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqParallelPlan:
+    """Resolved sequence-parallel prefill plan for one mesh + model.
+
+    ``axis`` is the mesh axis the prompt chunk shards over, ``size``
+    its device count, ``impl`` the attention transport — ``"ulysses"``
+    (all-to-all head-scatter/seq-gather) when the per-model-shard head
+    count divides the axis, ``"ring"`` (ppermute hops) otherwise.  When
+    the path is unusable ``axis`` is None and ``reason`` says why; the
+    scheduler degrades to the chunked loop instead of crashing."""
+    axis: object = None
+    size: int = 1
+    impl: object = None
+    reason: object = None
+
+    @property
+    def usable(self):
+        return self.axis is not None
+
+
+def resolve_sequence_plan(mesh, config, *, num_heads, num_kv_heads):
+    """Pick the sequence-parallel transport for one mesh + model.
+
+    Decision table (mirrored in serving/README.md):
+
+    * no ``sequence`` mesh axis, or size 1 -> degrade (chunked loop);
+    * heads-per-model-shard % axis size == 0 -> ``ulysses`` — the
+      all-to-all trades the seq split for a head split, which needs a
+      whole number of heads per sequence rank;
+    * otherwise -> ``ring`` — ppermute hops never split heads, so any
+      head count rides the axis.
+
+    KV heads are NOT a constraint here: the paged landing goes through
+    ``paged_write`` against the kv-head-sharded pool exactly like the
+    chunked path, and ring/ulysses run on the post-projection
+    full-head q/k/v of the chunk."""
+    ax = (config or ServingShardingConfig()).axis("sequence")
+    size = _mesh_axis_size(mesh, ax)
+    if ax is None or ax not in getattr(mesh, "shape", {}):
+        return SeqParallelPlan(reason=f"mesh has no '{ax}' axis")
+    if size <= 1:
+        return SeqParallelPlan(reason=f"mesh axis '{ax}' has size 1")
+    model_sz = _mesh_axis_size(mesh, (config or ServingShardingConfig())
+                               .axis("kv_heads"))
+    local_heads = num_heads // max(1, model_sz)
+    if local_heads % size == 0:
+        return SeqParallelPlan(axis=ax, size=size, impl="ulysses")
+    return SeqParallelPlan(axis=ax, size=size, impl="ring")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -218,6 +218,17 @@ EVENT_TAXONOMY = {
     # recompile watchdog
     "serving/comm/recompile":
         "steady-state recompile detected (value = cumulative count)",
+    # ----------------------- sequence-parallel prefill (long context)
+    "serving/seq_prefill/routed":
+        "a prompt routed onto the sp path (value = pending tokens)",
+    "serving/seq_prefill/reserved_pages":
+        "pages the routed prompt pre-reserved for its full chain",
+    "serving/seq_prefill/chunk_tokens":
+        "prompt tokens one sequence-sharded prefill chunk retired",
+    "serving/seq_prefill/degraded":
+        "a long prompt stayed on the chunked path (no usable axis)",
+    "serving/seq_prefill/shed_reserve_cap":
+        "a prompt shed on the reserve cap (value = pages it needed)",
 }
 
 # the eager comms logger's periodic report (comm.log_summary) routes

@@ -487,6 +487,20 @@ HEALTH_SCHEMA = {
     # stale-epoch calls it rejected/cancelled
     "ha_epoch": (int, type(None)),
     "ha_fenced": (int,),
+    # sequence-parallel prefill (PR 18): the resolved long-context
+    # routing state — threshold, transport (or why it degraded),
+    # compile-pinned chunk buckets, the fairness reserve cap, and the
+    # routing/shed counters admission dashboards key off
+    "seq_parallel_threshold": (int,),
+    "seq_parallel_axis": (str, type(None)),
+    "seq_parallel_impl": (str, type(None)),
+    "seq_parallel_degrade_reason": (str, type(None)),
+    "sp_chunk_buckets": (list,),
+    "prefill_reserve_cap": (int,),
+    "seq_prefill_routed": (int,),
+    "seq_prefill_chunks": (int,),
+    "seq_prefill_degraded": (int,),
+    "seq_prefill_shed": (int,),
 }
 
 

@@ -142,6 +142,10 @@ def _drive_all_serving_events(m):
     m.record_policy_dispatch(1, 3)
     m.record_grammar_violation(1, rid=1)
     m.record_handoff(1, 32)
+    m.record_seq_prefill_route(1, 256, 16)
+    m.record_seq_prefill_chunk(1, 128)
+    m.record_seq_prefill_degrade(1)
+    m.record_seq_prefill_shed(1, 33)
     m.record_mem(1, {"slot": 3, "prefix_shared": 2, "prefix_sole": 1,
                      "handoff": 0, "draft": 0, "unattributed": 0,
                      "free": 10}, 0.625, 1.25)

@@ -1,0 +1,358 @@
+"""Sequence-parallel paged prefill (PR 18): Ulysses/ring transports,
+the engine primitive's token-exact equivalence with chunked prefill,
+scheduler routing (threshold, reserve-cap fairness, degrade), and the
+pinned compile counts.  Runs on the conftest-forced 8-device CPU mesh.
+
+Also the first direct tier-1 coverage of the seed sequence modules
+(ops/attention/ulysses.py, ops/attention/ring.py): all-to-all layout
+round-trips and the ring ppermute against a jnp reference.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.models.llama import Llama, llama_tiny
+from deepspeed_tpu.ops.attention.ring import (NEG_INF,
+                                              ring_prefill_attention)
+from deepspeed_tpu.ops.attention.ulysses import (
+    ulysses_attention_sharded, ulysses_prefill_attention)
+from deepspeed_tpu.ops.attention.reference import mha_reference
+from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.serving import PagedKVManager, ServingScheduler
+from deepspeed_tpu.serving.sharding import resolve_sequence_plan
+
+
+# ------------------------------------------------- transport unit tests
+
+
+def _ref_prefill(q, k, v, k_pref, v_pref, prefix_len):
+    """jnp reference for one prefill chunk against a paged prefix: ONE
+    softmax over [masked prefix | causal chunk], float32 throughout."""
+    b, L, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    maxT = k_pref.shape[1]
+    lp = jnp.einsum("bqhd,bkhd->bhqk", q, k_pref,
+                    preferred_element_type=jnp.float32) * scale
+    lp = jnp.where((jnp.arange(maxT) < prefix_len)[None, None, None],
+                   lp, NEG_INF)
+    lc = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) * scale
+    lc = jnp.where(jnp.tril(jnp.ones((L, L), bool))[None, None],
+                   lc, NEG_INF)
+    logits = jnp.concatenate([lp, lc], axis=-1)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w[..., :maxT],
+                     v_pref.astype(jnp.float32)) + \
+        jnp.einsum("bhqk,bkhd->bqhd", w[..., maxT:],
+                   v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape, np.float32))
+
+
+def test_ulysses_all_to_all_round_trip():
+    """The seq<->head all-to-all pair is an exact bijection, and the
+    forward swap hands rank j precisely head block j of the full
+    sequence — the layout fact the prefix head-sharding relies on."""
+    mesh = make_mesh(MeshConfig(sequence=8))
+    rng = np.random.default_rng(0)
+    x = _rand(rng, 1, 32, 8, 4)          # [b, L, h, d], L and h = 8*k
+
+    def round_trip(x):
+        y = lax.all_to_all(x, "sequence", split_axis=2, concat_axis=1,
+                           tiled=True)
+        return lax.all_to_all(y, "sequence", split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    spec = P(None, "sequence", None, None)
+    rt = jax.shard_map(round_trip, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec)(x)
+    np.testing.assert_array_equal(np.asarray(rt), np.asarray(x))
+
+    fwd = jax.shard_map(
+        functools.partial(lax.all_to_all, axis_name="sequence",
+                          split_axis=2, concat_axis=1, tiled=True),
+        mesh=mesh, in_specs=(spec,),
+        out_specs=P(None, None, "sequence", None))(x)
+    # rank j's output block (head-sharded dim 2) is the full-L slice of
+    # head block j
+    np.testing.assert_array_equal(np.asarray(fwd), np.asarray(x))
+
+
+def test_ulysses_attention_matches_reference():
+    """Seed module coverage: the revived Ulysses full-attention path is
+    exact against the unsharded reference."""
+    mesh = make_mesh(MeshConfig(sequence=8))
+    rng = np.random.default_rng(1)
+    q, k, v = (_rand(rng, 2, 32, 8, 16) for _ in range(3))
+    got = ulysses_attention_sharded(q, k, v, mesh, causal=True)
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("prefix_len", [
+    20, pytest.param(0, marks=pytest.mark.slow)])
+def test_ulysses_prefill_matches_reference(prefix_len):
+    mesh = make_mesh(MeshConfig(sequence=8))
+    rng = np.random.default_rng(2)
+    q, k, v = (_rand(rng, 1, 32, 8, 16) for _ in range(3))
+    k_pref, v_pref = (_rand(rng, 1, 24, 8, 16) for _ in range(2))
+    got = ulysses_prefill_attention(q, k, v, k_pref, v_pref,
+                                    jnp.int32(prefix_len), mesh)
+    want = _ref_prefill(q, k, v, k_pref, v_pref, prefix_len)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_prefill_on_model_x_sequence_mesh():
+    """The tuple-axis P((model, sequence)) prefix head spec: with heads
+    split over model AND sequence, rank (m, j) must hold exactly the
+    head block its all-to-all output computes."""
+    mesh = make_mesh(MeshConfig(sequence=4, model=2))
+    rng = np.random.default_rng(3)
+    q, k, v = (_rand(rng, 1, 16, 8, 8) for _ in range(3))
+    k_pref, v_pref = (_rand(rng, 1, 16, 8, 8) for _ in range(2))
+    got = ulysses_prefill_attention(q, k, v, k_pref, v_pref,
+                                    jnp.int32(10), mesh)
+    want = _ref_prefill(q, k, v, k_pref, v_pref, 10)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("prefix_len", [
+    20, pytest.param(0, marks=pytest.mark.slow)])
+def test_ring_prefill_matches_reference(prefix_len):
+    """Ring transport (ppermute hops + prologue-seeded carries) with a
+    head count (4) that does NOT divide the axis (8) — the case the
+    plan routes away from Ulysses."""
+    mesh = make_mesh(MeshConfig(sequence=8))
+    rng = np.random.default_rng(4)
+    q, k, v = (_rand(rng, 1, 32, 4, 16) for _ in range(3))
+    k_pref, v_pref = (_rand(rng, 1, 24, 4, 16) for _ in range(2))
+    got = ring_prefill_attention(q, k, v, k_pref, v_pref,
+                                 jnp.int32(prefix_len), mesh)
+    want = _ref_prefill(q, k, v, k_pref, v_pref, prefix_len)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_resolve_sequence_plan_decision_table():
+    """The README decision table, case by case."""
+    m8 = make_mesh(MeshConfig(sequence=8))
+    p = resolve_sequence_plan(m8, None, num_heads=8, num_kv_heads=8)
+    assert (p.axis, p.size, p.impl) == ("sequence", 8, "ulysses")
+    p = resolve_sequence_plan(m8, None, num_heads=4, num_kv_heads=4)
+    assert (p.axis, p.impl) == ("sequence", "ring")
+    m42 = make_mesh(MeshConfig(sequence=4, model=2))
+    p = resolve_sequence_plan(m42, None, num_heads=8, num_kv_heads=8)
+    assert (p.size, p.impl) == (4, "ulysses")   # 8/2 = 4 heads % 4 == 0
+    flat = make_mesh(MeshConfig(data=8))
+    p = resolve_sequence_plan(flat, None, num_heads=8, num_kv_heads=8)
+    assert not p.usable and "size 1" in p.reason
+
+
+# --------------------------------------------- engine primitive oracle
+
+
+def _build_engine(model_fn, mesh):
+    eng = deepspeed_tpu.init_inference(model=model_fn(), dtype="float32",
+                                       mesh=dict(mesh))
+    eng.init_params()
+    return eng
+
+
+# Tier-1 keeps one representative per transport x mesh family (ring on
+# the flat sequence=8 axis via GPT-2, Ulysses on the hybrid 4x2 via
+# Llama); the mirrored model/mesh combinations cross-check the same
+# code paths and run in the slow lane (PR-15/17 wall-time precedent).
+@pytest.mark.parametrize("mesh_axes,model_fn,heads", [
+    ({"sequence": 8}, lambda: GPT2(gpt2_tiny()), 4),
+    ({"sequence": 4, "data": 2},
+     lambda: Llama(llama_tiny(num_layers=2)), 4),
+    pytest.param({"sequence": 4, "data": 2},
+                 lambda: GPT2(gpt2_tiny()), 4,
+                 marks=pytest.mark.slow),
+    pytest.param({"sequence": 8},
+                 lambda: Llama(llama_tiny(num_layers=2)), 4,
+                 marks=pytest.mark.slow),
+], ids=["gpt2-seq8-ring", "llama-4x2-ulysses",
+        "gpt2-4x2-ulysses", "llama-seq8-ring"])
+def test_engine_sp_prefill_token_exact_vs_chunked(mesh_axes, model_fn,
+                                                  heads):
+    """The tentpole oracle: prefill_sequence_parallel lands the SAME
+    pages and boundary logits as the chunked prefill_into_slots —
+    ring on sequence=8 (4 heads don't divide 8), Ulysses on
+    sequence=4 x data=2 — with ONE compiled signature per chunk
+    shape."""
+    eng = _build_engine(model_fn, mesh_axes)
+    plan = eng.seq_parallel_plan()
+    assert plan.usable
+    assert plan.impl == ("ring" if plan.size == 8 else "ulysses")
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 256, 32).astype(np.int32)
+    outs = []
+    for use_sp in (False, True):
+        pools = eng.init_paged_cache(num_pages=16, page_size=16)
+        kv = PagedKVManager(16, 16, num_slots=4, max_pages_per_slot=4)
+        lengths = np.zeros(4, np.int32)
+        assert kv.ensure_capacity(0, len(prompt))
+        logits = None
+        for pos in range(0, len(prompt), 16):
+            ids = np.zeros((1, 16), np.int32)
+            n_valid = min(16, len(prompt) - pos)
+            ids[0, :n_valid] = prompt[pos:pos + n_valid]
+            fn = eng.prefill_sequence_parallel if use_sp \
+                else eng.prefill_into_slots
+            logits, pools = fn(ids, 0, n_valid, kv.table, lengths, pools)
+            lengths[0] += n_valid
+        outs.append((np.asarray(logits),
+                     [np.asarray(L["k_pages"]) for L in pools["layers"]]))
+    (lg0, kp0), (lg1, kp1) = outs
+    assert int(lg0.argmax()) == int(lg1.argmax())
+    assert float(np.max(np.abs(lg0 - lg1))) < 5e-3
+    for a, b in zip(kp0, kp1):
+        # pools are bfloat16: equal to one ulp
+        assert float(np.max(np.abs(a.astype(np.float32) -
+                                   b.astype(np.float32)))) < 4e-3
+    assert eng.serving_seq_prefill_compile_count() == 1
+
+
+# ----------------------------------------------- scheduler-level oracle
+
+
+@pytest.fixture(scope="module")
+def seq8_engine():
+    return _build_engine(lambda: GPT2(gpt2_tiny()), {"sequence": 8})
+
+
+def _oracle(engine, prompts, max_new):
+    return [[int(t) for t in
+             engine.generate(p[None], max_new_tokens=m,
+                             do_sample=False)[0, len(p):]]
+            for p, m in zip(prompts, max_new)]
+
+
+def test_scheduler_sp_oracle_eviction_and_decode(seq8_engine):
+    """Routed long prompts + short fillers through a pool small enough
+    to force eviction stay token-exact vs per-request generate(), and
+    the routed requests CONTINUE through fused decode afterwards —
+    pages landed in the standard pool, so decode never notices."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (64, 7, 64)]
+    max_new = [6, 8, 6]
+    want = _oracle(seq8_engine, prompts, max_new)
+    # 9 pages fill exactly at admission (4 + 1 + 4 up-front reserves):
+    # the first routed request's decode past token 64 needs a 5th page
+    # and must preempt
+    sched = ServingScheduler(seq8_engine, num_slots=3, num_pages=9,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8, seq_parallel_threshold=32)
+    reqs = [sched.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_new)]
+    got = sched.run()
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+    m = sched.metrics
+    # >= 2: a preempted long request re-routes on re-admission, so the
+    # routing-event count can exceed the number of long prompts
+    assert m.seq_prefill_routed >= 2
+    assert m.seq_prefill_chunks >= 2
+    assert m.preemptions > 0, \
+        "pool was sized to force eviction; none happened"
+    assert sched.kv.pool.pages_in_use == 0
+    # compile pinning: one jit signature per sp chunk bucket used
+    used = seq8_engine.serving_seq_prefill_compile_count()
+    assert 1 <= used <= len(sched.sp_chunk_buckets)
+
+
+def test_scheduler_sp_prefix_cache_full_hit_and_cow(seq8_engine):
+    """Routed prompts compose with the prefix cache: a full-page hit
+    skips cached pages before routing (pending shrinks), and a
+    partial-page match COW-copies then sp-prefills the tail — both
+    token-exact."""
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 256, 64).astype(np.int32)
+    tail = rng.integers(0, 256, 48).astype(np.int32)
+    prompts = [base,
+               np.concatenate([base, tail]),   # partial/COW on page 5
+               base.copy()]                    # full hit (limit len-1)
+    max_new = [4, 4, 4]
+    want = _oracle(seq8_engine, prompts, max_new)
+    sched = ServingScheduler(seq8_engine, num_slots=2, num_pages=24,
+                             page_size=16, max_pages_per_slot=12,
+                             prefill_chunk=8, seq_parallel_threshold=32,
+                             prefix_cache=True)
+    got, reqs = {}, []
+    for p, m in zip(prompts, max_new):     # sequential: deterministic
+        r = sched.submit(p, max_new_tokens=m)   # cache state per submit
+        got.update(sched.run())
+        reqs.append(r)
+    for r, w in zip(reqs, want):
+        assert got[r.rid] == w
+    assert sched.metrics.prefix_hits >= 2
+    assert sched.prefix_cache.cow_copies >= 1
+    # request 2's pending after the full hit is below the threshold —
+    # routing prices POST-cache pending, so it stays chunked
+    assert sched.metrics.seq_prefill_routed == 2
+
+
+def test_scheduler_degrades_without_sequence_axis():
+    eng = _build_engine(lambda: GPT2(gpt2_tiny()),
+                        {"data": 1, "model": 1})
+    sched = ServingScheduler(eng, num_slots=2, num_pages=16,
+                             page_size=16, max_pages_per_slot=8,
+                             prefill_chunk=8, seq_parallel_threshold=16)
+    assert sched.seq_plan is None
+    rng = np.random.default_rng(2)
+    r = sched.submit(rng.integers(0, 256, 40).astype(np.int32),
+                     max_new_tokens=4)
+    sched.run()
+    assert r.state == "finished"
+    assert sched.metrics.seq_prefill_degraded == 1
+    h = sched.health()
+    assert h["seq_parallel_impl"] is None
+    assert "size 1" in h["seq_parallel_degrade_reason"]
+
+
+def test_reserve_cap_sheds_and_admits_shorts(seq8_engine):
+    """Satellite 2 fairness: on a 6-slot server, a long prompt whose
+    up-front reservation exceeds the cap is shed WITH REASON while
+    short requests keep being admitted and finish; a long prompt
+    under the cap prefills concurrently with the shorts (their first
+    tokens land while it is still prefilling)."""
+    rng = np.random.default_rng(3)
+    sched = ServingScheduler(seq8_engine, num_slots=6, num_pages=32,
+                             page_size=16, max_pages_per_slot=32,
+                             prefill_chunk=4, seq_parallel_threshold=48,
+                             prefill_reserve_frac=0.5)   # cap: 16 pages
+    over = sched.submit(rng.integers(0, 256, 400).astype(np.int32),
+                        max_new_tokens=4)    # needs 25 pages > cap
+    under = sched.submit(rng.integers(0, 256, 192).astype(np.int32),
+                         max_new_tokens=4)   # needs 13 pages <= cap
+    shorts = [sched.submit(rng.integers(0, 256, 7).astype(np.int32),
+                           max_new_tokens=4) for _ in range(4)]
+    sched.run()
+    assert over.state == "shed" and "reserve cap" in over.error
+    assert under.state == "finished"
+    for s in shorts:
+        assert s.state == "finished", (s.state, s.error)
+    assert sched.metrics.seq_prefill_shed == 1
+    assert sched.metrics.seq_prefill_routed == 1
+    # concurrency witness: every short emitted its first token before
+    # the routed long request did (the long prefill did not monopolize
+    # the loop)
+    assert max(s.t_first for s in shorts) <= under.t_first
+    assert sched.kv.pool.pages_in_use == 0

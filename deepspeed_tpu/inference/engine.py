@@ -1139,6 +1139,78 @@ class InferenceEngine:
         the recompile watchdog."""
         return jit_cache_size(getattr(self, "_copy_page_fn", None))
 
+    def export_page_chain(self, pools, page_ids):
+        """Gather a page chain out of the paged pool as a transferable
+        payload: one ``[n, page_size, kv_heads, d]`` leaf per pool leaf
+        per layer, where ``n == len(page_ids)``.  The disaggregated
+        handoff transport's READ half — the payload either rides
+        ``jax.device_put`` to a sibling pool in-process or gets staged
+        to host and framed onto a cross-process KV sidecar fd.
+
+        Gathering EVERY leaf of each layer dict (not just k/v payload)
+        is what keeps a quantized pool's per-row scales welded to their
+        page across a transfer: a chain that moved int8/fp8 payload
+        without its scale rows would dequantize on the destination with
+        whatever stale scales its fresh pages held.  Same rule as
+        ``copy_page``, for the same reason.
+
+        ``page_ids`` must be padded to a power-of-two chunk bucket
+        (``transport.chunk_bucket``) — pad with any in-range id (0 is
+        conventional; the extra gathered page is trimmed on host).  Ids
+        are a traced operand, so churn in WHICH pages transfer never
+        adds a signature: exactly one compile per bucket length."""
+        if getattr(self, "_chain_export_fn", None) is None:
+            def export(pools, ids):
+                return [{name: arr[ids] for name, arr in L.items()}
+                        for L in pools["layers"]]
+            pool_sh = self._serving_shardings().pool
+            # payload leaves keep the pool's layout ([page-dim, ps,
+            # kvh, d] with kv-heads model-sharded), so the pool
+            # sharding pins through — device_put to the destination's
+            # identical NamedSharding is then resharding-free
+            self._chain_export_fn = jax.jit(export, out_shardings=pool_sh)
+        args = (pools, jnp.asarray(page_ids, jnp.int32))
+        with dist.mesh_scope(self.mesh):
+            return self._dispatch("chain_export", self._chain_export_fn,
+                                  *args)
+
+    def import_page_chain(self, pools, payload, page_ids):
+        """Scatter an exported chain payload into this pool at
+        ``page_ids`` (the destination's freshly allocated pages) and
+        return the updated pools — the transport's WRITE half, the
+        functional-update twin of ``export_page_chain``.
+
+        ``page_ids`` must be padded to the payload's chunk bucket with
+        ``num_pages`` (one past the last page): ``mode="drop"`` masks
+        the padded writes, the same out-of-range discipline every paged
+        write primitive rides.  Donates the pools like every other
+        pool-mutating primitive; one compile per bucket length."""
+        if getattr(self, "_chain_import_fn", None) is None:
+            def imp(pools, payload, ids):
+                return {"layers": [
+                    {name: arr.at[ids].set(pl[name], mode="drop")
+                     for name, arr in L.items()}
+                    for L, pl in zip(pools["layers"], payload)]}
+            pool_sh = self._serving_shardings().pool
+            self._chain_import_fn = jax.jit(imp, donate_argnums=(0,),
+                                            out_shardings=pool_sh)
+        args = (pools, payload, jnp.asarray(page_ids, jnp.int32))
+        with dist.mesh_scope(self.mesh):
+            return self._dispatch("chain_import", self._chain_import_fn,
+                                  *args)
+
+    def serving_chain_export_compile_count(self):
+        """Compiled signatures behind export_page_chain — one per
+        power-of-two chunk bucket a transfer ever used, NOT per chain
+        length (the bucket pins assert this stays flat across handoff
+        churn)."""
+        return jit_cache_size(getattr(self, "_chain_export_fn", None))
+
+    def serving_chain_import_compile_count(self):
+        """Compiled signatures behind import_page_chain — one per
+        chunk bucket, the mirror of the export pin."""
+        return jit_cache_size(getattr(self, "_chain_import_fn", None))
+
     # -------------------------------------- comm/compile observability
     def set_compile_watchdog(self, watchdog):
         """Install a :class:`tracing.CompileWatchdog` (None removes

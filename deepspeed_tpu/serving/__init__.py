@@ -48,6 +48,7 @@ from deepspeed_tpu.serving.cluster import (ClusterRouter,  # noqa: F401
                                            RouterSupervisor,
                                            StaleEpoch,
                                            make_disaggregated_group,
+                                           make_process_disaggregated_group,
                                            make_local_fleet)
 from deepspeed_tpu.serving.metrics import (ClusterMetrics,  # noqa: F401
                                            HaMetrics)

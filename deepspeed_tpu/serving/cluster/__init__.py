@@ -45,6 +45,7 @@ from deepspeed_tpu.serving.cluster.replica import (LocalReplica,  # noqa: F401
 from deepspeed_tpu.serving.cluster.router import (ClusterRouter,  # noqa: F401
                                                   DisaggGroup,
                                                   make_disaggregated_group,
+                                                  make_process_disaggregated_group,
                                                   make_local_fleet)
 from deepspeed_tpu.serving.cluster.wal import (FileWalSink,  # noqa: F401
                                                MemoryWalSink)

@@ -233,10 +233,37 @@ class RouterSupervisor:
                 stranded.append(entry.rid)
                 pkt = journal.pending_packets.get(entry.rid)
                 group = None if pkt is None else groups.get(pkt["group"])
+                transport = "shared_pool" if group is None else \
+                    getattr(group, "transport", "shared_pool")
                 if group is None:
                     entry.next_try = 0.0
                     journal.requeue(entry, error="handoff group lost "
                                                  "across takeover")
+                elif transport != "shared_pool":
+                    # cross-pool packet: the old primary's host-side
+                    # transfer state (buffered wire frames, in-flight
+                    # device_put chunks) died with it — only the WAL
+                    # manifest survives.  Re-drive unified, token-exact
+                    # off the journal; for a device_put packet whose
+                    # source replica survives, defensively free the
+                    # still-held source chain first.
+                    man = pkt.get("manifest") or {}
+                    if pkt.get("pages") and pkt.get("src"):
+                        src = next((r for r in self.replicas
+                                    if r.id == pkt["src"]), None)
+                        sched = getattr(src, "sched", None)
+                        if sched is not None:
+                            try:
+                                sched.kv.pool.free(list(pkt["pages"]))
+                            except Exception:
+                                pass
+                    entry.next_try = 0.0
+                    journal.requeue(
+                        entry,
+                        error="handoff transport lost across takeover;"
+                              " re-driven unified from manifest "
+                              f"(chunks={man.get('chunks')} "
+                              f"bytes={man.get('bytes')})")
                 else:
                     router._packets.append(_Packet(
                         entry, group, list(pkt["prompt"]),

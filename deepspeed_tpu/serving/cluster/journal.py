@@ -370,14 +370,23 @@ class RequestJournal:
         entry.replica_history.append(replica_id)
         self.pending_packets.pop(entry.rid, None)
 
-    def handoff(self, entry, group, prompt, pages, length, first_tok):
+    def handoff(self, entry, group, prompt, pages, length, first_tok,
+                manifest=None, src=None):
         """Record a prefill->decode handoff packet awaiting dispatch.
         ``pages`` are plain page ids — the pool object is resolved by
-        whoever (re)drives the packet."""
+        whoever (re)drives the packet.  Cross-pool packets additionally
+        carry ``manifest`` (chunk count, exact payload bytes, digest,
+        epoch — what a takeover needs to re-drive or account for an
+        interrupted transfer) and ``src`` (the exporting replica id,
+        which resolves the source pool when pages must be freed)."""
         rec = {"op": "handoff", "rid": entry.rid, "group": group,
                "prompt": [int(t) for t in prompt],
                "pages": [int(p) for p in pages], "length": int(length),
                "first_tok": int(first_tok)}
+        if manifest is not None:
+            rec["manifest"] = dict(manifest)
+        if src is not None:
+            rec["src"] = src
         if not self._wal(rec):
             return
         entry.state = HANDOFF
@@ -480,6 +489,11 @@ class RequestJournal:
                    "epoch": self.epoch,
                    "wal_position": None if self.wal is None else
                                    self.wal.position(),
+                   # in-flight handoff packets WITH their transfer
+                   # manifests: the dump round-trips exactly what a
+                   # takeover would re-drive
+                   "pending_packets": {rid: dict(rec) for rid, rec
+                                       in self.pending_packets.items()},
                    "entries": [e.snapshot()
                                for e in self.entries.values()]}
         tmp = str(path) + ".tmp"

@@ -213,6 +213,17 @@ class LocalReplica:
         return (len(s.waiting) + len(s._pending_attach) +
                 sum(r is not None for r in s.slot_req))
 
+    def attach_backlog(self):
+        """Chains parked at this replica awaiting a slot.  The router's
+        soft admission gate (``attach_backlog() < attach_slots()``)
+        never parks more chains than the replica has slots — parked
+        chains hold pool pages."""
+        return 0 if self.sched is None else \
+            len(self.sched._pending_attach)
+
+    def attach_slots(self):
+        return 0 if self.sched is None else self.sched.num_slots
+
     # -------------------------------------------------------------- pump
     def has_work(self):
         if self.sched is None:
@@ -366,20 +377,30 @@ class _RemoteHandle:
 
 class ProcessReplica:
     """A worker process behind the replica interface (JSONL protocol —
-    see ``cluster/worker.py``).  Unified role only: cross-process KV
-    page handoff would need a device-to-device transport this CPU
-    harness cannot model honestly."""
+    see ``cluster/worker.py``).
 
-    role = "unified"
-    group = None
+    Role workers carry real cross-process KV transport: a ``prefill``
+    worker gets a dedicated binary KV sidecar fd (``--kv-fd-out``) its
+    exported page-chain frames ride OUT on (length-prefixed, never the
+    JSONL control wire; a reader thread buffers them here per worker
+    rid), and a ``decode`` worker gets one (``--kv-fd-in``) the router
+    relays those frames INTO — the worker scatters each chunk on
+    arrival and attaches the request once the manifest verifies.
+    Prefix routing for process replicas runs on shipped
+    ``PrefixCache.fingerprint()`` digests (heartbeat cadence + the
+    ``fingerprint`` op), matched router-side by
+    :class:`~deepspeed_tpu.serving.prefix_cache.FingerprintMatcher` —
+    the wire twin of ``prefix_len`` scoring."""
 
     def __init__(self, replica_id, *, model="gpt2-tiny", num_slots=3,
                  num_pages=32, page_size=16, max_pages_per_slot=8,
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
                  hb_timeout_s=60.0, env=None, trace=False,
                  mem_telemetry=False, comm_telemetry=False,
-                 kv_dtype=None):
+                 kv_dtype=None, role="unified", group=None):
         self.id = replica_id
+        self.role = role                 # unified | prefill | decode
+        self.group = group               # DisaggGroup for role workers
         self.state = UP
         self.death_reason = None
         self.missed_beats = 0
@@ -401,6 +422,8 @@ class ProcessReplica:
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
+        self._handoff_sink = None
+        self._fp = None              # FingerprintMatcher, once shipped
         # worker-side spans, flushed over the JSONL protocol with each
         # heartbeat (already epoch-µs-serialized by the worker).  Kept
         # on the REPLICA so a SIGKILLed worker's last flushed window
@@ -445,6 +468,25 @@ class ProcessReplica:
             cmd.append("--comm-telemetry")
         if cfg["trace"]:
             cmd += ["--trace", "--trace-label", str(self.id)]
+        # KV sidecar plumbing for role workers: a dedicated binary fd
+        # pair per direction, separate from the JSONL control pipes —
+        # page-chain payloads never ride (or block) the control wire
+        self._wire_frames = {}       # worker rid -> [(header, raw)...]
+        self._wire_lock = threading.Lock()
+        self._wire_pending = set()   # wire-attach rids not yet adopted
+        self._kv_w = None            # decode: parent -> worker frames
+        self._kv_r = None            # prefill: worker -> parent frames
+        pass_fds, child_fds = (), []
+        if self.role == "prefill":
+            r_fd, w_fd = os.pipe()
+            cmd += ["--role", "prefill", "--kv-fd-out", str(w_fd)]
+            pass_fds, child_fds = (w_fd,), [w_fd]
+            self._kv_r = os.fdopen(r_fd, "rb")
+        elif self.role == "decode":
+            r_fd, w_fd = os.pipe()
+            cmd += ["--role", "decode", "--kv-fd-in", str(r_fd)]
+            pass_fds, child_fds = (r_fd,), [r_fd]
+            self._kv_w = os.fdopen(w_fd, "wb")
         try:
             # forward PRNG semantics: seeded init only yields the SAME
             # params in the child when threefry partitioning matches
@@ -455,20 +497,54 @@ class ProcessReplica:
             pass
         env = os.environ.copy()
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # the child must import THIS deepspeed_tpu however the parent
+        # got it (site-packages, cwd, or an explicit sys.path entry —
+        # the env of a driver script run from anywhere): the package's
+        # import root rides PYTHONPATH, it is not inherited through -m
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
         # the elastic-agent grace contract: the worker's SIGTERM drain
         # sizes itself against the budget the supervisor will enforce
         env["DS_PREEMPTION_GRACE_S"] = str(self.term_grace_s)
         env.update(self._env)
         self._proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True, env=env)
+            stderr=subprocess.DEVNULL, text=True, env=env,
+            pass_fds=pass_fds)
+        for fd in child_fds:
+            os.close(fd)    # the child owns its end now
         self._events = deque()
         self._events_lock = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True)
         self._reader.start()
+        if self._kv_r is not None:
+            self._kv_reader = threading.Thread(target=self._kv_read_loop,
+                                               daemon=True)
+            self._kv_reader.start()
         self._last_hb = time.monotonic()
         self._ready = False
+
+    def _kv_read_loop(self):
+        """Prefill sidecar reader: buffer exported chain frames per
+        worker rid until the router relays (or drops) them.  Frames
+        are decoded once here — the relay rewrites only the rid."""
+        from deepspeed_tpu.serving.cluster import transport as tp
+        stream = self._kv_r
+        try:
+            while True:
+                frame = tp.read_frame(stream)
+                if frame is None:
+                    return           # EOF: worker died or sidecar closed
+                header, raw = frame
+                with self._wire_lock:
+                    self._wire_frames.setdefault(
+                        header["rid"], []).append((header, raw))
+        except Exception:
+            pass
 
     def _read_loop(self):
         proc = self._proc
@@ -519,25 +595,57 @@ class ProcessReplica:
             elif kind == "hb":
                 self._last_hb = time.monotonic()
                 self.last_health = ev.get("health")
+                if ev.get("fp") is not None:
+                    self._absorb_fp(ev["fp"])
+            elif kind == "fp":
+                self._absorb_fp(ev)
+            elif kind == "handoff":
+                # prefill worker finished a handoff prompt: its frames
+                # are on (or arriving over) the KV sidecar; hand the
+                # metadata to the router's wire sink
+                rid = ev.get("rid")
+                h = self._handles.pop(rid, None)
+                if h is None or self._handoff_sink is None:
+                    self.drop_wire_frames(rid)
+                elif h.state in ("waiting", "prefill", "running"):
+                    h.state = "handoff"
+                    self._handoff_sink(
+                        h, [int(t) for t in ev["prompt"]],
+                        int(ev["length"]), int(ev["first_tok"]),
+                        ev["manifest"])
+            elif kind == "attached":
+                # decode worker verified the manifest and adopted the
+                # chain: the wire attach left the pending (backlog) set
+                self._wire_pending.discard(ev.get("rid"))
             elif kind == "tok":
                 h = self._handles.get(ev.get("rid"))
                 if h is not None and h.on_token is not None:
                     h.on_token(h, int(ev["t"]))
             elif kind == "done":
-                h = self._handles.pop(ev.get("rid"), None)
+                rid = ev.get("rid")
+                self._wire_pending.discard(rid)
+                h = self._handles.pop(rid, None)
                 if h is not None:
                     h.state = ev.get("status", "finished")
                     h.error = ev.get("error")
             elif kind == "spans":
                 self.trace_events.extend(ev.get("spans") or [])
 
+    def _absorb_fp(self, fp):
+        from deepspeed_tpu.serving.prefix_cache import FingerprintMatcher
+        if self._fp is None:
+            self._fp = FingerprintMatcher()
+        self._fp.update(fp)
+
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
                sample_offset=0, epoch=None):
-        if handoff:
-            raise ValueError("process replicas serve unified only")
+        if handoff and self.role != "prefill":
+            raise ValueError(
+                "handoff submits require a prefill-role worker "
+                "(its KV sidecar is the chain's way out)")
         _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
@@ -550,6 +658,8 @@ class ProcessReplica:
               "max_new_tokens": int(max_new_tokens),
               "eos_token_id": eos_token_id,
               "deadline_s": deadline_s}
+        if handoff:
+            op["handoff"] = True
         # decoding-policy wire fields are omitted when default so old
         # workers keep accepting the protocol
         if sampling:
@@ -573,15 +683,134 @@ class ProcessReplica:
         self._send(op)
         return handle
 
+    def set_handoff_sink(self, cb):
+        """Router wiring for prefill workers: where finished-prompt
+        handoff metadata goes (the frames ride the KV sidecar)."""
+        self._handoff_sink = cb
+
     def prefix_match_len(self, tokens):
-        # no fingerprint protocol op yet: process replicas route by load
-        return 0
+        """Prefix-aware routing score from the worker's last shipped
+        fingerprint: the wire twin of ``prefix_len`` (page-granular by
+        construction — a digest set can't represent the in-process
+        copy-on-write partial, and routing doesn't need it)."""
+        if self.state != UP or self._fp is None or len(tokens) < 2:
+            return 0
+        return self._fp.match_len(tokens, limit=len(tokens) - 1)
 
     def prefix_stats(self):
-        return (0, 0, 0)
+        if self._fp is None:
+            return (0, 0, 0)
+        return (self._fp.hits, self._fp.lookups, self._fp.tokens_reused)
+
+    def request_fingerprint(self):
+        """Ask the worker for a fresh prefix fingerprint now (it also
+        rides every heartbeat); the reply lands via ``_pump_events``."""
+        try:
+            self._send({"op": "fingerprint"})
+        except Exception:
+            pass   # dying worker: heartbeats will declare the death
 
     def load(self):
         return len(self._handles)
+
+    def attach_backlog(self):
+        """Wire attaches dispatched but not yet adopted worker-side —
+        each holds a freshly allocated destination chain, so the
+        router's admission gate bounds them by slot count exactly like
+        an in-process replica's parked chains."""
+        return len(self._wire_pending)
+
+    def attach_slots(self):
+        return int(self._cfg["num_slots"])
+
+    # ------------------------------------------------------ KV sidecar
+    def wire_frames_ready(self, rid, total):
+        """True once every frame of a chain export is host-buffered."""
+        with self._wire_lock:
+            return len(self._wire_frames.get(rid, ())) >= int(total)
+
+    def take_wire_frames(self, rid):
+        with self._wire_lock:
+            return self._wire_frames.pop(rid, [])
+
+    def drop_wire_frames(self, rid):
+        with self._wire_lock:
+            self._wire_frames.pop(rid, None)
+
+    def begin_wire_attach(self, prompt, length, first_tok, *, manifest,
+                          max_new_tokens, eos_token_id=None,
+                          deadline_s=None, on_token=None, trace_ctx=None,
+                          sampling=None, seed=None, grammar=None,
+                          sample_offset=0, epoch=None):
+        """Dispatch the decode side of a cross-process handoff: the
+        worker allocates the destination chain, scatters relayed
+        frames as they land, and adopts the request once the manifest
+        verifies (chunk count, exact bytes, running digest).  Frames
+        follow via :meth:`send_wire_chunk`."""
+        _fence_check(self, epoch)
+        if self.state != UP:
+            raise ReplicaKilled(f"{self.id} is {self.state}")
+        if self._kv_w is None:
+            raise ReplicaKilled(f"{self.id} has no KV sidecar "
+                                "(not a decode-role worker)")
+        rid = f"w{self._next_rid}"
+        self._next_rid += 1
+        handle = _RemoteHandle(rid, on_token, self)
+        self._handles[rid] = handle
+        self._wire_pending.add(rid)
+        op = {"op": "attach", "rid": rid,
+              "prompt": [int(t) for t in prompt],
+              "length": int(length), "first_tok": int(first_tok),
+              "manifest": dict(manifest),
+              "max_new_tokens": int(max_new_tokens),
+              "eos_token_id": eos_token_id,
+              "deadline_s": deadline_s}
+        if sampling:
+            op["sampling"] = dict(sampling)
+        if seed:
+            op["seed"] = int(seed)
+        if grammar:
+            op["grammar"] = dict(grammar)
+        if sample_offset:
+            op["sample_offset"] = int(sample_offset)
+        if epoch is not None:
+            op["epoch"] = int(epoch)
+        if trace_ctx is not None:
+            op["trace"] = trace_ctx
+        try:
+            self._send(op)
+        except ReplicaKilled:
+            self._wire_pending.discard(rid)
+            self._handles.pop(rid, None)
+            raise
+        return handle
+
+    def send_wire_chunk(self, rid, frame):
+        """Relay one buffered frame into the decode worker's sidecar,
+        rewriting the source worker's rid to the decode-side one."""
+        from deepspeed_tpu.serving.cluster import transport as tp
+        header, raw = frame
+        hdr = dict(header)
+        hdr["rid"] = rid
+        hb = json.dumps(hdr, separators=(",", ":")).encode()
+        buf = tp._MAGIC + tp._HDR.pack(len(hb), len(raw)) + hb + raw
+        try:
+            self._kv_w.write(buf)
+            self._kv_w.flush()
+        except Exception as e:
+            raise ReplicaKilled(
+                f"{self.id} KV sidecar broken: {e}") from e
+
+    def abort_wire_attach(self, rid):
+        """Tear down a dispatched wire attach (mid-transfer fault):
+        the worker frees the partial destination chain.  No-raise —
+        a dead worker's pages died with its pool."""
+        self._wire_pending.discard(rid)
+        self._handles.pop(rid, None)
+        try:
+            self._send({"op": "attach_abort", "rid": rid})
+        except Exception:
+            pass
 
     # -------------------------------------------------------------- pump
     def has_work(self):
@@ -637,6 +866,20 @@ class ProcessReplica:
         except OSError:
             pass
 
+    def _close_kv(self):
+        """Close this incarnation's sidecar ends (buffered frames for
+        unfinished exports die with them — the journal replays)."""
+        for stream in (self._kv_w, self._kv_r):
+            if stream is not None:
+                try:
+                    stream.close()
+                except Exception:
+                    pass
+        self._kv_w = self._kv_r = None
+        with self._wire_lock:
+            self._wire_frames.clear()
+        self._wire_pending.clear()
+
     def die(self, reason):
         if self.state == DEAD:
             return
@@ -644,6 +887,7 @@ class ProcessReplica:
         self.death_reason = reason
         self.kill()
         self._handles.clear()
+        self._close_kv()
 
     def begin_drain(self):
         if self.state != UP:
@@ -680,6 +924,7 @@ class ProcessReplica:
         except subprocess.TimeoutExpired:
             pass
         self._handles.clear()
+        self._close_kv()
         self._spawn()
         self.wait_ready()
         if self.fence_epoch:

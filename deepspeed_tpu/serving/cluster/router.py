@@ -42,24 +42,36 @@ import numpy as np
 
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.cluster import journal as jn
+from deepspeed_tpu.serving.cluster import transport as tp
 from deepspeed_tpu.serving.cluster.journal import RequestJournal
 from deepspeed_tpu.serving.cluster.replica import (DEAD, DRAINING, UP,
                                                    LocalReplica,
                                                    ReplicaKilled,
                                                    StaleEpoch)
 from deepspeed_tpu.serving.metrics import ClusterMetrics
-from deepspeed_tpu.serving.page_manager import PagePool
+from deepspeed_tpu.serving.page_manager import PagePool, PagePoolExhausted
 from deepspeed_tpu.serving.scheduler import ServingScheduler, _PoolsRef
 
 
 class DisaggGroup:
-    """Prefill and decode workers sharing one physical page pool and
-    one device-pools ref — the handoff transport."""
+    """A prefill/decode worker group and its transport path.
 
-    def __init__(self, name, pool, pools_ref):
+    ``transport`` is the three-way dispatch rule
+    (:func:`transport.choose_transport`): ``shared_pool`` groups share
+    ONE physical page pool + device-pools ref (handoff = page-id
+    ownership transfer, zero copies); ``device_put`` groups give every
+    worker its own pool in one process (chains move chunk-wise through
+    ``export_page_chain`` -> ``jax.device_put`` ->
+    ``import_page_chain``); ``wire`` groups are separate processes
+    (chains move as length-prefixed frames over KV sidecar fds,
+    relayed by the router).  ``pool``/``pools_ref`` are None except on
+    the shared path."""
+
+    def __init__(self, name, pool, pools_ref, transport="shared_pool"):
         self.name = name
         self.pool = pool
         self.pools_ref = pools_ref
+        self.transport = transport
 
 
 class _Packet:
@@ -69,13 +81,21 @@ class _Packet:
     prompt the prefill worker served) — the decode-side request must be
     keyed on it, not on the journal's current folded prompt, because
     the boundary token was already journal-emitted by the time the
-    packet dispatches and folding it again would double-count it."""
+    packet dispatches and folding it again would double-count it.
+
+    Cross-pool packets also carry the transfer ``manifest`` (chunk
+    count / exact bytes / digest / epoch), the source replica
+    (``src_rep`` — whose pool the pages still live in until the
+    transfer completes), and, on the wire path, the worker-side rid
+    the source's sidecar frames are keyed by (``wire_rid``; ``pages``
+    is empty and ``pool`` None — the payload never exists as router-
+    side pages)."""
 
     __slots__ = ("entry", "group", "prompt", "pages", "length",
-                 "first_tok", "pool")
+                 "first_tok", "pool", "manifest", "src_rep", "wire_rid")
 
     def __init__(self, entry, group, prompt, pages, length, first_tok,
-                 pool):
+                 pool, manifest=None, src_rep=None, wire_rid=None):
         self.entry = entry
         self.group = group
         self.prompt = prompt
@@ -83,6 +103,85 @@ class _Packet:
         self.length = length
         self.first_tok = first_tok
         self.pool = pool
+        self.manifest = manifest
+        self.src_rep = src_rep
+        self.wire_rid = wire_rid
+
+
+class _Transfer:
+    """One in-flight cross-pool chain transfer (``device_put`` path):
+    destination pages are allocated up front, then the chain moves one
+    chunk per router pump — export-gather from the (live, still
+    serving) source pool, ``device_put`` to the destination sharding,
+    scatter-import — so the transfer overlaps both sides' ongoing
+    decode horizons.  The ``cluster.handoff`` fault point fires per
+    chunk, and death of either side mid-transfer aborts: partial pages
+    freed on BOTH pools, request requeued unified."""
+
+    __slots__ = ("pkt", "dst_rep", "dst_pages", "dst_pool", "chunks",
+                 "seq", "t0", "nbytes", "page_bytes", "flow")
+
+    def __init__(self, pkt, dst_rep, dst_pages, t0):
+        self.pkt = pkt
+        self.dst_rep = dst_rep
+        self.dst_pages = dst_pages
+        # captured now: a replica death drops its scheduler, but the
+        # pool object is stable — partial pages stay freeable
+        self.dst_pool = dst_rep.sched.kv.pool
+        self.chunks = list(tp.iter_chunks(pkt.pages))
+        self.seq = 0
+        self.t0 = t0
+        self.nbytes = 0
+        src_sched = pkt.src_rep.sched
+        self.page_bytes = src_sched.engine.kv_page_bytes(
+            src_sched.kv.page_size, src_sched.kv_dtype_name)
+        self.flow = f"handoff:{pkt.entry.rid}:{id(self)}"
+
+    def done(self):
+        return self.seq >= len(self.chunks)
+
+    def advance_chunk(self):
+        """Move ONE chunk; the caller owns fault/death policy."""
+        import jax
+        src_sched = self.pkt.src_rep.sched
+        dst_sched = self.dst_rep.sched
+        chunk = self.chunks[self.seq]
+        src_chunk = chunk
+        payload, _ = tp.export_chunk(src_sched.engine, src_sched.pools,
+                                     src_chunk)
+        # same-process fast path: both pools live on one mesh, so the
+        # device_put to the destination's pool NamedSharding is a
+        # resharding-free placement (on separate hosts this is the DCN
+        # hop)
+        pool_sh = dst_sched.engine._serving_shardings().pool
+        payload = jax.device_put(payload, pool_sh)
+        dst_chunk = self.dst_pages[self.seq * tp.CHUNK_PAGES:
+                                   self.seq * tp.CHUNK_PAGES + len(chunk)]
+        tp.import_chunk(dst_sched.engine, dst_sched._pools_ref, payload,
+                        dst_chunk, dst_sched.kv.pool.num_pages)
+        self.nbytes += len(chunk) * self.page_bytes
+        self.seq += 1
+
+
+class _WireRelay:
+    """One in-flight wire transfer (``wire`` path, separate processes):
+    the prefill worker's exported frames, buffered host-side by the
+    source ``ProcessReplica``, streaming into the decode worker's KV
+    sidecar fd a few frames per router pump.  The decode worker
+    scatters each chunk on arrival and only attaches the request once
+    the manifest verifies (chunk count, exact bytes, running digest)."""
+
+    __slots__ = ("pkt", "dst_rep", "handle", "frames", "seq", "t0",
+                 "flow")
+
+    def __init__(self, pkt, dst_rep, handle, frames, t0):
+        self.pkt = pkt
+        self.dst_rep = dst_rep
+        self.handle = handle
+        self.frames = frames
+        self.seq = 0
+        self.t0 = t0
+        self.flow = f"handoff:{pkt.entry.rid}:{id(self)}"
 
 
 class ClusterRouter:
@@ -92,7 +191,7 @@ class ClusterRouter:
                  retry_backoff_s=0.02, heartbeat_misses=3, monitor=None,
                  seed=0, term_grace_s=10.0, tracer=None,
                  flight_recorder=None, journal=None, wal=None,
-                 epoch=None, lease=None):
+                 epoch=None, lease=None, transfer_chunks_per_step=2):
         if routing not in ("prefix", "round_robin"):
             raise ValueError(f"unknown routing policy {routing!r}")
         self.replicas = list(replicas)
@@ -122,6 +221,12 @@ class ClusterRouter:
         self._rng = np.random.default_rng(seed)
         self._by_handle = {}     # id(replica handle) -> journal entry
         self._packets = deque()
+        # in-flight cross-pool chain transfers, advanced
+        # `transfer_chunks_per_step` chunks per pump so a transfer
+        # overlaps the whole fleet's serving instead of stalling it
+        self._transfers = []
+        self.transfer_chunks_per_step = max(1,
+                                            int(transfer_chunks_per_step))
         self._has_prefill = any(r.role == "prefill" for r in self.replicas)
         # fleet tracing: the router records routing/failover/handoff
         # spans under its own process label and hands every replica a
@@ -160,7 +265,12 @@ class ClusterRouter:
                     rep.attach_comm_flight(self.flight)
         for rep in self.replicas:
             if rep.role == "prefill" and hasattr(rep, "set_handoff_sink"):
-                rep.set_handoff_sink(self._make_handoff_sink(rep))
+                if getattr(rep.group, "transport",
+                           "shared_pool") == "wire":
+                    rep.set_handoff_sink(
+                        self._make_wire_handoff_sink(rep))
+                else:
+                    rep.set_handoff_sink(self._make_handoff_sink(rep))
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -216,6 +326,7 @@ class ClusterRouter:
         now = time.monotonic()
         self._check_replicas()
         self._dispatch_handoffs(now)
+        self._advance_transfers(now)
         self._route(now)
         for rep in self.replicas:
             if rep.state == DEAD:
@@ -230,7 +341,8 @@ class ClusterRouter:
             except Exception:   # an uncontained replica error is a death
                 self._on_death(rep)
         self._collect(now)
-        return self.journal.has_live() or bool(self._packets)
+        return self.journal.has_live() or bool(self._packets) \
+            or bool(self._transfers)
 
     def run(self, max_steps=100000):
         """Pump until every journaled request is terminal; returns
@@ -239,7 +351,8 @@ class ClusterRouter:
             if not self.step():
                 break
             if not any(rep.state != DEAD and rep.has_work()
-                       for rep in self.replicas) and not self._packets:
+                       for rep in self.replicas) and not self._packets \
+                    and not self._transfers:
                 # nothing on any device: backoff gates are the only
                 # clock left — don't spin the host
                 time.sleep(0.002)
@@ -278,6 +391,25 @@ class ClusterRouter:
                 "missed heartbeats")
         self.metrics.failovers += 1
         self.metrics.event(self.step_idx, "failover")
+        # abort in-flight chain transfers touching the dead replica
+        # BEFORE replaying its stranded entries: device_put transfers
+        # free partial pages on both pools and requeue unified (pool
+        # objects outlive their scheduler — same contract the shared-
+        # pool path relies on); wire relays into a dead decode worker
+        # just stop (the entry is ROUTED there, so the stranded scan
+        # below owns the token-exact requeue)
+        for t in list(self._transfers):
+            if isinstance(t, _WireRelay):
+                # source death is harmless here — the frames are
+                # already host-buffered; only the destination matters
+                if t.dst_rep is rep:
+                    self._transfers.remove(t)
+                    self.metrics.record_handoff_abort(self.step_idx)
+            elif t.pkt.src_rep is rep or t.dst_rep is rep:
+                side = "source" if t.pkt.src_rep is rep \
+                    else "destination"
+                self._abort_transfer(
+                    t, reason=f"{side} died mid-transfer")
         # incarnation-matched: entries routed to a LATER incarnation of
         # this id (revived replica, flap race) are NOT stranded — a
         # stale death signal must never re-adopt live work
@@ -502,18 +634,90 @@ class ClusterRouter:
                 rep.sched.kv.pool.free(pages)
                 return
             entry.handle = None
+            manifest = None
+            if getattr(rep.group, "transport",
+                       "shared_pool") == "device_put":
+                # cross-pool packet: the manifest travels into the WAL
+                # so a takeover knows exactly what was in flight.  The
+                # digest is empty — this path never host-stages the
+                # payload (only the wire path hashes bytes).
+                sched = rep.sched
+                manifest = tp.make_manifest(
+                    len(pages),
+                    len(pages) * sched.engine.kv_page_bytes(
+                        sched.kv.page_size, sched.kv_dtype_name),
+                    "", 0 if self.epoch is None else self.epoch)
             self.journal.handoff(entry, rep.group.name,
                                  list(req.orig_prompt), pages, length,
-                                 first_tok)
+                                 first_tok, manifest=manifest,
+                                 src=rep.id)
             self._packets.append(
                 _Packet(entry, rep.group, list(req.orig_prompt), pages,
-                        length, first_tok, rep.sched.kv.pool))
+                        length, first_tok, rep.sched.kv.pool,
+                        manifest=manifest, src_rep=rep))
         return sink
 
+    def _make_wire_handoff_sink(self, rep):
+        """Handoff sink for a prefill ``ProcessReplica``: the worker
+        already exported the chain onto its KV sidecar fd (and freed
+        its local pages) by the time the ``handoff`` event arrives —
+        the router holds the frames and relays them to a decode
+        worker's sidecar.  ``pages`` is empty by construction: the
+        payload never exists as router-side pool pages."""
+        def sink(handle, prompt, length, first_tok, manifest):
+            entry = self._by_handle.pop(id(handle), None)
+            if entry is None:   # not a routed request (defensive)
+                rep.drop_wire_frames(handle.rid)
+                return
+            entry.handle = None
+            self.journal.handoff(entry, rep.group.name, list(prompt),
+                                 [], length, first_tok,
+                                 manifest=manifest, src=rep.id)
+            self._packets.append(
+                _Packet(entry, rep.group, list(prompt), [], length,
+                        first_tok, None, manifest=manifest, src_rep=rep,
+                        wire_rid=handle.rid))
+        return sink
+
+    def _attach_packet(self, pkt, rep, now, pages):
+        """Dispatch the decode-side attach for a packet whose chain
+        (or chain transfer) is complete: ``pages`` are destination-pool
+        page ids (the packet's own ids on the shared path, the freshly
+        imported ids after a device_put transfer).  Returns the handle
+        or raises (StaleEpoch propagates; the caller owns cleanup)."""
+        entry = pkt.entry
+        handle = rep.attach(
+            pkt.prompt, pages, pkt.length,
+            pkt.first_tok, max_new_tokens=entry.remaining_new + 1,
+            eos_token_id=entry.eos_token_id,
+            deadline_s=None if entry.deadline_abs is None
+            else max(0.001, entry.deadline_abs - now),
+            on_token=self._make_token_sink(entry, rep),
+            trace_ctx=None if self.tracer is None else
+            {"trace_id": entry.rid, "attempt": entry.replays},
+            # the boundary token (already journal-emitted) rides
+            # in out_tokens on the decode side, so the offset
+            # excludes it: next position = offset + len(out) =
+            # len(emitted) — the stream stays position-exact
+            # across the handoff
+            sampling=entry.sampling, seed=entry.seed,
+            grammar=entry.grammar,
+            sample_offset=max(0, len(entry.emitted) - 1),
+            epoch=self.epoch)
+        self.journal.dispatch(entry, rep.id,
+                              getattr(rep, "incarnation", 0))
+        entry.handle = handle
+        self._by_handle[id(handle)] = entry
+        self.metrics.handoffs += 1
+        self.metrics.event(self.step_idx, "handoff")
+        return handle
+
     def _dispatch_handoffs(self, now):
-        """Attach pending KV packets to decode workers.  Every failure
-        mode — injected ``cluster.handoff`` fault, no live decode
-        worker, attach refusal — frees the pages and requeues the
+        """Attach pending KV packets to decode workers, per the
+        group's transport path.  Every failure mode — injected
+        ``cluster.handoff`` fault, no live decode worker, attach
+        refusal, source death before the chain was relayable — frees
+        the pages (on whichever pools hold them) and requeues the
         request for unified serving: a handoff can be retried or
         degraded, never lost."""
         if self.lease is not None and \
@@ -523,69 +727,287 @@ class ClusterRouter:
             # here would corrupt shared state the fence exists to protect
             self.fenced_dispatches += len(self._packets)
             self._packets.clear()
+            self._transfers.clear()
             return
         for _ in range(len(self._packets)):
             pkt = self._packets.popleft()
             entry = pkt.entry
+            transport = getattr(pkt.group, "transport", "shared_pool")
             if entry.cancel_requested:
-                pkt.pool.free(pkt.pages)
+                self._free_packet_source(pkt)
                 self._finalize(entry, jn.CANCELLED,
                                "cancelled during handoff")
                 continue
-            try:
-                faults.fire("cluster.handoff", step=self.step_idx,
-                            rid=entry.rid)
-            except Exception as e:
-                pkt.pool.free(pkt.pages)
-                self._requeue_unified(entry,
-                                      f"handoff fault: {type(e).__name__}")
-                continue
-            targets = [r for r in self._up("decode")
-                       if r.group is pkt.group]
-            # soft admission gate: never park more chains at a worker
-            # than it has slots — parked chains hold pool pages
-            targets = [r for r in targets
-                       if len(r.sched._pending_attach) < r.sched.num_slots]
-            if not targets:
+            if transport == "shared_pool":
+                # zero-copy path: page ids change owners, the fault
+                # point fires once per packet (there are no chunks)
+                try:
+                    faults.fire("cluster.handoff", step=self.step_idx,
+                                rid=entry.rid)
+                except Exception as e:
+                    pkt.pool.free(pkt.pages)
+                    self._requeue_unified(
+                        entry, f"handoff fault: {type(e).__name__}")
+                    continue
+            rep = self._pick_decode_target(pkt)
+            if rep is None:
                 if self._up("decode"):
                     self._packets.append(pkt)   # backpressure: retry
                     continue
-                pkt.pool.free(pkt.pages)
+                self._free_packet_source(pkt)
                 self._requeue_unified(entry, "no live decode worker")
                 continue
-            rep = min(targets, key=lambda r: r.load())
+            if transport == "shared_pool":
+                try:
+                    self._attach_packet(pkt, rep, now, pkt.pages)
+                except StaleEpoch:
+                    self.fenced_dispatches += 1
+                    return         # deposed: pages belong to the heir
+                except Exception:
+                    pkt.pool.free(pkt.pages)
+                    self._requeue_unified(entry, "attach failed")
+                continue
+            if transport == "wire":
+                self._begin_wire_transfer(pkt, rep, now)
+                continue
+            # device_put: allocate the destination chain up front and
+            # start the chunked transfer; the attach dispatches when
+            # the last chunk lands (_advance_transfers)
             try:
-                handle = rep.attach(
-                    pkt.prompt, pkt.pages, pkt.length,
-                    pkt.first_tok, max_new_tokens=entry.remaining_new + 1,
-                    eos_token_id=entry.eos_token_id,
-                    deadline_s=None if entry.deadline_abs is None
-                    else max(0.001, entry.deadline_abs - now),
-                    on_token=self._make_token_sink(entry, rep),
-                    trace_ctx=None if self.tracer is None else
-                    {"trace_id": entry.rid, "attempt": entry.replays},
-                    # the boundary token (already journal-emitted) rides
-                    # in out_tokens on the decode side, so the offset
-                    # excludes it: next position = offset + len(out) =
-                    # len(emitted) — the stream stays position-exact
-                    # across the handoff
-                    sampling=entry.sampling, seed=entry.seed,
-                    grammar=entry.grammar,
-                    sample_offset=max(0, len(entry.emitted) - 1),
-                    epoch=self.epoch)
+                dst_pages = rep.sched.kv.pool.allocate(len(pkt.pages))
+            except PagePoolExhausted:
+                self._packets.append(pkt)       # backpressure: retry
+                continue
+            t = _Transfer(pkt, rep, dst_pages, now)
+            self._transfers.append(t)
+            if self.tracer is not None:
+                # the s/f flow pair: arrow from the source process's
+                # track to the destination's, one per transfer
+                self.tracer.flow(
+                    "s", t.flow, "handoff_transfer", rid=entry.rid,
+                    process=str(pkt.src_rep.id),
+                    args={"pages": len(pkt.pages),
+                          "chunks": len(t.chunks),
+                          "bytes": pkt.manifest["bytes"]
+                          if pkt.manifest else None})
+
+    def _pick_decode_target(self, pkt):
+        """Least-loaded live decode worker in the packet's group with
+        attach headroom (the soft admission gate: never park more
+        chains at a worker than it has slots — parked chains hold pool
+        pages)."""
+        targets = [r for r in self._up("decode") if r.group is pkt.group
+                   and r.attach_backlog() < r.attach_slots()]
+        return min(targets, key=lambda r: r.load()) if targets else None
+
+    def _free_packet_source(self, pkt):
+        """Free whatever source-side pages a packet still holds.  Wire
+        packets hold none (the worker freed its pages at export; the
+        router only buffers host frames, dropped here)."""
+        if pkt.pool is not None and pkt.pages:
+            pkt.pool.free(pkt.pages)
+        if pkt.wire_rid is not None and pkt.src_rep is not None:
+            pkt.src_rep.drop_wire_frames(pkt.wire_rid)
+
+    # -------------------------------------------------- chain transfers
+    def _begin_wire_transfer(self, pkt, rep, now):
+        """Start relaying a wire packet: dispatch the attach op to the
+        decode worker (it allocates pages and scatters frames as they
+        arrive), then stream the buffered frames over the pumps."""
+        entry = pkt.entry
+        if not pkt.src_rep.wire_frames_ready(pkt.wire_rid,
+                                             pkt.manifest["chunks"]):
+            if pkt.src_rep.state == DEAD:
+                # source SIGKILLed mid-export: the chain can never
+                # complete — drop the partial frames, requeue unified
+                # (token-exact: emitted tokens fold into the prompt)
+                pkt.src_rep.drop_wire_frames(pkt.wire_rid)
+                self.metrics.record_handoff_abort(self.step_idx)
+                self._requeue_unified(
+                    entry, "prefill worker died mid-transfer")
+                return
+            self._packets.append(pkt)       # frames still arriving
+            return
+        frames = pkt.src_rep.take_wire_frames(pkt.wire_rid)
+        try:
+            handle = rep.begin_wire_attach(
+                pkt.prompt, pkt.length, pkt.first_tok,
+                manifest=pkt.manifest,
+                max_new_tokens=entry.remaining_new + 1,
+                eos_token_id=entry.eos_token_id,
+                deadline_s=None if entry.deadline_abs is None
+                else max(0.001, entry.deadline_abs - now),
+                on_token=self._make_token_sink(entry, rep),
+                trace_ctx=None if self.tracer is None else
+                {"trace_id": entry.rid, "attempt": entry.replays},
+                sampling=entry.sampling, seed=entry.seed,
+                grammar=entry.grammar,
+                sample_offset=max(0, len(entry.emitted) - 1),
+                epoch=self.epoch)
+        except StaleEpoch:
+            self.fenced_dispatches += 1
+            return
+        except Exception:
+            self.metrics.record_handoff_abort(self.step_idx)
+            self._requeue_unified(entry, "wire attach refused")
+            return
+        self.journal.dispatch(entry, rep.id,
+                              getattr(rep, "incarnation", 0))
+        entry.handle = handle
+        self._by_handle[id(handle)] = entry
+        self.metrics.handoffs += 1
+        self.metrics.event(self.step_idx, "handoff")
+        relay = _WireRelay(pkt, rep, handle, frames, now)
+        self._transfers.append(relay)
+        if self.tracer is not None:
+            self.tracer.flow(
+                "s", relay.flow, "handoff_transfer", rid=entry.rid,
+                process=str(pkt.src_rep.id),
+                args={"chunks": pkt.manifest["chunks"],
+                      "bytes": pkt.manifest["bytes"]})
+
+    def _advance_transfers(self, now):
+        """Move every in-flight chain transfer forward by up to
+        ``transfer_chunks_per_step`` chunks.  The per-chunk
+        ``cluster.handoff`` fault fires before each chunk moves;
+        faults and deaths abort the transfer with partial pages freed
+        on both sides and the request requeued unified."""
+        for t in list(self._transfers):
+            if isinstance(t, _WireRelay):
+                self._advance_wire_relay(t)
+                continue
+            pkt = t.pkt
+            entry = pkt.entry
+            if entry.cancel_requested:
+                self._abort_transfer(t, requeue=False)
+                self._finalize(entry, jn.CANCELLED,
+                               "cancelled during handoff transfer")
+                continue
+            if pkt.src_rep.state == DEAD or t.dst_rep.state == DEAD:
+                side = "source" if pkt.src_rep.state == DEAD \
+                    else "destination"
+                self._abort_transfer(
+                    t, reason=f"{side} died mid-transfer")
+                continue
+            aborted = False
+            for _ in range(self.transfer_chunks_per_step):
+                if t.done():
+                    break
+                try:
+                    faults.fire("cluster.handoff", step=self.step_idx,
+                                rid=entry.rid, chunk=t.seq)
+                except Exception as e:
+                    self._abort_transfer(
+                        t, reason=f"handoff fault at chunk {t.seq}: "
+                                  f"{type(e).__name__}")
+                    aborted = True
+                    break
+                try:
+                    t.advance_chunk()
+                except Exception as e:
+                    self._abort_transfer(
+                        t, reason=f"transfer failed at chunk {t.seq}: "
+                                  f"{type(e).__name__}")
+                    aborted = True
+                    break
+            if aborted or not t.done():
+                continue
+            # chain complete: source pages release, destination adopts
+            self._transfers.remove(t)
+            if pkt.pool is not None:
+                pkt.pool.free(pkt.pages)
+            ms = (time.monotonic() - t.t0) * 1e3
+            try:
+                self._attach_packet(pkt, t.dst_rep, now, t.dst_pages)
             except StaleEpoch:
                 self.fenced_dispatches += 1
-                return             # deposed: pages belong to the heir
+                return
             except Exception:
-                pkt.pool.free(pkt.pages)
-                self._requeue_unified(entry, "attach failed")
+                t.dst_pool.free(t.dst_pages)
+                self.metrics.record_handoff_abort(self.step_idx)
+                self._requeue_unified(entry, "attach failed after "
+                                             "transfer")
                 continue
-            self.journal.dispatch(entry, rep.id,
-                                  getattr(rep, "incarnation", 0))
-            entry.handle = handle
-            self._by_handle[id(handle)] = entry
-            self.metrics.handoffs += 1
-            self.metrics.event(self.step_idx, "handoff")
+            self._record_transfer(t, pkt, ms, "device_put")
+
+    def _advance_wire_relay(self, relay):
+        """Stream the next frames of a wire transfer into the decode
+        worker's KV sidecar.  The worker scatters each chunk on
+        arrival; its death mid-relay is a normal replica death (the
+        entry is ROUTED there — the failover pass replays it unified,
+        token-exact), so the relay just stops."""
+        pkt = relay.pkt
+        entry = pkt.entry
+        if relay.dst_rep.state == DEAD or entry.handle is None:
+            # destination died (failover owns the requeue) or the
+            # entry moved on: stop relaying, count the abort
+            self._transfers.remove(relay)
+            self.metrics.record_handoff_abort(self.step_idx)
+            return
+        for _ in range(self.transfer_chunks_per_step):
+            if relay.seq >= len(relay.frames):
+                break
+            try:
+                faults.fire("cluster.handoff", step=self.step_idx,
+                            rid=entry.rid, chunk=relay.seq)
+            except Exception as e:
+                # mid-relay fault: tear down the decode side (it frees
+                # its partial pages) and requeue unified.  The entry is
+                # ROUTED to the decode worker — pull it back first.
+                self._transfers.remove(relay)
+                relay.dst_rep.abort_wire_attach(relay.handle.rid)
+                self._by_handle.pop(id(relay.handle), None)
+                entry.handle = None
+                entry.replica = None
+                self.metrics.record_handoff_abort(self.step_idx)
+                self._requeue_unified(
+                    entry, f"handoff fault at chunk {relay.seq}: "
+                           f"{type(e).__name__}")
+                return
+            try:
+                relay.dst_rep.send_wire_chunk(relay.handle.rid,
+                                              relay.frames[relay.seq])
+            except Exception:
+                # broken sidecar = dying worker: stop; the heartbeat
+                # pass declares the death and replays the entry
+                self._transfers.remove(relay)
+                self.metrics.record_handoff_abort(self.step_idx)
+                return
+            relay.seq += 1
+        if relay.seq >= len(relay.frames):
+            self._transfers.remove(relay)
+            ms = (time.monotonic() - relay.t0) * 1e3
+            self._record_transfer(relay, pkt, ms, "wire")
+
+    def _record_transfer(self, t, pkt, ms, path):
+        nbytes = pkt.manifest["bytes"] if pkt.manifest else t.nbytes
+        chunks = pkt.manifest["chunks"] if pkt.manifest \
+            else len(t.chunks)
+        self.metrics.record_handoff_transfer(self.step_idx, path,
+                                             nbytes, chunks, ms)
+        if self.tracer is not None:
+            self.tracer.flow(
+                "f", t.flow, "handoff_transfer", rid=pkt.entry.rid,
+                process=str(t.dst_rep.id),
+                args={"bytes": nbytes, "chunks": chunks,
+                      "ms": round(ms, 3), "path": path})
+
+    def _abort_transfer(self, t, reason=None, requeue=True):
+        """Tear down a device_put transfer mid-chain: free the source
+        pages (the source pool outlives its scheduler — same contract
+        as the shared-pool path) and the destination's pre-allocated
+        chain, requeue unified.  Token-exact either way: the journal
+        folds emitted tokens into the replayed prompt."""
+        if t in self._transfers:
+            self._transfers.remove(t)
+        pkt = t.pkt
+        if pkt.pool is not None:
+            pkt.pool.free(pkt.pages)
+        t.dst_pool.free(t.dst_pages)
+        self.metrics.record_handoff_abort(self.step_idx)
+        if requeue:
+            self._requeue_unified(pkt.entry,
+                                  reason or "transfer aborted")
 
     def _requeue_unified(self, entry, reason):
         if entry.finished_by_emitted():
@@ -715,16 +1137,26 @@ class ClusterRouter:
         for rep in self.replicas:
             if rep.state != DEAD:
                 rep.begin_drain()
-        while self.journal.has_live() or self._packets:
+        while self.journal.has_live() or self._packets or self._transfers:
             if deadline is not None and time.monotonic() > deadline:
                 break
             if not self.step():
                 break
         for pkt in list(self._packets):
-            pkt.pool.free(pkt.pages)
+            self._free_packet_source(pkt)
             self._finalize(pkt.entry, jn.SHED,
                            "shutdown drain: grace budget exhausted")
         self._packets.clear()
+        for t in list(self._transfers):
+            if isinstance(t, _WireRelay):
+                self._transfers.remove(t)
+                self.metrics.record_handoff_abort(self.step_idx)
+                # entry is ROUTED at the decode worker — the live-entry
+                # sweep below sheds it
+            else:
+                self._abort_transfer(t, requeue=False)
+                self._finalize(t.pkt.entry, jn.SHED,
+                               "shutdown drain: grace budget exhausted")
         for entry in list(self.journal.live()):
             self._finalize(entry, jn.SHED,
                            "shutdown drain: grace budget exhausted")
@@ -792,7 +1224,16 @@ class ClusterRouter:
                 dent = entry(sched._spec.kv.pool)
                 dent["managers"].append(sched._spec.kv)
         for pkt in self._packets:
-            entry(pkt.pool)["chains"].append(pkt.pages)
+            if pkt.pool is not None:     # wire packets hold no pages
+                entry(pkt.pool)["chains"].append(pkt.pages)
+        for t in self._transfers:
+            # mid-transfer chains hold pages on BOTH pools: the source
+            # chain until the last chunk lands, the pre-allocated
+            # destination chain from dispatch onward
+            if isinstance(t, _WireRelay):
+                continue                 # both sides worker-internal
+            entry(t.pkt.pool)["chains"].append(t.pkt.pages)
+            entry(t.dst_pool)["chains"].append(t.dst_pages)
         reports = []
         for i, ent in enumerate(pools.values()):
             pool = ent.pop("pool")
@@ -902,6 +1343,7 @@ class ClusterRouter:
                           if e.state == jn.QUEUED),
             "live_requests": len(self.journal.live()),
             "packets_pending": len(self._packets),
+            "transfers_inflight": len(self._transfers),
             "aggregate_prefix_hit_rate":
                 round(hits / lookups, 4) if lookups else 0.0,
             "aggregate_tokens_reused": reused,
@@ -934,27 +1376,95 @@ def make_local_fleet(engine, n, *, id_prefix="replica", **sched_kw):
 
 def make_disaggregated_group(engine, *, name="g0", num_prefill=1,
                              num_decode=1, num_pages=64, page_size=16,
-                             kv_dtype=None, **sched_kw):
-    """A prefill/decode worker group: separate schedulers (separate
-    slot tables) over ONE shared page pool and ONE device-pools ref, so
-    a finished prompt's KV chain transfers by page id — zero copies.
-    ``kv_dtype`` overrides the engine's pool dtype for the SHARED pools
-    (int8/fp8 quantized pages handoff by page id like any others —
-    their scale pools ride the same ids)."""
-    pool = PagePool(num_pages, page_size)
-    pools_ref = _PoolsRef(engine.init_paged_cache(num_pages, page_size,
-                                                  kv_dtype=kv_dtype))
-    group = DisaggGroup(name, pool, pools_ref)
+                             kv_dtype=None, transport="shared_pool",
+                             **sched_kw):
+    """A prefill/decode worker group under the three-path transport
+    dispatch rule (:func:`transport.choose_transport`):
 
-    def factory():
-        return ServingScheduler(engine, num_pages=num_pages,
-                                page_size=page_size, shared_pool=pool,
-                                pools_ref=pools_ref, **sched_kw)
+    * ``transport="shared_pool"`` — separate schedulers (separate slot
+      tables) over ONE shared page pool and ONE device-pools ref; a
+      finished prompt's KV chain transfers by page id, zero copies.
+      This is the fast path when prefill and decode share devices.
+    * ``transport="device_put"`` — every worker gets its OWN pool and
+      device-pools ref (same process, separate HBM budgets); chains
+      move chunk-wise through ``engine.export_page_chain`` ->
+      ``jax.device_put`` to the destination pool's NamedSharding ->
+      ``engine.import_page_chain``, overlapped with both sides' decode.
+    * for separate OS processes use
+      :func:`make_process_disaggregated_group` (``transport="wire"``):
+      chains move as length-prefixed binary frames over dedicated KV
+      sidecar fds, relayed by the router — never on the JSONL control
+      wire.
+
+    ``kv_dtype`` overrides the engine's pool dtype (int8/fp8 quantized
+    pages handoff like any others on every path — their scale pools
+    ride the same page ids, and the chunk payloads carry the scale
+    leaves so transferred pages land with their own scales)."""
+    if transport not in ("shared_pool", "device_put"):
+        raise ValueError(f"unknown in-process transport {transport!r}")
+    reps = []
+    if transport == "shared_pool":
+        pool = PagePool(num_pages, page_size)
+        pools_ref = _PoolsRef(engine.init_paged_cache(
+            num_pages, page_size, kv_dtype=kv_dtype))
+        group = DisaggGroup(name, pool, pools_ref)
+
+        def factory():
+            return ServingScheduler(engine, num_pages=num_pages,
+                                    page_size=page_size,
+                                    shared_pool=pool,
+                                    pools_ref=pools_ref, **sched_kw)
+        for i in range(num_prefill):
+            reps.append(LocalReplica(f"{name}-prefill{i}", factory,
+                                     role="prefill", group=group))
+        for i in range(num_decode):
+            reps.append(LocalReplica(f"{name}-decode{i}", factory,
+                                     role="decode", group=group))
+        return reps
+    group = DisaggGroup(name, None, None, transport="device_put")
+    roles = [("prefill", i) for i in range(num_prefill)] + \
+            [("decode", i) for i in range(num_decode)]
+    for role, i in roles:
+        # per-replica pool + pools ref created OUTSIDE the factory
+        # closure: a die/restart builds a fresh scheduler over the SAME
+        # physical pool (mirroring how a real worker's HBM allocation
+        # survives its serving loop), so in-flight transfer pages stay
+        # freeable and the fleet audit's census holds across restarts
+        pool = PagePool(num_pages, page_size)
+        pools_ref = _PoolsRef(engine.init_paged_cache(
+            num_pages, page_size, kv_dtype=kv_dtype))
+
+        def factory(pool=pool, pools_ref=pools_ref):
+            return ServingScheduler(engine, num_pages=num_pages,
+                                    page_size=page_size,
+                                    shared_pool=pool,
+                                    pools_ref=pools_ref, **sched_kw)
+        reps.append(LocalReplica(f"{name}-{role}{i}", factory,
+                                 role=role, group=group))
+    return reps
+
+
+def make_process_disaggregated_group(*, name="w0", num_prefill=1,
+                                     num_decode=1, model="gpt2-tiny",
+                                     **proc_kw):
+    """A prefill/decode worker group over SEPARATE OS processes
+    (``transport="wire"``): each worker owns a private page pool in its
+    own process; finished-prompt chains leave the prefill worker as
+    length-prefixed binary frames on its KV sidecar fd, the router
+    relays them (with the decode-side rid rewritten) into the decode
+    worker's sidecar, and the decode worker scatters each chunk on
+    arrival — attach happens only after the manifest verifies (chunk
+    count, exact bytes, running digest).  ``proc_kw`` passes through to
+    :class:`ProcessReplica` (num_pages, page_size, kv_dtype, ...)."""
+    from deepspeed_tpu.serving.cluster.replica import ProcessReplica
+    group = DisaggGroup(name, None, None, transport="wire")
     reps = []
     for i in range(num_prefill):
-        reps.append(LocalReplica(f"{name}-prefill{i}", factory,
-                                 role="prefill", group=group))
+        reps.append(ProcessReplica(f"{name}-prefill{i}", model=model,
+                                   role="prefill", group=group,
+                                   **proc_kw))
     for i in range(num_decode):
-        reps.append(LocalReplica(f"{name}-decode{i}", factory,
-                                 role="decode", group=group))
+        reps.append(ProcessReplica(f"{name}-decode{i}", model=model,
+                                   role="decode", group=group,
+                                   **proc_kw))
     return reps

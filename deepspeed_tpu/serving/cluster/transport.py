@@ -1,0 +1,301 @@
+"""KV page-chain transport: move a handoff's pages between pools.
+
+The disaggregated tier's handoff API already detaches a finished
+prompt's page chain from the prefill scheduler (``take_slot_pages``)
+and adopts it on the decode side (``adopt_chain``) — this module is
+what happens IN BETWEEN when the two sides do not share a pool.  The
+page chain is the transfer unit, moved in chunks so the transfer
+overlaps both sides' ongoing serving, with a three-path dispatch rule
+(:func:`choose_transport`):
+
+``shared_pool``
+    Source and destination schedulers share ONE ``PagePool`` + pools
+    ref (the original in-process tier).  Nothing moves: the handoff
+    stays a host-side ownership transfer of page ids, zero copies.
+
+``device_put``
+    Same process, separate pools.  ``engine.export_page_chain``
+    gathers a chunk (payload keeps the pool layout + sharding), the
+    payload rides ``jax.device_put`` to the destination pool's
+    ``NamedSharding``, ``engine.import_page_chain`` scatters it into
+    freshly allocated destination pages.  No host staging.
+
+``wire``
+    Separate processes (``ProcessReplica``).  The exported chunk is
+    staged to host and framed onto a dedicated binary KV sidecar fd —
+    length-prefixed frames, NEVER the JSONL control wire — relayed by
+    the router from the prefill worker's sidecar to the decode
+    worker's, and scattered incrementally on arrival.
+
+Chunking follows the power-of-two bucket discipline every other
+serving primitive uses: a chunk of ``n`` pages pads to
+``chunk_bucket(n)`` so export/import compile once per bucket, never
+per chain length.  Export pads ids with page 0 (a harmless extra
+gather, trimmed on host); import pads with ``num_pages`` so the
+``mode="drop"`` masking contract swallows the padded writes.
+
+Every leaf of every layer dict moves with the chunk — for quantized
+pools that is the k/v payload AND the per-row scale leaves, welded to
+their page exactly like ``copy_page`` welds them (a chain that moved
+int8/fp8 payload without scales would dequantize on the destination
+with whatever stale scales its fresh pages held).
+
+Wire frame format (both sidecar hops)::
+
+    b"KV01" | header_len:u32le | payload_len:u64le | header | payload
+
+where ``header`` is a compact-JSON dict ``{rid, seq, of, pages,
+leaves: [[dtype, shape], ...]}`` and ``payload`` is the raw
+concatenation of the trimmed host leaves in (layer, sorted-key)
+order.  A transfer MANIFEST — ``{pages, chunks, bytes, digest,
+epoch}`` — travels on the control wire and into the journal's WAL, so
+a router takeover knows exactly what was in flight; ``digest`` is a
+blake2b over the concatenated frame payloads in seq order (wire path;
+paths that never host-stage record ``digest=""``).
+"""
+
+import hashlib
+import json
+import struct
+
+import numpy as np
+
+from deepspeed_tpu.serving.page_manager import PagePoolExhausted
+
+# max pages per transfer dispatch: one chunk.  Bounds per-dispatch
+# payload bytes AND caps the bucket set at {1, 2, 4, 8} — at most
+# four compile signatures per primitive regardless of chain length.
+CHUNK_PAGES = 8
+
+_MAGIC = b"KV01"
+_HDR = struct.Struct("<IQ")
+
+
+def chunk_bucket(n):
+    """Smallest power of two >= n (n >= 1): the padded chunk length
+    export/import compile against."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def iter_chunks(pages, chunk_pages=CHUNK_PAGES):
+    """Split a page chain into transfer chunks, in order."""
+    for i in range(0, len(pages), chunk_pages):
+        yield list(pages[i:i + chunk_pages])
+
+
+def num_chunks(n_pages, chunk_pages=CHUNK_PAGES):
+    return (n_pages + chunk_pages - 1) // chunk_pages
+
+
+def choose_transport(src_rep, dst_rep):
+    """The three-path dispatch rule.  A replica without an in-process
+    scheduler (``ProcessReplica``) forces the wire; two in-process
+    schedulers sharing one ``PagePool`` object need no transport at
+    all; anything else is a same-process cross-pool ``device_put``."""
+    src_sched = getattr(src_rep, "sched", None)
+    dst_sched = getattr(dst_rep, "sched", None)
+    if src_sched is None or dst_sched is None:
+        return "wire"
+    if src_sched.kv.pool is dst_sched.kv.pool:
+        return "shared_pool"
+    return "device_put"
+
+
+def make_manifest(n_pages, nbytes, digest, epoch, chunk_pages=CHUNK_PAGES):
+    return {"pages": int(n_pages),
+            "chunks": num_chunks(n_pages, chunk_pages),
+            "bytes": int(nbytes), "digest": digest, "epoch": int(epoch)}
+
+
+# ------------------------------------------------------------ chunks
+
+def export_chunk(engine, pools, chunk):
+    """Gather one chunk as a device payload padded to its bucket.
+    Returns ``(payload, bucket)``; the payload's page dim is
+    ``bucket`` long — trim to ``len(chunk)`` before host staging."""
+    b = chunk_bucket(len(chunk))
+    ids = np.zeros(b, np.int32)
+    ids[:len(chunk)] = chunk
+    return engine.export_page_chain(pools, ids), b
+
+
+def import_chunk(engine, pools_ref, payload, chunk, num_pages):
+    """Scatter a (bucket-padded) payload into ``chunk``'s pages,
+    updating ``pools_ref`` in place.  Padded ids are ``num_pages``:
+    out of range, dropped by the write mask."""
+    b = int(np.shape(next(iter(payload[0].values())))[0])
+    ids = np.full(b, num_pages, np.int32)
+    ids[:len(chunk)] = chunk
+    pools_ref.pools = engine.import_page_chain(pools_ref.pools, payload,
+                                               ids)
+
+
+def payload_to_host(payload, n_pages):
+    """Trim a device payload to its real pages and pull to host as a
+    flat leaf list in deterministic (layer, sorted-key) order — the
+    wire order both sides agree on."""
+    leaves = []
+    for layer in payload:
+        for name in sorted(layer):
+            leaves.append(np.asarray(layer[name][:n_pages]))
+    return leaves
+
+
+def leaves_to_payload(leaves, layer_keys, bucket):
+    """Rebuild the per-layer payload dicts from a flat host leaf list,
+    padding each leaf's page dim to ``bucket`` (import compiles per
+    bucket, and only the last chunk of a chain is ragged)."""
+    keys = sorted(layer_keys)
+    payload = []
+    for i in range(0, len(leaves), len(keys)):
+        group = leaves[i:i + len(keys)]
+        layer = {}
+        for name, arr in zip(keys, group):
+            n = arr.shape[0]
+            if n < bucket:
+                pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            layer[name] = arr
+        payload.append(layer)
+    return payload
+
+
+# ------------------------------------------------------------ frames
+
+def encode_frame(rid, seq, total, leaves):
+    """Frame one host-staged chunk for the KV sidecar fd."""
+    n_pages = int(leaves[0].shape[0])
+    header = {"rid": rid, "seq": int(seq), "of": int(total),
+              "pages": n_pages,
+              "leaves": [[str(a.dtype), list(a.shape)] for a in leaves]}
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    raw = b"".join(a.tobytes() for a in leaves)
+    return _MAGIC + _HDR.pack(len(hb), len(raw)) + hb + raw
+
+
+def decode_frame(buf):
+    """Decode one full frame from bytes -> (header, raw)."""
+    if buf[:4] != _MAGIC:
+        raise ValueError("bad KV frame magic")
+    hlen, plen = _HDR.unpack(buf[4:4 + _HDR.size])
+    off = 4 + _HDR.size
+    header = json.loads(buf[off:off + hlen].decode())
+    raw = buf[off + hlen:off + hlen + plen]
+    if len(raw) != plen:
+        raise ValueError("truncated KV frame payload")
+    return header, raw
+
+
+def read_frame(stream):
+    """Blocking-read one frame from a binary stream; None on EOF."""
+    head = stream.read(4 + _HDR.size)
+    if not head or len(head) < 4 + _HDR.size:
+        return None
+    if head[:4] != _MAGIC:
+        raise ValueError("bad KV frame magic")
+    hlen, plen = _HDR.unpack(head[4:])
+    body = stream.read(hlen + plen)
+    if len(body) < hlen + plen:
+        return None
+    header = json.loads(body[:hlen].decode())
+    return header, body[hlen:]
+
+
+def frame_leaves(header, raw):
+    """Reconstruct the flat host leaf list from a decoded frame."""
+    leaves, off = [], 0
+    for dtype, shape in header["leaves"]:
+        arr = np.frombuffer(raw, dtype=np.dtype(dtype), count=int(
+            np.prod(shape, dtype=np.int64)), offset=off)
+        leaves.append(arr.reshape(shape))
+        off += arr.nbytes
+    return leaves
+
+
+# ------------------------------------------------- whole-chain export
+
+def export_chain_frames(engine, pools, pages, rid, *, epoch=0,
+                        chunk_pages=CHUNK_PAGES):
+    """Export a full chain as wire frames + its manifest (host-staged
+    path: the prefill worker's side of a cross-process handoff).
+    Returns ``(frames, manifest)``; ``manifest["bytes"]`` is the exact
+    payload byte count — ``pages * engine.kv_page_bytes(...)`` — and
+    ``manifest["digest"]`` hashes the payloads in seq order."""
+    chunks = list(iter_chunks(pages, chunk_pages))
+    frames, nbytes = [], 0
+    h = hashlib.blake2b(digest_size=16)
+    for seq, chunk in enumerate(chunks):
+        payload, _ = export_chunk(engine, pools, chunk)
+        leaves = payload_to_host(payload, len(chunk))
+        frame = encode_frame(rid, seq, len(chunks), leaves)
+        raw = b"".join(a.tobytes() for a in leaves)
+        h.update(raw)
+        nbytes += len(raw)
+        frames.append(frame)
+    manifest = make_manifest(len(pages), nbytes, h.hexdigest(), epoch,
+                             chunk_pages)
+    return frames, manifest
+
+
+class ChunkImporter:
+    """Destination-side incremental importer: fresh pages allocated up
+    front, each arriving chunk scattered AS IT LANDS — the import of
+    chunk k overlaps the transfer of chunk k+1, and the destination
+    keeps decoding between chunks.  ``finish()`` verifies the running
+    digest against the manifest; ``abort()`` frees the partial pages
+    (the destination half of the frees-both-sides failure rule)."""
+
+    def __init__(self, engine, sched, manifest):
+        self.engine = engine
+        self.sched = sched
+        self.manifest = manifest
+        # PagePoolExhausted propagates: the caller sheds/requeues the
+        # attach before any bytes were scattered
+        self.pages = sched.kv.pool.allocate(manifest["pages"])
+        self._keys = list(sched.pools["layers"][0])
+        self._h = hashlib.blake2b(digest_size=16)
+        self.seq = 0
+        self.nbytes = 0
+        self.done = False
+        self.aborted = False
+
+    def feed(self, header, raw):
+        """Scatter one frame's chunk.  Frames must arrive in seq order
+        (the sidecar is a pipe; order is inherent)."""
+        if header["seq"] != self.seq:
+            raise ValueError(
+                f"KV frame out of order: got seq {header['seq']}, "
+                f"want {self.seq}")
+        leaves = frame_leaves(header, raw)
+        n = header["pages"]
+        chunk = self.pages[self.seq * CHUNK_PAGES:
+                           self.seq * CHUNK_PAGES + n]
+        payload = leaves_to_payload(leaves, self._keys, chunk_bucket(n))
+        import_chunk(self.engine, self.sched._pools_ref, payload, chunk,
+                     self.sched.kv.pool.num_pages)
+        self._h.update(raw)
+        self.nbytes += len(raw)
+        self.seq += 1
+        if self.seq == self.manifest["chunks"]:
+            self.done = True
+
+    def verify(self):
+        """True when every chunk landed and the digest matches."""
+        return (self.done
+                and self.nbytes == self.manifest["bytes"]
+                and self._h.hexdigest() == self.manifest["digest"])
+
+    def abort(self):
+        if not self.aborted:
+            self.aborted = True
+            self.sched.kv.pool.free(self.pages)
+
+
+__all__ = ["CHUNK_PAGES", "chunk_bucket", "iter_chunks", "num_chunks",
+           "choose_transport", "make_manifest", "export_chunk",
+           "import_chunk", "payload_to_host", "leaves_to_payload",
+           "encode_frame", "decode_frame", "read_frame", "frame_leaves",
+           "export_chain_frames", "ChunkImporter", "PagePoolExhausted"]

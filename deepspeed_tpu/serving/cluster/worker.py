@@ -6,14 +6,30 @@ stdin ops (one JSON object per line):
    "eos_token_id": E?, "deadline_s": D?,
    "sampling": {...}?, "seed": S?, "grammar": {...}?,
    "sample_offset": O?,           # decoding policy; omitted = greedy
+   "handoff": true?,              # prefill role: export the chain at
+                                  # prompt end instead of decoding
    "trace": {"trace_id": ...}?}   # cluster trace ctx rides the wire
+  {"op": "attach", "rid": ..., "prompt": [...], "length": L,
+   "first_tok": T, "manifest": {...}, ...}   # decode role: adopt a
+                                  # relayed chain once its sidecar
+                                  # frames verify against the manifest
+  {"op": "attach_abort", "rid": ...}  # mid-transfer fault: free the
+                                      # partial destination chain
   {"op": "cancel", "rid": ...}
+  {"op": "fingerprint"}      # reply {"ev": "fp", ...} now (prefix
+                             # digests also ride every heartbeat)
   {"op": "drain"}            # stop admitting, finish in-flight
   {"op": "trace"}            # enable span tracing at runtime
   {"op": "fence", "epoch": N}  # router-HA fence: reject ops carrying a
                                # lower epoch, cancel in-flight requests
                                # dispatched under one (their tokens
                                # belong to a deposed router)
+
+KV page-chain payloads NEVER ride this JSONL wire: role workers get a
+dedicated binary sidecar fd (``--kv-fd-out`` on prefill: exported
+frames out; ``--kv-fd-in`` on decode: relayed frames in), carrying
+length-prefixed ``transport.encode_frame`` frames.  Only the manifest
+and the attach metadata travel on the control wire.
 
 Ops may carry "epoch": N (router-HA).  A submit whose epoch is below
 the worker's fence is REJECTED on the wire with a "fenced" done event
@@ -28,6 +44,14 @@ here would manufacture duplicate work on a crash):
   {"ev": "tok", "rid": ..., "t": ...}      # one generated token
   {"ev": "done", "rid": ..., "status": ..., "tokens": [...],
    "error": ...?}
+  {"ev": "handoff", "rid": ..., "prompt": [...], "length": L,
+   "first_tok": T, "manifest": {...}}       # prefill role: the chain's
+                                            # frames are on the sidecar
+  {"ev": "attached", "rid": ...}            # decode role: manifest
+                                            # verified, chain adopted
+  {"ev": "fp", "page_size": P, "digests": [...], ...}  # prefix cache
+                                            # fingerprint (also rides
+                                            # heartbeats as hb["fp"])
   {"ev": "spans", "spans": [...]}          # --trace: serialized span
                                            # batch, flushed with each
                                            # heartbeat (epoch-µs ts, so
@@ -105,6 +129,16 @@ def main(argv=None):
     p.add_argument("--trace-label", default=None,
                    help="process label for this worker's spans in the "
                         "merged fleet trace (the replica id)")
+    p.add_argument("--role", default="unified",
+                   choices=["unified", "prefill", "decode"],
+                   help="disaggregated-tier role; prefill/decode "
+                        "workers move KV chains over the sidecar fds")
+    p.add_argument("--kv-fd-out", type=int, default=None,
+                   help="prefill role: fd exported page-chain frames "
+                        "are written to (binary, length-prefixed)")
+    p.add_argument("--kv-fd-in", type=int, default=None,
+                   help="decode role: fd relayed page-chain frames "
+                        "arrive on (binary, length-prefixed)")
     p.add_argument("--hb-interval-s", type=float, default=0.2)
     p.add_argument("--threefry-partitionable", action="store_true",
                    help="mirror the parent's jax_threefry_partitionable "
@@ -118,6 +152,7 @@ def main(argv=None):
         import jax
         jax.config.update("jax_threefry_partitionable", True)
 
+    from deepspeed_tpu.serving.cluster import transport as tp
     from deepspeed_tpu.serving.scheduler import (TERMINAL,
                                                  ServingScheduler)
 
@@ -130,6 +165,61 @@ def main(argv=None):
         kv_dtype=args.kv_dtype,
         mem_telemetry=args.mem_telemetry,
         comm_telemetry=args.comm_telemetry)
+
+    fence = {"epoch": 0}   # highest router epoch seen on the wire
+
+    # ---- KV sidecar: the binary fd pair page-chain payloads ride.
+    # Prefill exports whole chains out; decode scatters relayed frames
+    # in, chunk by chunk, overlapped with its own decode horizon.
+    kv_out = None
+    if args.role == "prefill" and args.kv_fd_out is not None:
+        kv_out = os.fdopen(args.kv_fd_out, "wb")
+
+        def on_handoff(req, pages, length, first_tok):
+            """Export the finished prompt's chain: host-stage + frame
+            every chunk onto the sidecar, then free the local pages —
+            the source's HBM is reclaimed the moment the bytes leave
+            (a destination death later still requeues unified token-
+            exact off the journal, never off these pages)."""
+            t0 = time.monotonic()
+            frames, manifest = tp.export_chain_frames(
+                engine, sched.pools, pages, req._wire_rid,
+                epoch=fence["epoch"])
+            for fr in frames:
+                kv_out.write(fr)
+            kv_out.flush()
+            sched.kv.pool.free(pages)
+            sched.metrics.record_handoff_transport(
+                sched.step_idx, "out", manifest["bytes"],
+                manifest["chunks"], (time.monotonic() - t0) * 1e3)
+            _emit({"ev": "handoff", "rid": req._wire_rid,
+                   "prompt": [int(t) for t in req.orig_prompt],
+                   "length": int(length), "first_tok": int(first_tok),
+                   "manifest": manifest})
+
+        sched.on_handoff = on_handoff
+
+    kv_frames = queue.Queue()
+    if args.role == "decode" and args.kv_fd_in is not None:
+        def _kv_reader():
+            stream = os.fdopen(args.kv_fd_in, "rb")
+            try:
+                while True:
+                    fr = tp.read_frame(stream)
+                    if fr is None:
+                        return          # router hung up the sidecar
+                    kv_frames.put(fr)
+            except Exception:
+                pass
+
+        threading.Thread(target=_kv_reader, daemon=True).start()
+
+    # decode-side in-flight imports: wire rid -> {"imp": ChunkImporter,
+    # "op": the attach op (metadata for the eventual attach_handoff),
+    # "t0": arrival time}.  Frames racing ahead of their attach op on
+    # the other pipe park in orphans until the op lands.
+    imports = {}
+    orphans = {}
 
     tracer = {"t": None}
 
@@ -156,7 +246,6 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, lambda *a: term.update(flag=True))
 
     live = {}          # wire rid -> scheduler Request
-    fence = {"epoch": 0}   # highest router epoch seen on the wire
     eof = False
     last_hb = 0.0
     _emit({"ev": "ready"})
@@ -170,6 +259,76 @@ def main(argv=None):
         if req.error is not None:
             row["error"] = req.error
         _emit(row)
+
+    def shed(rid, error):
+        _emit({"ev": "done", "rid": rid, "status": "shed",
+               "tokens": [], "error": error})
+
+    def finish_import(rid):
+        """Last chunk landed: verify against the manifest, adopt the
+        chain.  A verification miss (truncated relay, corrupt frame)
+        frees the pages and sheds distinctly — the router requeues
+        unified off the journal, never off a half-imported chain."""
+        st = imports.pop(rid)
+        orphans.pop(rid, None)
+        imp, op = st["imp"], st["op"]
+        if not imp.verify():
+            imp.abort()
+            shed(rid, "KV transfer verification failed: "
+                      f"{imp.nbytes}B/{imp.seq} chunks vs manifest "
+                      f"{imp.manifest['bytes']}B/"
+                      f"{imp.manifest['chunks']}")
+            return
+        try:
+            req = sched.attach_handoff(
+                op["prompt"], imp.pages, op["length"], op["first_tok"],
+                max_new_tokens=op.get("max_new_tokens", 32),
+                eos_token_id=op.get("eos_token_id"),
+                on_token=on_token, deadline_s=op.get("deadline_s"),
+                trace_ctx=op.get("trace"),
+                sampling=op.get("sampling"), seed=op.get("seed"),
+                grammar=op.get("grammar"),
+                sample_offset=op.get("sample_offset", 0))
+        except Exception as e:
+            sched.kv.pool.free(imp.pages)
+            shed(rid, f"{type(e).__name__}: {e}")
+            return
+        req._wire_rid = rid
+        req._fence_epoch = st["epoch"]
+        live[rid] = req
+        sched.metrics.record_handoff_transport(
+            sched.step_idx, "in", imp.nbytes, imp.seq,
+            (time.monotonic() - st["t0"]) * 1e3)
+        _emit({"ev": "attached", "rid": rid})
+
+    def feed_frame(st, rid, header, raw):
+        imp = st["imp"]
+        try:
+            imp.feed(header, raw)
+        except Exception as e:
+            imports.pop(rid, None)
+            orphans.pop(rid, None)
+            imp.abort()
+            shed(rid, f"{type(e).__name__}: {e}")
+            return
+        if imp.done:
+            finish_import(rid)
+
+    def pump_kv():
+        """Scatter every sidecar frame that has landed.  Frames that
+        raced ahead of their attach op (separate pipes, no cross-fd
+        ordering) park in ``orphans`` until the op arrives."""
+        while True:
+            try:
+                header, raw = kv_frames.get_nowait()
+            except queue.Empty:
+                return
+            rid = header["rid"]
+            st = imports.get(rid)
+            if st is None:
+                orphans.setdefault(rid, []).append((header, raw))
+                continue
+            feed_frame(st, rid, header, raw)
 
     # stdin rides a reader thread: select()-then-readline() on a
     # BUFFERED stream drops the tail of a multi-line burst (readline
@@ -220,15 +379,15 @@ def main(argv=None):
                         op["prompt"], op.get("max_new_tokens", 32),
                         eos_token_id=op.get("eos_token_id"),
                         deadline_s=op.get("deadline_s"),
-                        on_token=on_token, trace_ctx=op.get("trace"),
+                        on_token=on_token,
+                        handoff=bool(op.get("handoff")),
+                        trace_ctx=op.get("trace"),
                         sampling=op.get("sampling"),
                         seed=op.get("seed"),
                         grammar=op.get("grammar"),
                         sample_offset=op.get("sample_offset", 0))
                 except Exception as e:
-                    _emit({"ev": "done", "rid": op["rid"],
-                           "status": "shed", "tokens": [],
-                           "error": f"{type(e).__name__}: {e}"})
+                    shed(op["rid"], f"{type(e).__name__}: {e}")
                     continue
                 req._wire_rid = op["rid"]
                 req._fence_epoch = op_epoch
@@ -236,6 +395,39 @@ def main(argv=None):
                     report(req)
                 else:
                     live[op["rid"]] = req
+            elif kind == "attach":
+                if op_epoch is not None and op_epoch < fence["epoch"]:
+                    sched.ha_fenced += 1
+                    _emit({"ev": "done", "rid": op["rid"],
+                           "status": "fenced", "tokens": [],
+                           "error": f"epoch {op_epoch} < fence "
+                                    f"{fence['epoch']}"})
+                    continue
+                try:
+                    # allocates the whole destination chain up front;
+                    # PagePoolExhausted sheds before any bytes scatter
+                    imp = tp.ChunkImporter(engine, sched,
+                                           op["manifest"])
+                except Exception as e:
+                    shed(op["rid"], f"{type(e).__name__}: {e}")
+                    continue
+                st = {"imp": imp, "op": op, "t0": time.monotonic(),
+                      "epoch": op_epoch}
+                imports[op["rid"]] = st
+                for header, raw in orphans.pop(op["rid"], []):
+                    feed_frame(st, op["rid"], header, raw)
+                    if op["rid"] not in imports:
+                        break     # fed to completion (or shed)
+            elif kind == "attach_abort":
+                rid = op.get("rid")
+                orphans.pop(rid, None)
+                st = imports.pop(rid, None)
+                if st is not None:
+                    st["imp"].abort()
+            elif kind == "fingerprint":
+                if sched.prefix_cache is not None:
+                    _emit({"ev": "fp",
+                           **sched.prefix_cache.fingerprint()})
             elif kind == "cancel":
                 req = live.get(op.get("rid"))
                 if req is not None:
@@ -256,6 +448,7 @@ def main(argv=None):
 
     while True:
         pump_stdin()
+        pump_kv()
         if term["flag"]:
             break
         work = sched.step() if (sched.requests or sched._inflight or
@@ -273,7 +466,14 @@ def main(argv=None):
                 # every subsequent heartbeat to the router
                 sched.comm_ledger()
             flush_spans()
-            _emit({"ev": "hb", "health": sched.health()})
+            hb = {"ev": "hb", "health": sched.health()}
+            if sched.prefix_cache is not None:
+                # the prefix fingerprint rides every heartbeat: the
+                # router scores this worker for a prompt exactly like
+                # an in-process replica, from digests instead of the
+                # trie it cannot see
+                hb["fp"] = sched.prefix_cache.fingerprint()
+            _emit(hb)
             last_hb = now
         if not work:
             time.sleep(0.01)

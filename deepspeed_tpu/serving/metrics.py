@@ -57,6 +57,12 @@ class ServingMetrics:
         self.spec_degrade_log = deque(maxlen=64)  # (step, rid, reason)
         self.handoffs = 0              # prefill->decode KV chains handed
         self.handoff_tokens = 0        # prefilled positions transferred
+        # handoff transport (cross-pool transfers; all 0 on shared_pool)
+        self.handoff_bytes_out = 0     # KV payload bytes exported
+        self.handoff_bytes_in = 0      # KV payload bytes imported
+        self.handoff_chunks = 0        # chunk dispatches either direction
+        self.handoff_transport_ms = 0.0  # wall ms moving chains
+        self.handoff_aborted = 0       # transfers torn down mid-chain
         # sequence-parallel prefill (long-context routing)
         self.seq_prefill_routed = 0    # prompts routed onto the sp path
         self.seq_prefill_chunks = 0    # sp chunk dispatches
@@ -374,12 +380,40 @@ class ServingMetrics:
 
     def record_handoff(self, step, tokens):
         """One prefill->decode KV handoff: ``tokens`` prefilled
-        positions changed owners without a byte of KV copied."""
+        positions changed owners (zero-copy by page id on a shared
+        pool; as a chunked chain transfer across pools — see
+        :meth:`record_handoff_transport`)."""
         self.handoffs += 1
         self.handoff_tokens += tokens
         self._write([
                 ("serving/handoff", 1, step),
                 ("serving/handoff_tokens", tokens, step)])
+
+    def record_handoff_transport(self, step, direction, nbytes, chunks,
+                                 ms):
+        """One completed chain transfer on THIS scheduler's side:
+        ``direction`` is ``"out"`` (chain exported off this pool) or
+        ``"in"`` (chain imported into it).  ``nbytes`` is exact KV
+        payload bytes — ``pages * engine.kv_page_bytes(...)`` — the
+        number the comm ledger's DCN tier aggregates (a cross-process
+        handoff is host-staged DCN traffic by definition)."""
+        if direction == "out":
+            self.handoff_bytes_out += int(nbytes)
+        else:
+            self.handoff_bytes_in += int(nbytes)
+        self.handoff_chunks += int(chunks)
+        self.handoff_transport_ms += float(ms)
+        self._write([
+                ("serving/comm/handoff_bytes", int(nbytes), step),
+                ("serving/handoff/chunks", int(chunks), step),
+                ("serving/handoff/transfer_ms", float(ms), step)])
+
+    def record_handoff_abort(self, step):
+        """A chain transfer torn down mid-flight (fault or death on
+        either side): partial pages were freed on both pools and the
+        request requeued unified."""
+        self.handoff_aborted += 1
+        self._write([("serving/handoff/aborted", 1, step)])
 
     def record_first_token(self, step, ttft_s):
         self.ttft_s.append(ttft_s)
@@ -455,6 +489,11 @@ class ServingMetrics:
             "spec_degraded": self.spec_degraded,
             "handoffs": self.handoffs,
             "handoff_tokens": self.handoff_tokens,
+            "handoff_bytes_out": self.handoff_bytes_out,
+            "handoff_bytes_in": self.handoff_bytes_in,
+            "handoff_chunks": self.handoff_chunks,
+            "handoff_transport_ms": round(self.handoff_transport_ms, 3),
+            "handoff_aborted": self.handoff_aborted,
             "seq_prefill_routed": self.seq_prefill_routed,
             "seq_prefill_chunks": self.seq_prefill_chunks,
             "seq_prefill_tokens": self.seq_prefill_tokens,
@@ -497,6 +536,31 @@ class ClusterMetrics:
         self.handoffs = 0             # prefill->decode packets delivered
         self.degraded_routes = 0      # routed unified for lack of a
                                       # healthy prefill worker
+        # handoff transport aggregates (cross-pool chain transfers)
+        self.handoff_transfers = 0    # completed chain transfers
+        self.handoff_bytes = 0        # KV payload bytes moved
+        self.handoff_chunks = 0       # chunk dispatches
+        self.handoff_transfer_ms = 0.0  # wall ms source-send -> adopted
+        self.handoff_aborts = 0       # transfers torn down mid-chain
+        self.handoff_paths = {"shared_pool": 0, "device_put": 0,
+                              "wire": 0}
+
+    def record_handoff_transfer(self, step, path, nbytes, chunks, ms):
+        """One chain transfer completed end to end through the router:
+        ``path`` is the three-way transport dispatch
+        (shared_pool | device_put | wire)."""
+        self.handoff_transfers += 1
+        self.handoff_bytes += int(nbytes)
+        self.handoff_chunks += int(chunks)
+        self.handoff_transfer_ms += float(ms)
+        self.handoff_paths[path] = self.handoff_paths.get(path, 0) + 1
+        self.event(step, "handoff_bytes", int(nbytes))
+
+    def record_handoff_abort(self, step):
+        """A chain transfer torn down mid-flight: partial pages freed
+        on both pools, request requeued unified."""
+        self.handoff_aborts += 1
+        self.event(step, "handoff_abort")
 
     def event(self, step, tag, value=1):
         if self.monitor is not None:
@@ -534,6 +598,16 @@ class ClusterMetrics:
             "restarts": self.restarts,
             "handoffs": self.handoffs,
             "degraded_routes": self.degraded_routes,
+            "handoff_transfers": self.handoff_transfers,
+            "handoff_bytes": self.handoff_bytes,
+            "handoff_chunks": self.handoff_chunks,
+            "handoff_transfer_ms": round(self.handoff_transfer_ms, 3),
+            "handoff_mb_per_s": round(
+                self.handoff_bytes / 1e6
+                / (self.handoff_transfer_ms / 1e3), 3)
+            if self.handoff_transfer_ms > 0 else 0.0,
+            "handoff_aborts": self.handoff_aborts,
+            "handoff_paths": dict(self.handoff_paths),
         }
 
 

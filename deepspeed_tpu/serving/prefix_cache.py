@@ -44,6 +44,10 @@ Lifecycle:
   cache is drained.
 """
 
+import hashlib
+
+import numpy as np
+
 
 class _Node:
     """One cached page: ``key`` is the exact ``page_size`` token IDs
@@ -291,3 +295,76 @@ class PrefixCache:
 
     def hit_rate(self):
         return self.hits / self.lookups if self.lookups else 0.0
+
+    def fingerprint(self, max_digests=4096):
+        """Wire-portable digest of what this cache could serve: one
+        :func:`prefix_digest` per cached page-aligned prefix (every
+        trie node digests the FULL token path from the root through
+        it), plus the raw hit counters.  A worker ships this at
+        heartbeat cadence / on the ``fingerprint`` protocol op; the
+        router-side :class:`FingerprintMatcher` then scores a
+        ``ProcessReplica`` for a prompt exactly like ``prefix_len``
+        scores an in-process replica — page-granular (the remote
+        copy-on-write partial isn't representable in a digest set,
+        and routing only needs the page-aligned score).  Pure walk:
+        no refcounts, no LRU touches."""
+        digests = []
+        stack = [(self._root, ())]
+        while stack and len(digests) < max_digests:
+            node, path = stack.pop()
+            for key, child in node.children.items():
+                child_path = path + key
+                digests.append(prefix_digest(child_path))
+                stack.append((child, child_path))
+        return {"page_size": self.page_size, "digests": digests,
+                "lookups": self.lookups, "hits": self.hits,
+                "tokens_reused": self.tokens_reused}
+
+
+def prefix_digest(tokens):
+    """Deterministic cross-process digest of a token prefix: blake2b
+    over the little-endian int32 token bytes.  NOT Python ``hash()``
+    — that is seed-randomized per process, and the whole point is
+    that the router and a worker compute identical digests."""
+    return hashlib.blake2b(np.asarray(tokens, "<i4").tobytes(),
+                           digest_size=8).hexdigest()
+
+
+class FingerprintMatcher:
+    """Router-side view of a remote worker's prefix cache, built from
+    shipped :meth:`PrefixCache.fingerprint` payloads.  ``match_len``
+    is the wire twin of ``PrefixCache.prefix_len``: the longest
+    page-aligned cached prefix of a prompt, in tokens."""
+
+    def __init__(self):
+        self.page_size = 0
+        self._digests = frozenset()
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_reused = 0
+
+    def update(self, fp):
+        """Absorb one shipped fingerprint (latest wins — the cache
+        mutates between heartbeats and stale entries only cost a
+        slightly off score, never correctness)."""
+        self.page_size = int(fp.get("page_size", 0) or 0)
+        self._digests = frozenset(fp.get("digests", ()))
+        self.lookups = int(fp.get("lookups", 0))
+        self.hits = int(fp.get("hits", 0))
+        self.tokens_reused = int(fp.get("tokens_reused", 0))
+
+    def match_len(self, tokens, limit=None):
+        """Longest page-aligned prefix of ``tokens[:limit]`` present
+        in the shipped digest set, in tokens.  Walks shortest-first
+        and stops at the first miss — the trie guarantees every
+        ancestor of a cached prefix is cached too, so a missing
+        k-page digest rules out every longer one."""
+        if not self._digests or not self.page_size:
+            return 0
+        n = len(tokens) if limit is None else min(limit, len(tokens))
+        matched = 0
+        for k in range(self.page_size, n + 1, self.page_size):
+            if prefix_digest(tokens[:k]) not in self._digests:
+                break
+            matched = k
+        return matched

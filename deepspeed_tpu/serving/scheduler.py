@@ -2609,6 +2609,13 @@ class ServingScheduler:
             "draining": self.draining,
             "handoffs": m.handoffs,
             "pending_handoffs": len(self._pending_attach),
+            # handoff transport (cross-pool chain transfers; all zero
+            # on the shared-pool path, which moves page ids only)
+            "handoff_bytes_out": m.handoff_bytes_out,
+            "handoff_bytes_in": m.handoff_bytes_in,
+            "handoff_chunks": m.handoff_chunks,
+            "handoff_transport_ms": round(m.handoff_transport_ms, 3),
+            "handoff_aborted": m.handoff_aborted,
             "completed": m.completed,
             "failed": m.failed,
             "shed": m.shed,

@@ -105,6 +105,13 @@ EVENT_TAXONOMY = {
     # disaggregation
     "serving/handoff": "one prefill->decode KV chain handed off",
     "serving/handoff_tokens": "prefilled positions transferred",
+    # handoff transport (cross-pool chain transfers; DCN-tier bytes)
+    "serving/comm/handoff_bytes":
+        "exact KV payload bytes one chain transfer moved over DCN",
+    "serving/handoff/chunks": "chunk dispatches of one chain transfer",
+    "serving/handoff/transfer_ms": "wall ms of one chain transfer",
+    "serving/handoff/aborted":
+        "chain transfer torn down mid-flight (pages freed both sides)",
     # HBM capacity / page-pool attribution (MemTelemetry; the page-state
     # taxonomy is conservation-exact: slot + prefix_shared + prefix_sole
     # + handoff + unattributed + free == num_pages at every step)
@@ -154,6 +161,10 @@ EVENT_TAXONOMY = {
     "cluster/retry": "backpressure admission retry",
     "cluster/handoff": "prefill->decode packet delivered",
     "cluster/handoff_degrade": "handoff failed; requeued unified",
+    "cluster/handoff_bytes":
+        "KV payload bytes one completed chain transfer moved",
+    "cluster/handoff_abort":
+        "mid-transfer teardown: partial pages freed, requeued unified",
     "cluster/drain": "replica drain completed",
     "cluster/restart": "replica restarted",
     # ------------------------------------------------ router HA (HaMetrics)

@@ -475,6 +475,14 @@ HEALTH_SCHEMA = {
     "draining": (bool,),
     "handoffs": (int,),
     "pending_handoffs": (int,),
+    # cross-pool KV transport (PR 19): chunked page-chain transfer
+    # counters — bytes exported/imported over device_put or the wire
+    # sidecar, chunk count, host-measured transfer time, aborts
+    "handoff_bytes_out": (int,),
+    "handoff_bytes_in": (int,),
+    "handoff_chunks": (int,),
+    "handoff_transport_ms": (float, int),
+    "handoff_aborted": (int,),
     "completed": (int,),
     "failed": (int,),
     "shed": (int,),

@@ -1,0 +1,638 @@
+"""KV page-chain transport (serving/cluster/transport) — the cross-pool
+half of disaggregated serving.
+
+Pins, fast lane:
+
+* **Chunk buckets** — a chain of any length compiles export/import
+  against at most the {1,2,4,8} power-of-two bucket set; the engine's
+  compile counters stay flat across handoff churn.
+* **Wire frame codec** — encode/decode/read round-trip bit-exact, and
+  the manifest's ``bytes`` field is HAND-DERIVED arithmetic (layers x
+  2 x page_size x kv_heads x head_dim x itemsize), agreeing with
+  ``engine.kv_page_bytes`` to the byte.
+* **Scale welding** — an int8/fp8 chunk moves its per-row scale leaves
+  with the payload: poisoning the destination pool's scales before the
+  import must leave the imported pages bit-identical to the source
+  (stale scales would dequantize garbage silently).
+* **Fingerprint parity** — ``FingerprintMatcher.match_len`` over a
+  shipped ``PrefixCache.fingerprint()`` equals the cache's own
+  page-aligned ``prefix_len`` — the wire twin the router scores
+  ProcessReplicas with.
+* **device_put transfers** — same-process/separate-pool groups serve
+  token-exact vs generate(), bill exact DCN-tier bytes, and a
+  ``cluster.handoff`` fault on a mid-transfer CHUNK frees partial
+  pages on both pools and requeues unified, zero lost.
+
+The slow lane runs the real thing: separate OS processes, chains over
+the binary KV sidecar wire, SIGKILL mid-transfer on either side, and
+fingerprint-routed prefix affinity beating round-robin on a 12-family
+workload.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2, gpt2_tiny
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import (ClusterRouter, ServingScheduler,
+                                   make_disaggregated_group)
+from deepspeed_tpu.serving.cluster import transport as tp
+from deepspeed_tpu.serving.cluster.journal import RequestJournal
+from deepspeed_tpu.serving.prefix_cache import (FingerprintMatcher,
+                                                prefix_digest)
+
+CFG = dict(num_slots=3, num_pages=16, page_size=16, max_pages_per_slot=8,
+           prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32", kv_cache_dtype="float32",
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    return eng
+
+
+def _oracle(engine, prompts, max_new):
+    return [
+        [int(t) for t in
+         engine.generate(p[None], max_new_tokens=m, do_sample=False)[
+             0, len(p):]]
+        for p, m in zip(prompts, max_new)]
+
+
+# --------------------------------------------------- chunking + codec
+
+
+def test_chunk_bucket_and_chunking_pins():
+    """The bucket discipline: any chain length maps onto the {1,2,4,8}
+    bucket set (CHUNK_PAGES=8), so export/import hold at most four
+    compiled signatures each, forever."""
+    assert [tp.chunk_bucket(n) for n in (1, 2, 3, 4, 5, 7, 8)] == \
+        [1, 2, 4, 4, 8, 8, 8]
+    buckets = set()
+    for chain_len in range(1, 65):
+        chunks = list(tp.iter_chunks(list(range(chain_len))))
+        assert sum(len(c) for c in chunks) == chain_len
+        assert len(chunks) == tp.num_chunks(chain_len)
+        assert all(len(c) == tp.CHUNK_PAGES for c in chunks[:-1])
+        buckets |= {tp.chunk_bucket(len(c)) for c in chunks}
+    assert buckets <= {1, 2, 4, 8}, \
+        "chain-length churn grew the bucket set"
+
+
+def test_frame_codec_round_trip():
+    """encode -> decode -> frame_leaves is bit-exact for mixed-dtype
+    leaf sets (the int8 payload + f32 scales shape of a quantized
+    pool), and read_frame consumes a stream frame-by-frame to EOF."""
+    rng = np.random.default_rng(0)
+    leaves = [rng.integers(-128, 127, (3, 16, 4, 16)).astype(np.int8),
+              rng.random((3, 16, 4, 1)).astype(np.float32),
+              rng.random((3, 16, 4, 16)).astype(np.float32)]
+    frame = tp.encode_frame("r1", 0, 2, leaves)
+    header, raw = tp.decode_frame(frame)
+    assert header["rid"] == "r1" and header["seq"] == 0 \
+        and header["of"] == 2 and header["pages"] == 3
+    back = tp.frame_leaves(header, raw)
+    assert len(back) == len(leaves)
+    for a, b in zip(leaves, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    # a second frame on the same stream; then clean EOF
+    frame2 = tp.encode_frame("r1", 1, 2, leaves[:1])
+    stream = io.BytesIO(frame + frame2)
+    h0, _ = tp.read_frame(stream)
+    h1, _ = tp.read_frame(stream)
+    assert (h0["seq"], h1["seq"]) == (0, 1)
+    assert tp.read_frame(stream) is None, "EOF must read as None"
+    with pytest.raises(ValueError):
+        tp.decode_frame(b"XX99" + frame[4:])
+
+
+def test_export_chain_exact_bytes_hand_derived(engine):
+    """The DCN ledger bills EXACT bytes: for a pinned 5-page float32
+    chain the manifest's byte count equals the hand-derived
+    ``layers * 2(K+V) * page_size * kv_heads * head_dim * 4`` — and
+    agrees with engine.kv_page_bytes, the capacity ledgers' unit."""
+    cfg = gpt2_tiny()
+    page_size, pages = 16, [2, 5, 7, 11, 3]
+    pools = engine.init_paged_cache(32, page_size)
+    frames, manifest = tp.export_chain_frames(engine, pools, pages, "r0",
+                                              epoch=3)
+    hand = cfg.num_layers * 2 * page_size * cfg.num_heads * \
+        (cfg.hidden_size // cfg.num_heads) * 4
+    assert manifest == {"pages": 5, "chunks": 1,
+                        "bytes": 5 * hand,
+                        "digest": manifest["digest"], "epoch": 3}
+    assert hand == engine.kv_page_bytes(page_size)
+    assert len(manifest["digest"]) == 32    # blake2b-128 hex
+    # the frames carry exactly the manifest's bytes, nothing more
+    total = sum(len(tp.decode_frame(f)[1]) for f in frames)
+    assert total == manifest["bytes"]
+    # deterministic: a re-export of the same chain hashes identically
+    _, again = tp.export_chain_frames(engine, pools, pages, "r0", epoch=3)
+    assert again["digest"] == manifest["digest"]
+
+
+def test_compile_signatures_one_per_bucket():
+    """Export/import compile once per power-of-two bucket, NOT per
+    chain length: three distinct chunk lengths in bucket 4 plus one in
+    bucket 8 leave exactly two signatures on each primitive."""
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32",
+        kv_cache_dtype="float32", mesh={"data": 1, "model": 1})
+    eng.init_params()
+    pools = eng.init_paged_cache(32, 16)
+    from deepspeed_tpu.serving.scheduler import _PoolsRef
+    ref = _PoolsRef(eng.init_paged_cache(32, 16))
+    for chunk in ([1, 2, 3], [4, 5, 6, 7], [8, 9, 10],      # bucket 4
+                  [1, 2, 3, 4, 5, 6, 7, 8]):                # bucket 8
+        payload, bucket = tp.export_chunk(eng, pools, chunk)
+        assert bucket == tp.chunk_bucket(len(chunk))
+        tp.import_chunk(eng, ref, payload, chunk, 32)
+    assert eng.serving_chain_export_compile_count() == 2, \
+        "export must compile per bucket, not per chunk length"
+    assert eng.serving_chain_import_compile_count() == 2, \
+        "import must compile per bucket, not per chunk length"
+
+
+# ----------------------------------------------------- scale welding
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_quantized_scales_travel_with_chunk(kv_dtype):
+    """Stale-scale mutation probe: poison the DESTINATION pool's scale
+    leaves, then import a quantized chunk.  Every leaf of the imported
+    pages — payload AND per-row scales — must equal the source bit-for-
+    bit, and non-imported pages must keep the poison (the ``mode=drop``
+    mask can't splash).  A transport that moved int8/fp8 payload
+    without its scales would pass a payload-only check and dequantize
+    garbage in production."""
+    from deepspeed_tpu.ops.quant.kv import fp8_supported
+    if kv_dtype == "fp8" and not fp8_supported():
+        pytest.skip("fp8 not supported on this backend")
+    import jax.numpy as jnp
+    eng = deepspeed_tpu.init_inference(
+        model=GPT2(gpt2_tiny()), dtype="float32", kv_cache_dtype=kv_dtype,
+        mesh={"data": 1, "model": 1})
+    eng.init_params()
+    rng = np.random.default_rng(7)
+    src = eng.init_paged_cache(16, 16)
+    # fill the source pool with recognizable per-leaf values
+    src = {"layers": [
+        {name: jnp.asarray(
+            rng.integers(1, 100, arr.shape).astype(np.float32)
+        ).astype(arr.dtype) for name, arr in layer.items()}
+        for layer in src["layers"]]}
+    assert any("scale" in name for name in src["layers"][0]), \
+        "quantized pool must carry scale leaves"
+
+    from deepspeed_tpu.serving.scheduler import _PoolsRef
+    dst = eng.init_paged_cache(16, 16)
+    dst = _PoolsRef({"layers": [
+        {name: jnp.full(arr.shape, 77).astype(arr.dtype)
+         for name, arr in layer.items()} for layer in dst["layers"]]})
+    # the poison as the dtype actually stores it (fp8 rounds 77)
+    poison = [{name: np.asarray(arr.astype(jnp.float32))
+               for name, arr in layer.items()}
+              for layer in dst.pools["layers"]]
+
+    src_pages, dst_pages = [2, 5, 9], [1, 3, 7]
+    payload, _ = tp.export_chunk(eng, src, src_pages)
+    # wire round-trip included: host-stage, frame, decode, rebuild
+    leaves = tp.payload_to_host(payload, len(src_pages))
+    header, raw = tp.decode_frame(
+        tp.encode_frame("r", 0, 1, leaves))
+    payload2 = tp.leaves_to_payload(
+        tp.frame_leaves(header, raw), list(src["layers"][0]),
+        tp.chunk_bucket(len(src_pages)))
+    tp.import_chunk(eng, dst, payload2, dst_pages, 16)
+
+    untouched = sorted(set(range(16)) - set(dst_pages))
+    for li, layer in enumerate(dst.pools["layers"]):
+        for name, arr in layer.items():
+            got = np.asarray(arr.astype(jnp.float32))
+            want = np.asarray(
+                src["layers"][li][name].astype(jnp.float32))
+            np.testing.assert_array_equal(
+                got[dst_pages], want[src_pages],
+                err_msg=f"layer {li} leaf {name} did not travel")
+            np.testing.assert_array_equal(
+                got[untouched], poison[li][name][untouched],
+                err_msg=f"import splashed outside its pages ({name})")
+
+
+# ------------------------------------------------- fingerprint parity
+
+
+def test_fingerprint_matcher_parity(engine):
+    """match_len over a shipped fingerprint == the cache's own
+    page-aligned prefix_len for every probe — hit, partial hit, and
+    miss — and prefix_digest is process-stable (blake2b, not the
+    seed-randomized hash())."""
+    rng = np.random.default_rng(11)
+    sched = ServingScheduler(engine, prefix_cache=True, **CFG)
+    head = rng.integers(0, 256, 37).astype(np.int32)
+    sched.submit(head, max_new_tokens=4)
+    sched.run()
+
+    fp = sched.prefix_cache.fingerprint()
+    m = FingerprintMatcher()
+    m.update(fp)
+    probes = [head,                                       # full hit
+              head[:20],                                  # partial
+              np.concatenate([head, [1, 2, 3]]),          # extension
+              rng.integers(0, 256, 24).astype(np.int32)]  # miss
+    for p in probes:
+        want = sched.prefix_cache.prefix_len(p, limit=len(p) - 1)
+        # align the reference to page granularity: the wire digest set
+        # can't represent a partial-page copy-on-write match
+        want -= want % CFG["page_size"]
+        got = m.match_len(p, limit=len(p) - 1)
+        assert got == want, (len(p), got, want)
+    assert m.match_len(probes[3]) == 0
+    # digest stability is the whole point: recompute == shipped
+    assert prefix_digest(list(head[:16])) in set(fp["digests"])
+
+
+# ------------------------------------------- journal manifest records
+
+
+def test_journal_manifest_dump_round_trip(tmp_path):
+    """A HANDOFF record's transfer manifest (chunks, exact bytes,
+    digest, epoch) and source replica survive journal.dump() and a
+    WAL-replay reconstruction bit-identically — what a takeover
+    re-drives from."""
+    class _ListWal:
+        def __init__(self):
+            self.records = []
+
+        def append(self, rec, epoch=0):
+            self.records.append(dict(rec))
+            return True
+
+        def snapshot(self, snap, epoch=0):
+            return True
+
+        def position(self):
+            return len(self.records)
+
+    wal = _ListWal()
+    j = RequestJournal(wal=wal)
+    e, _ = j.admit([1, 2, 3], 8, rid="r0")
+    man = tp.make_manifest(11, 11 * 16384, "ab" * 16, epoch=4)
+    j.handoff(e, "g0", [1, 2, 3], [5, 6, 7], 3, 42, manifest=man,
+              src="g0-prefill0")
+    assert man["chunks"] == 2    # 11 pages / CHUNK_PAGES=8
+    path = str(tmp_path / "journal.json")
+    j.dump(path)
+    dumped = json.loads(open(path).read())
+    rec = dumped["pending_packets"]["r0"]
+    assert rec["manifest"] == man and rec["src"] == "g0-prefill0"
+    # WAL replay rebuilds the same pending packet, manifest intact
+    j2 = RequestJournal.replay(wal.records)
+    assert j2.pending_packets["r0"]["manifest"] == man
+    assert j2.pending_packets["r0"]["src"] == "g0-prefill0"
+    assert j2.entries["r0"].state == "handoff"
+
+
+# --------------------------------------------- device_put transfers
+
+
+def test_device_put_transfer_oracle(engine):
+    """Same-process separate-pool group: every request rides an
+    export -> device_put -> import chain transfer and finishes
+    token-exact vs generate(); the DCN ledger bills exact page-chain
+    bytes and the compile set stays within the bucket pin."""
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (5, 11, 21, 33)]
+    max_new = [8, 6, 10, 4]
+    want = _oracle(engine, prompts, max_new)
+
+    reps = make_disaggregated_group(
+        engine, num_prefill=1, num_decode=1, num_pages=32, page_size=16,
+        transport="device_put", num_slots=3, max_pages_per_slot=8,
+        prefill_chunk=8)
+    router = ClusterRouter(reps)
+    entries = [router.submit(p, max_new_tokens=m)
+               for p, m in zip(prompts, max_new)]
+    got = router.run()
+    h = router.health()
+    assert h["handoffs"] == len(prompts)
+    assert h["handoff_paths"]["device_put"] == len(prompts)
+    assert h["handoff_aborts"] == 0
+    for e, w in zip(entries, want):
+        assert e.state == "finished" and got[e.rid] == w, \
+            (e.rid, e.state, e.error, e.replica_history)
+    # exact-bytes: each prompt's chain is its page-aligned prefill
+    # footprint; the ledger must bill page_bytes per page, no slack
+    page_bytes = engine.kv_page_bytes(16)
+    chain_pages = sum(-(-len(p) // 16) for p in prompts)
+    assert h["handoff_bytes"] == chain_pages * page_bytes, \
+        (h["handoff_bytes"], chain_pages, page_bytes)
+    assert engine.serving_chain_export_compile_count() <= 4
+    assert engine.serving_chain_import_compile_count() <= 4
+    router.audit()
+    for rep in reps:
+        assert rep.sched.kv.pool.pages_in_use == 0, f"{rep.id} leaked"
+
+
+def test_device_put_mid_transfer_fault_requeues_unified(engine):
+    """``cluster.handoff`` fires per CHUNK on the device_put path; an
+    armed raise mid-chain frees the partial pages on BOTH pools and
+    requeues the request unified — zero lost, token-exact, no leak."""
+    rng = np.random.default_rng(22)
+    # page_size 4 -> an 83-token prompt spans 21 pages = 3 chunks
+    prompts = [rng.integers(0, 256, 83).astype(np.int32),
+               rng.integers(0, 256, 17).astype(np.int32)]
+    want = _oracle(engine, prompts, [4, 4])
+    reps = make_disaggregated_group(
+        engine, num_prefill=1, num_decode=1, num_pages=64, page_size=4,
+        transport="device_put", num_slots=3, max_pages_per_slot=32,
+        prefill_chunk=8)
+    router = ClusterRouter(reps)
+    inj = faults.FaultInjector(seed=0)
+    plan = inj.on("cluster.handoff", nth=2,
+                  exc=RuntimeError("DCN link flapped"))
+    with faults.injected(inj):
+        entries = [router.submit(p, max_new_tokens=4) for p in prompts]
+        got = router.run()
+    assert plan.fired == 1, "the fault must land on a mid-chain chunk"
+    h = router.health()
+    # the abort is reported DISTINCTLY: the transfer ledger counts it
+    # (alongside the cluster/handoff_degrade event) and the completed
+    # count excludes it — the re-driven attempt lands exactly once
+    assert h["handoff_aborts"] == 1
+    assert h["handoff_transfers"] == len(prompts)
+    assert h["failed"] == 0 and h["shed"] == 0
+    for e, w in zip(entries, want):
+        assert e.state == "finished" and got[e.rid] == w, \
+            (e.rid, e.state, e.error, e.replica_history)
+    router.audit()
+    for rep in reps:
+        assert rep.sched.kv.pool.pages_in_use == 0, \
+            f"{rep.id} leaked transfer pages"
+
+
+# ------------------------------------------- cross-process (the wire)
+
+
+def _wire_group(**kw):
+    from deepspeed_tpu.serving.cluster.router import \
+        make_process_disaggregated_group
+    cfg = dict(num_prefill=1, num_decode=1, model="gpt2-tiny",
+               num_pages=32, page_size=16, num_slots=3, term_grace_s=5.0)
+    cfg.update(kw)
+    return make_process_disaggregated_group(**cfg)
+
+
+def _settle_census(router, reps, deadline_s=60.0):
+    """Pump until every worker's heartbeat reports an EMPTY pool —
+    the cross-process census: prefill freed every exported chain,
+    decode freed every completed one, zero pages stranded."""
+    import time as _time
+    deadline = _time.monotonic() + deadline_s
+    while _time.monotonic() < deadline:
+        router.step()
+        healths = [r.last_health for r in reps if r.state == "up"]
+        if healths and all(h and h["free_pages"] == r._cfg["num_pages"]
+                           for h, r in zip(healths, [x for x in reps
+                                                     if x.state == "up"])):
+            return
+        _time.sleep(0.05)
+    leaked = {r.id: (r.last_health or {}).get("free_pages")
+              for r in reps if r.state == "up"}
+    raise AssertionError(f"pages stranded after drain: {leaked}")
+
+
+@pytest.mark.slow
+def test_wire_disagg_oracle_token_exact(engine):
+    """The cross-process acceptance oracle: prefill and decode in
+    SEPARATE OS processes with separate pools, mixed traffic — every
+    request's chain rides the binary KV sidecar wire and finishes
+    token-exact vs the in-process generate() reference; the DCN ledger
+    bills exact bytes; both pools drain to an exact empty census."""
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (5, 11, 21, 33, 17)]
+    max_new = [8, 6, 10, 4, 8]
+    want = _oracle(engine, prompts, max_new)
+    reps = _wire_group()
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        router = ClusterRouter(reps, heartbeat_misses=1)
+        entries = [router.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        got = router.run(max_steps=500000)
+        h = router.health()
+        assert h["handoffs"] == len(prompts)
+        assert h["handoff_paths"]["wire"] == len(prompts)
+        assert h["handoff_aborts"] == 0 and h["failed"] == 0
+        # exact DCN-tier bytes: page-aligned prefill footprint x the
+        # engine's per-page byte cost, across every transferred chain
+        page_bytes = engine.kv_page_bytes(16)
+        chain_pages = sum(-(-len(p) // 16) for p in prompts)
+        assert h["handoff_bytes"] == chain_pages * page_bytes
+        for e, w in zip(entries, want):
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert got[e.rid] == w, (e.rid, e.replica_history)
+            assert e.replica_history[0] == "w0-prefill0" and \
+                e.replica_history[-1] == "w0-decode0"
+        router.audit()
+        _settle_census(router, reps)
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+@pytest.mark.slow
+def test_wire_transfer_fault_mid_chunk_requeues_unified(engine):
+    """``cluster.handoff`` fires per relayed CHUNK on the wire path
+    too; an armed raise mid-relay aborts the wire attach (the decode
+    worker frees its partial chain), requeues the request unified —
+    zero lost, token-exact, empty census after."""
+    rng = np.random.default_rng(32)
+    prompts = [rng.integers(0, 256, 33).astype(np.int32),
+               rng.integers(0, 256, 21).astype(np.int32)]
+    max_new = [6, 6]
+    want = _oracle(engine, prompts, max_new)
+    reps = _wire_group()
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        router = ClusterRouter(reps, heartbeat_misses=1)
+        inj = faults.FaultInjector(seed=0)
+        plan = inj.on("cluster.handoff", nth=1,
+                      exc=RuntimeError("DCN flow torn"))
+        with faults.injected(inj):
+            entries = [router.submit(p, max_new_tokens=m)
+                       for p, m in zip(prompts, max_new)]
+            got = router.run(max_steps=500000)
+        assert plan.fired == 1
+        h = router.health()
+        assert h["handoff_aborts"] >= 1 and h["failed"] == 0
+        for e, w in zip(entries, want):
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert got[e.rid] == w, (e.rid, e.replica_history)
+        router.audit()
+        _settle_census(router, reps)
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+@pytest.mark.slow
+def test_wire_source_sigkill_mid_transfer_zero_lost(engine):
+    """SIGKILL the prefill worker the moment a handoff packet is in
+    flight: whatever the wire had fully buffered still lands, the rest
+    re-drives unified off the journal — every request finishes
+    token-exact, zero lost, and the surviving pool's census is exact."""
+    import time as _time
+    rng = np.random.default_rng(33)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (21, 33, 17, 29)]
+    max_new = [8, 8, 8, 8]
+    want = _oracle(engine, prompts, max_new)
+    reps = _wire_group()
+    prefill = next(r for r in reps if r.role == "prefill")
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        router = ClusterRouter(reps, heartbeat_misses=1)
+        entries = [router.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        deadline = _time.monotonic() + 600
+        killed = False
+        while _time.monotonic() < deadline:
+            if not router.step():
+                break
+            if not killed and (router._packets or router._transfers):
+                prefill.kill()      # mid-transfer, the real signal
+                killed = True
+            _time.sleep(0.01)
+        assert killed, "no handoff was ever in flight"
+        got = router.run(max_steps=500000)
+        h = router.health()
+        assert h["failovers"] == 1 and h["failed"] == 0
+        assert h["replicas"]["w0-prefill0"]["state"] == "dead"
+        assert h["degraded"], "losing the prefill tier must degrade"
+        for e, w in zip(entries, want):
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert got[e.rid] == w, (e.rid, e.replica_history)
+        router.audit()
+        _settle_census(router, [r for r in reps if r.state == "up"])
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+@pytest.mark.slow
+def test_wire_decode_sigkill_mid_stream_zero_lost(engine):
+    """SIGKILL the decode worker after handoffs started: in-flight
+    relays stop, adopted streams replay token-exact from the journal
+    onto the surviving prefill worker (serving unified, last resort) —
+    zero lost, zero duplicated."""
+    import time as _time
+    rng = np.random.default_rng(34)
+    prompts = [rng.integers(0, 256, n).astype(np.int32)
+               for n in (21, 33, 17, 29)]
+    max_new = [12, 12, 12, 12]
+    want = _oracle(engine, prompts, max_new)
+    reps = _wire_group()
+    decode = next(r for r in reps if r.role == "decode")
+    try:
+        for rep in reps:
+            rep.wait_ready()
+        router = ClusterRouter(reps, heartbeat_misses=1)
+        entries = [router.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        deadline = _time.monotonic() + 600
+        while _time.monotonic() < deadline:
+            if not router.step():
+                break
+            if router.health()["handoffs"] >= 1:
+                decode.kill()       # streams adopted, now die
+                break
+            _time.sleep(0.01)
+        got = router.run(max_steps=500000)
+        h = router.health()
+        assert h["failovers"] == 1 and h["failed"] == 0
+        assert h["replays"] >= 1, "the dead decode worker held streams"
+        for e, w in zip(entries, want):
+            assert e.state == "finished", (e.rid, e.state, e.error)
+            assert got[e.rid] == w, (e.rid, e.replica_history)
+        router.audit()
+        _settle_census(router, [r for r in reps if r.state == "up"])
+    finally:
+        for rep in reps:
+            rep.die("test teardown")
+
+
+@pytest.mark.slow
+def test_process_fingerprint_routing_hit_rate():
+    """Prefix-fingerprint wire routing parity: 12 prefix families, 4
+    paced waves over 2 worker PROCESSES.  Fingerprint-scored routing
+    pins each family to one worker's cache (3/4 of lookups hit);
+    round-robin sprays members and eats a cold miss per (family,
+    replica) pair, landing at or below the 0.583 baseline."""
+    import time as _time
+    from deepspeed_tpu.serving import ProcessReplica
+
+    rng = np.random.default_rng(3)
+    heads = [rng.integers(0, 256, 32).astype(np.int32)
+             for _ in range(12)]   # 32 tokens = 2 exact pages
+    waves = []
+    for _ in range(4):
+        members = [np.concatenate(
+            [h, rng.integers(0, 256, 8).astype(np.int32)])
+            for h in heads]
+        waves.append([members[i] for i in rng.permutation(12)])
+
+    def serve(routing):
+        reps = [ProcessReplica(f"{routing}-w{i}", model="gpt2-tiny",
+                               num_pages=64, page_size=16, num_slots=3,
+                               prefix_cache=True, term_grace_s=5.0)
+                for i in range(2)]
+        try:
+            for rep in reps:
+                rep.wait_ready()
+            router = ClusterRouter(reps, heartbeat_misses=1,
+                                   routing=routing)
+            for wi, wave in enumerate(waves):
+                entries = [router.submit(p, max_new_tokens=4)
+                           for p in wave]
+                router.run(max_steps=500000)
+                assert all(e.state == "finished" for e in entries)
+                # sync fingerprints before the next wave: ask, then
+                # pump until THIS wave's shipped counters land
+                # router-side (every request did one cache lookup)
+                for rep in reps:
+                    rep.request_fingerprint()
+                deadline = _time.monotonic() + 60
+                while _time.monotonic() < deadline:
+                    router.step()
+                    if sum(rep.prefix_stats()[1]
+                           for rep in reps) >= 12 * (wi + 1):
+                        break
+                    _time.sleep(0.02)
+            hits = sum(rep.prefix_stats()[0] for rep in reps)
+            lookups = sum(rep.prefix_stats()[1] for rep in reps)
+            assert lookups == 48, lookups
+            return hits / lookups
+        finally:
+            for rep in reps:
+                rep.die("test teardown")
+
+    pf, rr = serve("prefix"), serve("round_robin")
+    assert pf >= 0.75, \
+        f"fingerprint routing hit rate {pf} below the 0.75 pin"
+    assert rr <= 0.583, \
+        f"round-robin baseline {rr} above the 0.583 bound"
+    assert pf > rr

@@ -142,6 +142,9 @@ def _drive_all_serving_events(m):
     m.record_policy_dispatch(1, 3)
     m.record_grammar_violation(1, rid=1)
     m.record_handoff(1, 32)
+    m.record_handoff_transport(1, "out", 4096, 2, 1.5)
+    m.record_handoff_transport(1, "in", 4096, 2, 1.5)
+    m.record_handoff_abort(1)
     m.record_seq_prefill_route(1, 256, 16)
     m.record_seq_prefill_chunk(1, 128)
     m.record_seq_prefill_degrade(1)
@@ -181,6 +184,8 @@ def test_event_taxonomy_pins_every_emitted_name():
         cm.event(1, tag)
     for state in ("finished", "failed", "shed", "cancelled"):
         cm.record_terminal(1, state)
+    cm.record_handoff_transfer(1, "wire", 4096, 2, 1.5)
+    cm.record_handoff_abort(1)
     ha = HaMetrics(rb)
     ha.record_gauges(1, epoch=1, fenced_writes=0, wal_records=3)
     ha.record_takeover(2, epoch=2, fenced_writes=1, wal_records=5)

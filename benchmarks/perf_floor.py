@@ -178,6 +178,19 @@ CHECKS = [
      "disagg.ttft_ratio_wire_vs_device_put", "info", None),
     ("disagg wire bytes per handoff (exact by construction)",
      "disagg.wire.bytes_per_handoff", "info", None),
+    # multi-tenant multi-LoRA rows (PR 20): the slowdown ratio prices
+    # the per-slot adapter gather + rank-bucket delta einsums on a CPU
+    # rig (the cost model's _fit_reference_terms reads this exact
+    # path); the fairness share is the two weighted tenants'
+    # page-seconds split over one pool — both re-anchor on a TPU
+    # round in the same JSON paths.  Info, never gating
+    ("multi-LoRA slowdown (base vs 8 adapters)",
+     "multi_lora.slowdown_tokens_per_sec", "info", None),
+    ("multi-LoRA tokens/s (8 adapters)",
+     "multi_lora.lora_8.tokens_per_sec", "info", None),
+    ("multi-LoRA gold-tenant page-seconds share",
+     "multi_lora.lora_8.fairness.page_seconds_share.gold", "info",
+     None),
 ]
 
 TRACING_OVERHEAD_CEILING = 0.05   # the committed <5% contract

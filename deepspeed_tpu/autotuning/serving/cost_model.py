@@ -85,6 +85,14 @@ DEFAULT_KNOBS = {
     # `sequence_axis_size` live signal defaults to 1).
     "seq_parallel_threshold": 0,
     "prefill_reserve_frac": None,      # scheduler default: whole pool
+    # multi-tenant serving (PR 20): the adapter roster size and rank
+    # (-> the rank bucket, a jit-signature input AND the per-token
+    # delta-einsum cost), and the per-tenant page quota (a feasibility
+    # bound exactly like the slot table).  0 adapters = tenancy priced
+    # as off (the base path is byte-identical by construction).
+    "num_adapters": 0,
+    "adapter_rank": 4,
+    "tenant_page_quota": None,
 }
 
 # dispatch overhead billed in token-equivalents for the TTFT prefill
@@ -99,6 +107,14 @@ _DISPATCH_TOKEN_EQUIV = 16.0
 # candidates matters for ranking, and on a 1-device rig the ledger's
 # bytes are zero so the term vanishes entirely.
 _NOMINAL_ICI_BYTES_PER_S = 1e11
+
+# per-rank-unit relative cost of the multi-LoRA delta einsums: every
+# injected projection pays two [.., in] x [in, r] / [.., r] x [r, out]
+# contractions plus the per-slot factor gather, so the slowdown scales
+# with the RANK BUCKET, not the adapter count (adapter churn within a
+# bucket is free by construction).  A committed ``multi_lora`` bench
+# section overrides this prior with the measured figure.
+_LORA_RANK_COST = 0.004
 
 
 def committed_bench_path():
@@ -225,6 +241,12 @@ class ServingCostModel:
         kvq = bench.get("kv_quant", {}).get("same_slots", {})
         self._kv_quant_speed_ref = float(
             kvq.get("speedup_tokens_per_sec") or 1.0)
+        # multi-LoRA decode slowdown vs base at the committed rank
+        # bucket (1.0 + analytic prior when the section is absent)
+        ml = bench.get("multi_lora", {})
+        self._lora_slowdown_ref = float(
+            ml.get("slowdown_tokens_per_sec") or 0.0)
+        self._lora_rank_ref = int(ml.get("rank_bucket") or 0)
 
     # ------------------------------------------------------- feasibility
     @staticmethod
@@ -273,6 +295,13 @@ class ServingCostModel:
                         f"(kv_dtype={k['kv_dtype']}) = "
                         f"{k['num_pages'] * bpp} B exceeds the pool "
                         f"budget of {self.pool_bytes_budget} B")
+        # a tenant quota below the worst-case request's page need can
+        # never admit it (the scheduler sheds with exactly this reason)
+        if k["tenant_page_quota"] is not None and \
+                pages_needed > int(k["tenant_page_quota"]):
+            return (f"worst-case request of {need} tokens needs "
+                    f"{pages_needed} pages > tenant_page_quota="
+                    f"{k['tenant_page_quota']}")
         return None
 
     # -------------------------------------------------------- prediction
@@ -293,7 +322,28 @@ class ServingCostModel:
                  / max(1, mix.max_prompt_tokens))
         gain = (self._prefix_speedup_ref - 1.0) * \
             (share / self._prefix_share_ref)
+        if int(k["num_adapters"]) > 0:
+            # per-(tenant, adapter) namespace isolation splits the
+            # radix: identical prompts under different adapters never
+            # share pages, so the expected hit rate divides across the
+            # roster (+1 for the base-model namespace)
+            gain /= int(k["num_adapters"]) + 1
         return 1.0 + max(0.0, gain)
+
+    def _lora_factor(self, k):
+        """Multi-LoRA decode slowdown: rank-bucket-proportional delta
+        einsum cost (adapter count is free within a bucket — the stack
+        gather is O(1) per slot).  The committed ``multi_lora`` bench
+        section anchors the slope when present; the analytic prior
+        prices it otherwise."""
+        if int(k["num_adapters"]) <= 0:
+            return 1.0
+        rb = 1 << (max(1, int(k["adapter_rank"])) - 1).bit_length() \
+            if int(k["adapter_rank"]) > 1 else 1
+        if self._lora_slowdown_ref > 0 and self._lora_rank_ref > 0:
+            slope = (self._lora_slowdown_ref - 1.0) / self._lora_rank_ref
+            return 1.0 / (1.0 + max(0.0, slope) * rb)
+        return 1.0 / (1.0 + _LORA_RANK_COST * rb)
 
     def _spec_factor(self, k):
         mix = self.mix
@@ -379,12 +429,19 @@ class ServingCostModel:
         # pressure term below, not a speed claim)
         kvq = self._kv_quant_speed_ref \
             if k["kv_dtype"] in ("int8", "fp8") else 1.0
+        lora = self._lora_factor(k)
         demand, pages_per_req = self._page_demand(k)
         pressure = min(1.0, k["num_pages"] / demand) if demand else 1.0
         # under demand > capacity the scheduler shrinks horizons and
         # evicts: discount toward the measured H=1 regime floor
         pressure = max(pressure, 0.25)
-        rate = base * prefix * spec * overlap * pressure * kvq
+        # a page quota caps the effective pool one tenant's traffic can
+        # occupy; with the tuner's single-tenant measurement mix the
+        # quota binds exactly like a smaller pool would
+        if k["tenant_page_quota"] is not None and demand:
+            pressure = max(min(pressure, int(k["tenant_page_quota"])
+                               / demand), 0.25)
+        rate = base * prefix * spec * overlap * pressure * kvq * lora
         comm = 1.0
         if self._comm_bytes_per_token > 0:
             comm = 1.0 / (1.0 + self._comm_bytes_per_token * rate
@@ -426,6 +483,7 @@ class ServingCostModel:
                       "pressure_factor": round(pressure, 3),
                       "comm_factor": round(comm, 4),
                       "kv_quant_factor": round(kvq, 3),
+                      "lora_factor": round(lora, 3),
                       "page_bytes": self.page_bytes(k),
                       "page_demand": demand,
                       "prefill_dispatches": disp,

@@ -132,6 +132,14 @@ def ds_serve_args(knobs):
         parts.append(f"--kv-dtype {k['kv_dtype']}")
     if k["weight_dtype"] is not None:
         parts.append(f"--weight-dtype {k['weight_dtype']}")
+    if int(k["num_adapters"]) > 0:
+        # synthetic roster mirroring the tuner's own measurement rig;
+        # swap in real .npz paths for deployment.  --tenants is the
+        # operator's file — entitlements/quotas are policy, not knobs.
+        roster = ",".join(
+            f"a{i}=random:{k['adapter_rank']}:{i}"
+            for i in range(int(k["num_adapters"])))
+        parts.append(f"--lora {roster} --tenants tenants.json")
     return " ".join(parts)
 
 
@@ -183,6 +191,29 @@ class ServingAutotuner(Autotuner):
         k = ServingCostModel.complete(knobs)
         mix = self.mix
         sampled_mode = mix.greedy_fraction < 1.0
+        tenancy, adapters = None, []
+        if int(k["num_adapters"]) > 0:
+            # one tenant entitled to a synthetic full-coverage roster:
+            # the trial measures the multi-LoRA decode path (per-slot
+            # gather + delta einsums at this rank bucket) under the
+            # same mix, with requests striped across the roster + base
+            if sampled_mode:
+                raise ValueError(
+                    "num_adapters > 0 needs a greedy mix: multi-LoRA "
+                    "serving rides the greedy decode path")
+            from deepspeed_tpu.serving.tenancy import (
+                AdapterStore, TenantConfig, TenantRegistry,
+                random_adapter)
+            mcfg = engine.module.cfg
+            store = AdapterStore(mcfg)
+            for i in range(int(k["num_adapters"])):
+                store.add(f"a{i}", random_adapter(
+                    mcfg, int(k["adapter_rank"]), seed=i))
+            adapters = store.names() + [None]
+            tenancy = TenantRegistry(
+                [TenantConfig("tuner", adapters=tuple(store.names()),
+                              page_quota=k["tenant_page_quota"])],
+                adapter_store=store)
         sched = ServingScheduler(
             engine, num_slots=k["num_slots"], num_pages=k["num_pages"],
             page_size=k["page_size"],
@@ -198,7 +229,7 @@ class ServingAutotuner(Autotuner):
             # a mixed-temperature mix serves sampled (the scheduler's
             # sampling is loop-level; spec disables itself there)
             do_sample=sampled_mode, temperature=0.7 if sampled_mode
-            else 1.0, max_queue=mix.requests + 1)
+            else 1.0, max_queue=mix.requests + 1, tenancy=tenancy)
         vocab = engine.module.cfg.vocab_size
         prompts, max_new, arrivals, _ = mix.generate(vocab)
         t0 = time.monotonic()
@@ -209,7 +240,11 @@ class ServingAutotuner(Autotuner):
             now = time.monotonic() - t0
             while pending and pending[0][2] <= now:
                 p, m, _ = pending.pop(0)
-                submitted.append(sched.submit(p, max_new_tokens=m))
+                tkw = {} if tenancy is None else {
+                    "tenant": "tuner",
+                    "adapter": adapters[len(submitted) % len(adapters)]}
+                submitted.append(sched.submit(p, max_new_tokens=m,
+                                              **tkw))
             if not sched.step():
                 if not pending:
                     break

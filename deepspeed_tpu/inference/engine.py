@@ -779,9 +779,16 @@ class InferenceEngine:
         module = self.module
         materialize = self._materialize
 
-        def prefill(params, ids, slot, n_valid, page_table, lengths, pools):
+        def prefill(params, ids, slot, n_valid, page_table, lengths, pools,
+                    adapters):
             cache = dict(pools, page_table=page_table, lengths=lengths,
                          slot=slot, n_valid=n_valid)
+            # multi-tenant LoRA side input: None is a LEAFLESS pytree, so
+            # base-only traffic keeps the exact pre-tenancy signature and
+            # trace; a stacked adapter pack adds one signature per rank
+            # bucket (shapes), never per adapter (ids/weights are traced)
+            if adapters is not None:
+                cache["adapters"] = adapters
             logits, cache = module.apply({"params": materialize(params)},
                                          ids, cache=cache)
             # the model already reduced to the chunk's boundary row (the
@@ -820,8 +827,8 @@ class InferenceEngine:
             return nxt.astype(jnp.int32), {"layers": cache["layers"]}
 
         def decode_multi(params, tok, active, page_table, lengths, pools,
-                         emitted, budgets, eos_ids, rng, horizon, do_sample,
-                         temperature, top_k, top_p):
+                         emitted, budgets, eos_ids, rng, adapters, horizon,
+                         do_sample, temperature, top_k, top_p):
             """``horizon`` fused decode steps as ONE dispatch (lax.scan):
             token feedback, the active mask, per-slot lengths and EOS /
             budget freezing all stay on device — the host sees one token
@@ -841,6 +848,11 @@ class InferenceEngine:
                 tok, active, lengths, emitted, layers = carry
                 cache = {"layers": layers, "page_table": page_table,
                          "lengths": lengths, "active": active}
+                # adapter factors are scan CONSTANTS (closure capture of
+                # the traced outer arg), never carries — each step
+                # re-gathers by the same per-slot ids
+                if adapters is not None:
+                    cache["adapters"] = adapters
                 logits, cache = module.apply(
                     {"params": materialize(params)}, tok[:, None],
                     cache=cache)
@@ -861,7 +873,8 @@ class InferenceEngine:
                     {"layers": layers})
 
         def verify_multi(params, tok, drafts, widths, active, page_table,
-                         lengths, pools, emitted, budgets, eos_ids):
+                         lengths, pools, emitted, budgets, eos_ids,
+                         adapters):
             """Teacher-forced speculative verification: score K drafted
             tokens per slot in ONE forward over the paged cache (the
             draft/verify counterpart of ``decode_multi``'s scan).
@@ -885,6 +898,8 @@ class InferenceEngine:
             cols = jnp.where(active, widths + 1, 0)
             cache = dict(pools, page_table=page_table, lengths=lengths,
                          active=active, widths=cols)
+            if adapters is not None:
+                cache["adapters"] = adapters
             logits, cache = module.apply({"params": materialize(params)},
                                          x, cache=cache)
             # the greedy contract: fp32 argmax, ties to the lowest id
@@ -1075,7 +1090,7 @@ class InferenceEngine:
         # compile count stays bounded across slot churn
         self._paged_decode_multi_fn = jax.jit(
             decode_multi, donate_argnums=(5,),
-            static_argnums=(10, 11, 12, 13, 14),
+            static_argnums=(11, 12, 13, 14, 15),
             out_shardings=(block, block, slot, slot, slot, slot, pool))
         # K is baked into the drafts shape, so the compile count is
         # bounded by the scheduler's spec-K bucket set (greedy-only: no
@@ -1336,7 +1351,7 @@ class InferenceEngine:
         return out
 
     def prefill_into_slots(self, ids_chunk, slot, n_valid, page_table,
-                           lengths, pools):
+                           lengths, pools, adapter_ids=None, adapters=None):
         """One prefill chunk of one slot: write the chunk's K/V through
         the page table and return (boundary logits [vocab], new pools).
         ``ids_chunk`` is [1, chunk] (padded past ``n_valid``); the pages
@@ -1358,8 +1373,17 @@ class InferenceEngine:
                 (ids_chunk, np.int32, rep), (slot, np.int32, rep),
                 (n_valid, np.int32, rep), (page_table, np.int32, blk),
                 (lengths, np.int32, slot_sh)])
+        # multi-tenant LoRA: the stacked factor pack is already device-
+        # committed (AdapterStore caches it); only the per-slot ids are
+        # per-dispatch host state. None = leafless side input, so base-
+        # only traffic keeps the exact pre-tenancy signature.
+        ad = None
+        if adapters is not None:
+            (ids_arr,) = self._stage_host_inputs(
+                [(adapter_ids, np.int32, slot_sh)])
+            ad = dict(adapters, ids=ids_arr)
         args = (self.params, ids_chunk, slot, n_valid, page_table,
-                lengths, pools)
+                lengths, pools, ad)
         if self._comm_capture is not None:   # label cost only when armed
             self._capture_comm_sig(
                 "prefill", f"prefill[chunk={np.shape(ids_chunk)[1]}]",
@@ -1469,7 +1493,8 @@ class InferenceEngine:
 
     def decode_multi(self, toks, active, page_table, lengths, pools, *,
                      horizon, budgets, eos_ids, emitted=None,
-                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0):
+                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                     adapter_ids=None, adapters=None):
         """``horizon`` continuous-batching decode steps as ONE dispatch.
 
         Returns ``(toks_block [slots, H] i32, valid [slots, H] bool,
@@ -1500,8 +1525,13 @@ class InferenceEngine:
                 (page_table, np.int32, blk), (lengths, np.int32, slot),
                 (emitted, np.int32, slot), (budgets, np.int32, slot),
                 (eos_ids, np.int32, slot)])
+        ad = None
+        if adapters is not None:
+            (ids_arr,) = self._stage_host_inputs(
+                [(adapter_ids, np.int32, slot)])
+            ad = dict(adapters, ids=ids_arr)
         args = (self.params, toks, active, page_table, lengths, pools,
-                emitted, budgets, eos_ids, rng)
+                emitted, budgets, eos_ids, rng, ad)
         statics = (int(horizon), bool(do_sample), float(temperature),
                    int(top_k), float(top_p))
         if self._comm_capture is not None:
@@ -1518,7 +1548,8 @@ class InferenceEngine:
                 else {"horizon": int(horizon)})
 
     def verify_multi(self, toks, drafts, active, page_table, lengths,
-                     pools, *, widths, budgets, eos_ids, emitted=None):
+                     pools, *, widths, budgets, eos_ids, emitted=None,
+                     adapter_ids=None, adapters=None):
         """Speculative-decode verification: score ``drafts`` [slots, K]
         proposed tokens per slot in ONE teacher-forced dispatch over the
         paged cache, accept the longest greedy-matching prefix plus the
@@ -1553,8 +1584,13 @@ class InferenceEngine:
              (page_table, np.int32, blk), (lengths, np.int32, slot),
              (emitted, np.int32, slot), (budgets, np.int32, slot),
              (eos_ids, np.int32, slot)])
+        ad = None
+        if adapters is not None:
+            (ids_arr,) = self._stage_host_inputs(
+                [(adapter_ids, np.int32, slot)])
+            ad = dict(adapters, ids=ids_arr)
         args = (self.params, toks, drafts, widths, active, page_table,
-                lengths, pools, emitted, budgets, eos_ids)
+                lengths, pools, emitted, budgets, eos_ids, ad)
         k = int(np.shape(drafts)[1])
         if self._comm_capture is not None:
             self._capture_comm_sig("verify", f"verify[k={k}]",

@@ -118,8 +118,17 @@ class SelfAttention(nn.Module):
         b, l, _ = x.shape
         attn_bias = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
         qkv_bias = attn_bias if cfg.qkv_bias is None else cfg.qkv_bias
+        # multi-tenant serving: per-slot LoRA deltas ride the paged
+        # cache as a stacked side input (models/lora.py); absent for
+        # base-only traffic, so that path's trace is unchanged
+        ad = cache.get("adapters") if cache is not None else None
+        if ad is not None:
+            from deepspeed_tpu.models.lora import adapter_rows, lora_delta
+            ad_rows = adapter_rows(ad, cache)
         qkv = _dense(3 * cfg.hidden_size, cfg, ("embed", "kv"), name="qkv",
                      use_bias=qkv_bias)(x)
+        if ad is not None and "qkv" in ad:
+            qkv = qkv + lora_delta(x, ad["qkv"], ad_rows, ad["scale"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, l, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.num_heads, cfg.head_dim)
@@ -325,8 +334,12 @@ class SelfAttention(nn.Module):
             else:
                 out = mha_reference(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.hidden_size)
+        proj_in = out
         out = _dense(cfg.hidden_size, cfg, ("heads", "embed"), name="proj",
-                     use_bias=attn_bias)(out)
+                     use_bias=attn_bias)(proj_in)
+        if ad is not None and "proj" in ad:
+            out = out + lora_delta(proj_in, ad["proj"], ad_rows,
+                                   ad["scale"])
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
         return out, new_cache
@@ -336,13 +349,22 @@ class MLP(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, adapters=None, ad_rows=None):
         cfg = self.cfg
         h = _dense(cfg.mlp_ratio * cfg.hidden_size, cfg, ("embed", "mlp"),
                    name="fc_in")(x)
+        if adapters is not None and "fc_in" in adapters:
+            from deepspeed_tpu.models.lora import lora_delta
+            h = h + lora_delta(x, adapters["fc_in"], ad_rows,
+                               adapters["scale"])
         h = nn.relu(h) if cfg.activation == "relu" else \
             nn.gelu(h, approximate=cfg.activation != "gelu_exact")
-        h = _dense(cfg.hidden_size, cfg, ("mlp", "embed"), name="fc_out")(h)
+        mid = h
+        h = _dense(cfg.hidden_size, cfg, ("mlp", "embed"), name="fc_out")(mid)
+        if adapters is not None and "fc_out" in adapters:
+            from deepspeed_tpu.models.lora import lora_delta
+            h = h + lora_delta(mid, adapters["fc_out"], ad_rows,
+                               adapters["scale"])
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h
@@ -358,6 +380,11 @@ class Block(nn.Module):
                  pld_keep=None):
         cfg = self.cfg
         x_in = x
+        ad = cache.get("adapters") if cache is not None else None
+        ad_rows = None
+        if ad is not None:
+            from deepspeed_tpu.models.lora import adapter_rows
+            ad_rows = adapter_rows(ad, cache)
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            name="ln_1")(x)
         attn_out, new_cache = SelfAttention(cfg, self.window, name="attn")(
@@ -368,7 +395,7 @@ class Block(nn.Module):
             h = ln1 if cfg.single_ln else nn.LayerNorm(
                 epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_2")(x)
             assert not self.use_moe, "parallel residual + MoE unsupported"
-            mlp_out = MLP(cfg, name="mlp")(h, deterministic)
+            mlp_out = MLP(cfg, name="mlp")(h, deterministic, ad, ad_rows)
             out = x + attn_out + mlp_out
         else:
             x = x + attn_out
@@ -387,7 +414,7 @@ class Block(nn.Module):
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               name="moe")(h, deterministic)
             else:
-                h = MLP(cfg, name="mlp")(h, deterministic)
+                h = MLP(cfg, name="mlp")(h, deterministic, ad, ad_rows)
             out = x + h
         if pld_keep is not None:
             # progressive layer drop (reference
@@ -552,6 +579,9 @@ class GPT2(nn.Module):
                                 "seq_axis", "seq_impl"):
                         if key in cache:
                             layer_cache[key] = cache[key]
+                    if "adapters" in cache:
+                        from deepspeed_tpu.models.lora import layer_adapters
+                        layer_cache["adapters"] = layer_adapters(cache, i)
                 pk = None if pld_keeps is None else pld_keeps[i]
                 # random layerwise token dropping (reference
                 # data_routing/basic_layer.py:14 RandomLayerTokenDrop):

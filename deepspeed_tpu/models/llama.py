@@ -83,9 +83,23 @@ class LlamaAttention(nn.Module):
         cfg = self.cfg
         b, l, _ = x.shape
         h, kv_h, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        # multi-tenant serving: per-slot LoRA deltas ride the paged
+        # cache as a stacked side input (models/lora.py); absent for
+        # base-only traffic, so that path's trace is unchanged
+        ad = cache.get("adapters") if cache is not None else None
+        if ad is not None:
+            from deepspeed_tpu.models.lora import adapter_rows, lora_delta
+            ad_rows = adapter_rows(ad, cache)
         q = _proj(cfg, h * d, ("embed", "heads"), "wq")(x)
         k = _proj(cfg, kv_h * d, ("embed", "kv"), "wk")(x)
         v = _proj(cfg, kv_h * d, ("embed", "kv"), "wv")(x)
+        if ad is not None:
+            if "wq" in ad:
+                q = q + lora_delta(x, ad["wq"], ad_rows, ad["scale"])
+            if "wk" in ad:
+                k = k + lora_delta(x, ad["wk"], ad_rows, ad["scale"])
+            if "wv" in ad:
+                v = v + lora_delta(x, ad["wv"], ad_rows, ad["scale"])
         q = q.reshape(b, l, h, d)
         k = k.reshape(b, l, kv_h, d)
         v = v.reshape(b, l, kv_h, d)
@@ -237,7 +251,10 @@ class LlamaAttention(nn.Module):
                 out = mha_reference(q, k_full, v_full, causal=True)
 
         out = out.reshape(b, l, h * d)
-        out = _proj(cfg, cfg.hidden_size, ("heads", "embed"), "wo")(out)
+        wo_in = out
+        out = _proj(cfg, cfg.hidden_size, ("heads", "embed"), "wo")(wo_in)
+        if ad is not None and "wo" in ad:
+            out = out + lora_delta(wo_in, ad["wo"], ad_rows, ad["scale"])
         return out, new_cache
 
 
@@ -245,12 +262,25 @@ class LlamaMLP(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapters=None, ad_rows=None):
         cfg = self.cfg
         gate = _proj(cfg, cfg.intermediate_size, ("embed", "mlp"), "w_gate")(x)
         up = _proj(cfg, cfg.intermediate_size, ("embed", "mlp"), "w_up")(x)
+        if adapters is not None:
+            from deepspeed_tpu.models.lora import lora_delta
+            if "w_gate" in adapters:
+                gate = gate + lora_delta(x, adapters["w_gate"], ad_rows,
+                                         adapters["scale"])
+            if "w_up" in adapters:
+                up = up + lora_delta(x, adapters["w_up"], ad_rows,
+                                     adapters["scale"])
         h = nn.silu(gate) * up
-        return _proj(cfg, cfg.hidden_size, ("mlp", "embed"), "w_down")(h)
+        down = _proj(cfg, cfg.hidden_size, ("mlp", "embed"), "w_down")(h)
+        if adapters is not None and "w_down" in adapters:
+            from deepspeed_tpu.models.lora import lora_delta
+            down = down + lora_delta(h, adapters["w_down"], ad_rows,
+                                     adapters["scale"])
+        return down
 
 
 class LlamaBlock(nn.Module):
@@ -259,12 +289,18 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache=None):
         cfg = self.cfg
+        ad = cache.get("adapters") if cache is not None else None
+        ad_rows = None
+        if ad is not None:
+            from deepspeed_tpu.models.lora import adapter_rows
+            ad_rows = adapter_rows(ad, cache)
         attn_out, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x),
             positions, cache)
         x = x + attn_out
         x = x + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x))
+            RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x),
+            ad, ad_rows)
         return x, new_cache
 
 
@@ -318,6 +354,9 @@ class Llama(nn.Module):
                             "seq_axis", "seq_impl"):
                     if key in cache:
                         layer_cache[key] = cache[key]
+                if "adapters" in cache:
+                    from deepspeed_tpu.models.lora import layer_adapters
+                    layer_cache["adapters"] = layer_adapters(cache, i)
             x, new_c = block(cfg, name=f"layers_{i}")(x, positions,
                                                       layer_cache)
             new_layer_caches.append(new_c)

@@ -56,11 +56,11 @@ class JournalEntry:
                  "attempts", "replays", "replica", "replica_history",
                  "replica_inc", "handle", "next_try", "t_submit",
                  "t_first", "t_last", "cancel_requested", "trace_flow",
-                 "sampling", "seed", "grammar")
+                 "sampling", "seed", "grammar", "tenant", "adapter")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  on_token=None, deadline_s=None, sampling=None, seed=None,
-                 grammar=None):
+                 grammar=None, tenant=None, adapter=None):
         self.rid = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -93,6 +93,11 @@ class JournalEntry:
         self.sampling = dict(sampling) if sampling else None
         self.seed = None if seed is None else int(seed)
         self.grammar = dict(grammar) if grammar else None
+        # Tenancy attribution, journaled verbatim: a failover
+        # resubmission lands on the survivor under the SAME tenant
+        # (quota/billing/namespace) and the same adapter weights.
+        self.tenant = tenant
+        self.adapter = adapter
         self.cancel_requested = False
         self.trace_flow = None     # open failover-replay flow-link id:
                                    # set when a death replays this entry,
@@ -131,6 +136,7 @@ class JournalEntry:
             "replica_history": list(self.replica_history),
             "sampling": self.sampling, "seed": self.seed,
             "grammar": self.grammar,
+            "tenant": self.tenant, "adapter": self.adapter,
         }
 
     def to_record(self):
@@ -152,13 +158,15 @@ class JournalEntry:
             "cancel_requested": self.cancel_requested,
             "sampling": self.sampling, "seed": self.seed,
             "grammar": self.grammar,
+            "tenant": self.tenant, "adapter": self.adapter,
         }
 
     @classmethod
     def from_record(cls, rec):
         e = cls(rec["rid"], rec["prompt"], rec["max_new_tokens"],
                 rec.get("eos_token_id"), sampling=rec.get("sampling"),
-                seed=rec.get("seed"), grammar=rec.get("grammar"))
+                seed=rec.get("seed"), grammar=rec.get("grammar"),
+                tenant=rec.get("tenant"), adapter=rec.get("adapter"))
         e.t_submit = rec.get("t_submit", e.t_submit)
         e.deadline_abs = rec.get("deadline_abs")
         e.emitted = [int(t) for t in rec.get("emitted", [])]
@@ -323,7 +331,7 @@ class RequestJournal:
     # ------------------------------------------------- mutation API
     def admit(self, prompt, max_new_tokens, eos_token_id=None,
               on_token=None, deadline_s=None, rid=None, sampling=None,
-              seed=None, grammar=None):
+              seed=None, grammar=None, tenant=None, adapter=None):
         """Returns ``(entry, created)``; a duplicate rid returns the
         incumbent with ``created=False`` (at-most-once admission)."""
         if rid is None:
@@ -333,7 +341,8 @@ class RequestJournal:
             return self.entries[rid], False
         entry = JournalEntry(rid, prompt, max_new_tokens, eos_token_id,
                              on_token, deadline_s, sampling=sampling,
-                             seed=seed, grammar=grammar)
+                             seed=seed, grammar=grammar, tenant=tenant,
+                             adapter=adapter)
         self._wal(dict(entry.to_record(), op="admit",
                        auto_rid=self._auto_rid))
         self.entries[rid] = entry

@@ -136,7 +136,7 @@ class LocalReplica:
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0, epoch=None):
+               sample_offset=0, tenant=None, adapter=None, epoch=None):
         _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
@@ -146,14 +146,15 @@ class LocalReplica:
                                 handoff=handoff, trace_ctx=trace_ctx,
                                 sampling=sampling, seed=seed,
                                 grammar=grammar,
-                                sample_offset=sample_offset)
+                                sample_offset=sample_offset,
+                                tenant=tenant, adapter=adapter)
         req._fence_epoch = epoch
         return req
 
     def attach(self, prompt, pages, length, first_tok, *, max_new_tokens,
                eos_token_id=None, deadline_s=None, on_token=None,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0, epoch=None):
+               sample_offset=0, tenant=None, adapter=None, epoch=None):
         _fence_check(self, epoch)
         if self.state != UP:
             raise ReplicaKilled(f"{self.id} is {self.state}")
@@ -162,7 +163,8 @@ class LocalReplica:
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
             on_token=on_token, deadline_s=deadline_s,
             trace_ctx=trace_ctx, sampling=sampling, seed=seed,
-            grammar=grammar, sample_offset=sample_offset)
+            grammar=grammar, sample_offset=sample_offset,
+            tenant=tenant, adapter=adapter)
         req._fence_epoch = epoch
         return req
 
@@ -397,7 +399,8 @@ class ProcessReplica:
                  prefill_chunk=8, prefix_cache=False, term_grace_s=5.0,
                  hb_timeout_s=60.0, env=None, trace=False,
                  mem_telemetry=False, comm_telemetry=False,
-                 kv_dtype=None, role="unified", group=None):
+                 kv_dtype=None, role="unified", group=None,
+                 tenants=None, lora=None):
         self.id = replica_id
         self.role = role                 # unified | prefill | decode
         self.group = group               # DisaggGroup for role workers
@@ -418,7 +421,7 @@ class ProcessReplica:
                          prefix_cache=prefix_cache, trace=bool(trace),
                          mem_telemetry=bool(mem_telemetry),
                          comm_telemetry=bool(comm_telemetry),
-                         kv_dtype=kv_dtype)
+                         kv_dtype=kv_dtype, tenants=tenants, lora=lora)
         self._env = dict(env or {})
         self._handles = {}
         self._next_rid = 0
@@ -466,6 +469,12 @@ class ProcessReplica:
             cmd.append("--mem-telemetry")
         if cfg.get("comm_telemetry"):
             cmd.append("--comm-telemetry")
+        if cfg.get("tenants"):
+            # tenancy survives restarts: the respawned worker rebuilds
+            # the identical registry (same adapter ids/namespaces)
+            cmd += ["--tenants", str(cfg["tenants"])]
+        if cfg.get("lora"):
+            cmd += ["--lora", str(cfg["lora"])]
         if cfg["trace"]:
             cmd += ["--trace", "--trace-label", str(self.id)]
         # KV sidecar plumbing for role workers: a dedicated binary fd
@@ -641,7 +650,7 @@ class ProcessReplica:
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
                deadline_s=None, on_token=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0, epoch=None):
+               sample_offset=0, tenant=None, adapter=None, epoch=None):
         if handoff and self.role != "prefill":
             raise ValueError(
                 "handoff submits require a prefill-role worker "
@@ -670,6 +679,12 @@ class ProcessReplica:
             op["grammar"] = dict(grammar)
         if sample_offset:
             op["sample_offset"] = int(sample_offset)
+        # tenancy fields are omitted when absent for the same
+        # wire-compat reason
+        if tenant is not None:
+            op["tenant"] = str(tenant)
+        if adapter is not None:
+            op["adapter"] = str(adapter)
         if epoch is not None:
             # the epoch rides the wire too: even if a zombie router
             # slips past the in-process fence (it cannot here, but a
@@ -741,7 +756,8 @@ class ProcessReplica:
                           max_new_tokens, eos_token_id=None,
                           deadline_s=None, on_token=None, trace_ctx=None,
                           sampling=None, seed=None, grammar=None,
-                          sample_offset=0, epoch=None):
+                          sample_offset=0, tenant=None, adapter=None,
+                          epoch=None):
         """Dispatch the decode side of a cross-process handoff: the
         worker allocates the destination chain, scatters relayed
         frames as they land, and adopts the request once the manifest
@@ -773,6 +789,10 @@ class ProcessReplica:
             op["grammar"] = dict(grammar)
         if sample_offset:
             op["sample_offset"] = int(sample_offset)
+        if tenant is not None:
+            op["tenant"] = str(tenant)
+        if adapter is not None:
+            op["adapter"] = str(adapter)
         if epoch is not None:
             op["epoch"] = int(epoch)
         if trace_ctx is not None:

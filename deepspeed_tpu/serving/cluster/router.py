@@ -275,7 +275,7 @@ class ClusterRouter:
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                on_token=None, deadline_s=None, rid=None, sampling=None,
-               seed=None, grammar=None):
+               seed=None, grammar=None, tenant=None, adapter=None):
         """Journal a request (idempotent on ``rid``) for routing at the
         next pump.  Returns the journal entry — its ``state`` /
         ``emitted`` are the client-visible truth across any number of
@@ -283,11 +283,14 @@ class ClusterRouter:
         dicts journaled verbatim: a failover resubmission replays the
         identical decoding policy (position-keyed PRNG + grammar-cursor
         replay make the continuation stream-exact, not just
-        distribution-exact)."""
+        distribution-exact).  ``tenant``/``adapter`` are journaled the
+        same way: a failover lands on the survivor under the same
+        tenant ledger/quota/namespace and adapter weights."""
         entry, created = self.journal.admit(
             prompt, max_new_tokens, eos_token_id=eos_token_id,
             on_token=on_token, deadline_s=deadline_s, rid=rid,
-            sampling=sampling, seed=seed, grammar=grammar)
+            sampling=sampling, seed=seed, grammar=grammar,
+            tenant=tenant, adapter=adapter)
         if created:
             self.metrics.submitted += 1
         else:
@@ -560,6 +563,7 @@ class ClusterRouter:
                     # prompt suffix to replay through the grammar cursor
                     sampling=entry.sampling, seed=entry.seed,
                     grammar=entry.grammar,
+                    tenant=entry.tenant, adapter=entry.adapter,
                     sample_offset=len(entry.emitted), epoch=self.epoch)
             except StaleEpoch:
                 # this router is deposed: the replica refused the
@@ -702,6 +706,7 @@ class ClusterRouter:
             # across the handoff
             sampling=entry.sampling, seed=entry.seed,
             grammar=entry.grammar,
+            tenant=entry.tenant, adapter=entry.adapter,
             sample_offset=max(0, len(entry.emitted) - 1),
             epoch=self.epoch)
         self.journal.dispatch(entry, rep.id,
@@ -842,6 +847,7 @@ class ClusterRouter:
                 {"trace_id": entry.rid, "attempt": entry.replays},
                 sampling=entry.sampling, seed=entry.seed,
                 grammar=entry.grammar,
+                tenant=entry.tenant, adapter=entry.adapter,
                 sample_offset=max(0, len(entry.emitted) - 1),
                 epoch=self.epoch)
         except StaleEpoch:

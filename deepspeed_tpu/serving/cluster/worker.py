@@ -139,6 +139,14 @@ def main(argv=None):
     p.add_argument("--kv-fd-in", type=int, default=None,
                    help="decode role: fd relayed page-chain frames "
                         "arrive on (binary, length-prefixed)")
+    p.add_argument("--tenants", default=None,
+                   help="tenants.json path (TenantConfig.from_dict "
+                        "schema) — turns the multi-tenant tier on; "
+                        "submits then REQUIRE a tenant field")
+    p.add_argument("--lora", default=None,
+                   help="adapter roster 'name=path.npz,...' (or "
+                        "name=random:<rank>[:<seed>] for synthetic "
+                        "factors); requires --tenants")
     p.add_argument("--hb-interval-s", type=float, default=0.2)
     p.add_argument("--threefry-partitionable", action="store_true",
                    help="mirror the parent's jax_threefry_partitionable "
@@ -157,6 +165,15 @@ def main(argv=None):
                                                  ServingScheduler)
 
     engine = _build_engine(args.model, args.dtype)
+    tenancy = None
+    if args.tenants is not None or args.lora is not None:
+        # same builder ds_serve uses: every worker of the fleet derives
+        # the IDENTICAL registry (adapter ids, namespaces, weights)
+        # from the same CLI strings, so failover replays land under
+        # the same tenant/adapter on any survivor
+        from deepspeed_tpu.serving.tenancy import build_tenancy
+        tenancy = build_tenancy(engine.module.cfg, tenants=args.tenants,
+                                lora=args.lora)
     sched = ServingScheduler(
         engine, num_slots=args.num_slots, num_pages=args.num_pages,
         page_size=args.page_size,
@@ -164,7 +181,7 @@ def main(argv=None):
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
         kv_dtype=args.kv_dtype,
         mem_telemetry=args.mem_telemetry,
-        comm_telemetry=args.comm_telemetry)
+        comm_telemetry=args.comm_telemetry, tenancy=tenancy)
 
     fence = {"epoch": 0}   # highest router epoch seen on the wire
 
@@ -288,7 +305,8 @@ def main(argv=None):
                 trace_ctx=op.get("trace"),
                 sampling=op.get("sampling"), seed=op.get("seed"),
                 grammar=op.get("grammar"),
-                sample_offset=op.get("sample_offset", 0))
+                sample_offset=op.get("sample_offset", 0),
+                tenant=op.get("tenant"), adapter=op.get("adapter"))
         except Exception as e:
             sched.kv.pool.free(imp.pages)
             shed(rid, f"{type(e).__name__}: {e}")
@@ -385,7 +403,9 @@ def main(argv=None):
                         sampling=op.get("sampling"),
                         seed=op.get("seed"),
                         grammar=op.get("grammar"),
-                        sample_offset=op.get("sample_offset", 0))
+                        sample_offset=op.get("sample_offset", 0),
+                        tenant=op.get("tenant"),
+                        adapter=op.get("adapter"))
                 except Exception as e:
                     shed(op["rid"], f"{type(e).__name__}: {e}")
                     continue

@@ -232,6 +232,71 @@ def _classify_once(sched, pool):
     return counts
 
 
+def classify_tenants(sched, raise_on_error=True):
+    """Per-tenant page attribution (tenancy on): every attributable
+    page of the pool is charged to exactly ONE tenant, in the same
+    holder-precedence order as :func:`classify` (prefix trie, then
+    slot tables, then parked handoff chains), so the per-tenant states
+    sum to the global attributable count — conservation per tenant AND
+    globally.  A page reachable from TWO tenants' holders is a
+    cross-tenant leak (quota isolation broken by construction) and
+    raises :class:`AuditError`.
+
+    Returns ``{"label": "tenancy", "ok": ..., "errors": [...],
+    "tenants": {tenant: {slot, handoff, prefix_shared, prefix_sole}}}``.
+    """
+    reg = sched.tenancy
+    pool = sched.kv.pool
+    errors = []
+    owner = {}                    # page -> tenant (first claim wins)
+    states = ("slot", "handoff", "prefix_shared", "prefix_sole")
+    per = {t: dict.fromkeys(states, 0) for t in reg.tenants}
+
+    def claim(page, tenant, state):
+        page = int(page)
+        prev = owner.get(page)
+        if prev is not None:
+            if prev != tenant:
+                errors.append(
+                    f"page {page} held by BOTH tenant {prev!r} and "
+                    f"{tenant!r} (cross-tenant page leak)")
+            return
+        owner[page] = tenant
+        per[tenant][state] += 1
+
+    if sched.prefix_cache is not None:
+        for t in reg.tenants:
+            for ns in sched._tenant_namespaces(t):
+                for p in sched.prefix_cache.ns_iter_pages(ns):
+                    claim(p, t, "prefix_shared"
+                          if pool.ref_count(p) > 1 else "prefix_sole")
+    for slot, r in enumerate(sched.slot_req):
+        if r is not None and r.tenant is not None:
+            for p in sched.kv._slot_pages[slot]:
+                claim(p, r.tenant, "slot")
+    for r in sched._pending_attach:
+        if r.tenant is not None:
+            for p in r._attach[0]:
+                claim(p, r.tenant, "handoff")
+    g = classify(sched)
+    attributable = sum(g.get(k, 0) for k in
+                       ("slot", "prefix_shared", "prefix_sole",
+                        "handoff"))
+    charged = sum(sum(c.values()) for c in per.values())
+    if charged != attributable and not errors:
+        errors.append(
+            f"tenant attribution not conservation-exact: {charged} "
+            f"page(s) charged to tenants != {attributable} "
+            "attributable page(s) in the global split")
+    report = {"label": "tenancy", "errors": errors, "ok": not errors,
+              "tenants": per}
+    if errors and raise_on_error:
+        raise AuditError(
+            f"tenant page audit failed ({len(errors)} violation(s)):"
+            "\n  " + "\n  ".join(errors))
+    return report
+
+
 # ------------------------------------------------- pressure forensics
 
 class _NullChain:

@@ -77,6 +77,8 @@ class ServingMetrics:
         # memory telemetry (MemTelemetry drives these; all 0 when off)
         self.mem_pressure_events = 0   # capacity causal chains recorded
         self.mem_pressure_episodes = 0  # sustained episodes fired
+        # multi-tenant serving (tenancy on; all 0 otherwise)
+        self.quota_shed = 0            # requests shed on page quota
         # online autotuner (OnlineTuner drives these; all 0 when off)
         self.tune_nudges = 0           # knob nudges applied
         self.tune_log = deque(maxlen=64)   # (step, knob, value)
@@ -178,6 +180,25 @@ class ServingMetrics:
         self.seq_prefill_shed += 1
         self._write([
             ("serving/seq_prefill/shed_reserve_cap", pages_needed, step)])
+
+    def record_tenants(self, step, *, active, page_seconds, max_share):
+        """Per-step tenancy gauges: tenants with live pages, the summed
+        page-seconds ledger across all tenants, and the largest single
+        tenant's share of the pool (the fairness headline — a weighted
+        mix should keep it near its weight fraction).  Names are FIXED
+        scalars (taxonomy-pinned); per-tenant detail rides
+        ``health()['tenants']``, never dynamic gauge names."""
+        self._write([
+            ("serving/tenant/active", active, step),
+            ("serving/tenant/page_seconds", page_seconds, step),
+            ("serving/tenant/max_share", max_share, step),
+        ])
+
+    def record_quota_shed(self, step):
+        """A request shed because its tenant's page quota could not
+        cover it even after draining the tenant's own cached pages."""
+        self.quota_shed += 1
+        self._write([("serving/tenant/quota_shed", 1, step)])
 
     def record_cache_eviction(self, step, pages):
         """Cached pages drained back to the free list under pool

@@ -48,6 +48,10 @@ import hashlib
 
 import numpy as np
 
+# namespace sentinel meaning "every namespace" (None is a real
+# namespace: the legacy single-tenant root)
+ALL_NAMESPACES = object()
+
 
 class _Node:
     """One cached page: ``key`` is the exact ``page_size`` token IDs
@@ -78,7 +82,12 @@ class PrefixCache:
         if min_partial_tokens is None:
             min_partial_tokens = self.page_size // 4
         self.min_partial_tokens = max(1, int(min_partial_tokens))
-        self._root = _Node()
+        # one radix root per namespace (multi-tenant isolation): the
+        # namespace is part of every lookup/insert key, so one tenant's
+        # donated KV can never hit another's prompt.  ``None`` is the
+        # legacy single-tenant namespace — every default path behaves
+        # exactly as before.
+        self._roots = {None: _Node()}
         self._nodes = 0              # == cached pages held by the index
         self._clock = 0              # LRU timestamp source
         # observability (the scheduler folds these into ServingMetrics)
@@ -94,12 +103,43 @@ class PrefixCache:
     def cached_pages(self):
         return self._nodes
 
+    @property
+    def _root(self):
+        # legacy single-tenant trie root (pre-namespace alias; the
+        # coherence walks in the serving test suites traverse it)
+        return self._roots[None]
+
     def _touch(self, node):
         self._clock += 1
         node.last_used = self._clock
 
+    def _root_for(self, ns, create=False):
+        root = self._roots.get(ns)
+        if root is None and create:
+            root = self._roots[ns] = _Node()
+        return root
+
+    def _iter_roots(self, ns):
+        if ns is ALL_NAMESPACES:
+            return list(self._roots.items())
+        root = self._roots.get(ns)
+        return [] if root is None else [(ns, root)]
+
+    def ns_pages(self, ns):
+        """Cached pages held under ONE namespace (the tenant quota
+        ledger counts these against the owning tenant)."""
+        root = self._roots.get(ns)
+        if root is None:
+            return 0
+        count, stack = 0, list(root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            count += 1
+        return count
+
     # ------------------------------------------------------------- match
-    def match(self, tokens, limit=None):
+    def match(self, tokens, limit=None, ns=None):
         """Longest-prefix match of ``tokens[:limit]`` against the index.
 
         Returns ``(full_nodes, partial_node, partial_len)``:
@@ -118,7 +158,10 @@ class PrefixCache:
         if limit is None:
             limit = len(tokens)
         limit = min(limit, len(tokens))
-        node, full_nodes, i = self._root, [], 0
+        root = self._roots.get(ns)
+        if root is None:
+            return [], None, 0
+        node, full_nodes, i = root, [], 0
         while i + ps <= limit:
             child = node.children.get(tuple(int(t) for t in
                                             tokens[i:i + ps]))
@@ -157,14 +200,14 @@ class PrefixCache:
         self._touch(node)
 
     # ------------------------------------------------------------ donate
-    def insert(self, tokens, pages):
+    def insert(self, tokens, pages, ns=None):
         """Donate a finished request's full pages: ``pages[j]`` holds
         the KV of ``tokens[j*ps : (j+1)*ps]``.  The caller transfers
         ownership of each page's pool reference; pages the cache does
         NOT keep (duplicate chains, cap overflow) are returned for the
         caller to free.  Never triggers pool allocation."""
         ps = self.page_size
-        node, leftover = self._root, []
+        node, leftover = self._root_for(ns, create=True), []
         for j, page in enumerate(pages):
             key = tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
             child = node.children.get(key)
@@ -189,21 +232,23 @@ class PrefixCache:
 
     def _path(self, node):
         out = set()
-        while node is not None and node is not self._root:
+        while node is not None and node.key is not None:
             out.add(id(node))
             node = node.parent
         return out
 
     # ------------------------------------------------------------- evict
-    def _evictable(self, protect):
+    def _evictable(self, protect, ns=ALL_NAMESPACES):
         """Leaves whose only holder is the cache itself (live slots add
-        holders via acquire, making their chains un-evictable)."""
+        holders via acquire, making their chains un-evictable).
+        ``ns`` scopes the sweep to ONE namespace (a tenant at quota
+        drains only its own pages); the default sweeps every root."""
         out = []
-        stack = [self._root]
+        stack = [root for _, root in self._iter_roots(ns)]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if n is not self._root and not n.children and \
+            if n.key is not None and not n.children and \
                     id(n) not in protect and \
                     self.pool.ref_count(n.page) == 1:
                 out.append(n)
@@ -214,7 +259,7 @@ class PrefixCache:
         leaf).  Returns True when a page was reclaimed."""
         return self.evict(1, protect) == 1
 
-    def evict(self, n_pages, protect=frozenset()):
+    def evict(self, n_pages, protect=frozenset(), ns=ALL_NAMESPACES):
         """Reclaim up to ``n_pages`` cached pages, LRU-first.  Each pass
         collects the CURRENT evictable leaves once and drains them in
         LRU order; interior nodes exposed by a pass become candidates in
@@ -224,7 +269,7 @@ class PrefixCache:
         Returns the number of pages actually freed."""
         freed = 0
         while freed < n_pages:
-            victims = self._evictable(protect)
+            victims = self._evictable(protect, ns)
             if not victims:
                 break
             victims.sort(key=lambda n: n.last_used)
@@ -238,7 +283,7 @@ class PrefixCache:
                 freed += 1
         return freed
 
-    def reclaimable_pages(self, protect=frozenset()):
+    def reclaimable_pages(self, protect=frozenset(), ns=ALL_NAMESPACES):
         """EXACTLY how many pages ``evict(..., protect)`` can free right
         now: a node is drainable only when the cache is its sole holder,
         it is not protected, AND its whole subtree is drainable — a
@@ -250,47 +295,63 @@ class PrefixCache:
         live-request preemption.  Iterative post-order — chain depth is
         unbounded (one page per ``page_size`` tokens of the longest
         donated sequence) and this runs inside the serving loop."""
-        results = {}                  # id(node) -> (count, drainable)
-        stack = [(self._root, False)]
-        while stack:
-            node, visited = stack.pop()
-            if not visited:
-                stack.append((node, True))
-                stack.extend((c, False) for c in node.children.values())
-                continue
-            count, ok = 0, True
-            for child in node.children.values():
-                c_count, c_ok = results.pop(id(child))
-                count += c_count
-                ok = ok and c_ok
-            if node is not self._root:
-                if ok and id(node) not in protect and \
-                        self.pool.ref_count(node.page) == 1:
-                    count += 1
-                else:
-                    ok = False
-            results[id(node)] = (count, ok)
-        return results[id(self._root)][0]
+        total = 0
+        for _, root in self._iter_roots(ns):
+            results = {}              # id(node) -> (count, drainable)
+            stack = [(root, False)]
+            while stack:
+                node, visited = stack.pop()
+                if not visited:
+                    stack.append((node, True))
+                    stack.extend((c, False)
+                                 for c in node.children.values())
+                    continue
+                count, ok = 0, True
+                for child in node.children.values():
+                    c_count, c_ok = results.pop(id(child))
+                    count += c_count
+                    ok = ok and c_ok
+                if node.key is not None:
+                    if ok and id(node) not in protect and \
+                            self.pool.ref_count(node.page) == 1:
+                        count += 1
+                    else:
+                        ok = False
+                results[id(node)] = (count, ok)
+            total += results[id(root)][0]
+        return total
 
     def iter_pages(self):
         """Every page id the trie currently holds one pool reference
-        for (one per node) — the census the memory-telemetry auditor
-        (``serving/mem_telemetry.audit_pool``) and page-state
-        classifier sweep.  Pure iterative walk, no refcounts move."""
-        stack = list(self._root.children.values())
+        for (one per node, across all namespaces) — the census the
+        memory-telemetry auditor (``serving/mem_telemetry.audit_pool``)
+        and page-state classifier sweep.  Pure iterative walk, no
+        refcounts move."""
+        stack = [c for _, root in self._iter_roots(ALL_NAMESPACES)
+                 for c in root.children.values()]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
             yield n.page
 
-    def prefix_len(self, tokens, limit=None):
+    def ns_iter_pages(self, ns):
+        """``iter_pages`` scoped to one namespace (the per-tenant page
+        attribution sweep in ``mem_telemetry.classify``)."""
+        root = self._roots.get(ns)
+        stack = [] if root is None else list(root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n.page
+
+    def prefix_len(self, tokens, limit=None, ns=None):
         """Fingerprint export for the cluster router: how many leading
         tokens of ``tokens`` this cache could serve RIGHT NOW (whole
         matched pages plus the best copy-on-write partial).  Pure
         lookup — no refcounts move, no LRU touch, no stats — so the
         prefix-aware router can score every replica per admission
         without perturbing any cache."""
-        full, _, plen = self.match(tokens, limit=limit)
+        full, _, plen = self.match(tokens, limit=limit, ns=ns)
         return len(full) * self.page_size + plen
 
     def hit_rate(self):
@@ -309,25 +370,37 @@ class PrefixCache:
         and routing only needs the page-aligned score).  Pure walk:
         no refcounts, no LRU touches."""
         digests = []
-        stack = [(self._root, ())]
+        stack = [(root, (), ns) for ns, root in
+                 self._iter_roots(ALL_NAMESPACES)]
         while stack and len(digests) < max_digests:
-            node, path = stack.pop()
+            node, path, ns = stack.pop()
             for key, child in node.children.items():
                 child_path = path + key
-                digests.append(prefix_digest(child_path))
-                stack.append((child, child_path))
+                # the namespace salts the digest, so a router matching
+                # tenant A's prompt can never score a hit against
+                # tenant B's cached pages — the isolation invariant
+                # holds over the wire too
+                digests.append(prefix_digest(child_path, ns=ns))
+                stack.append((child, child_path, ns))
         return {"page_size": self.page_size, "digests": digests,
                 "lookups": self.lookups, "hits": self.hits,
                 "tokens_reused": self.tokens_reused}
 
 
-def prefix_digest(tokens):
+def prefix_digest(tokens, ns=None):
     """Deterministic cross-process digest of a token prefix: blake2b
     over the little-endian int32 token bytes.  NOT Python ``hash()``
     — that is seed-randomized per process, and the whole point is
-    that the router and a worker compute identical digests."""
-    return hashlib.blake2b(np.asarray(tokens, "<i4").tobytes(),
-                           digest_size=8).hexdigest()
+    that the router and a worker compute identical digests.  A
+    non-None namespace (multi-tenant isolation) salts the digest, so
+    equal prompts in different namespaces digest differently; the
+    ``None`` namespace keeps the legacy unsalted bytes (mixed-version
+    fleets keep matching)."""
+    h = hashlib.blake2b(digest_size=8)
+    if ns is not None:
+        h.update(repr(ns).encode("utf-8") + b"\x00")
+    h.update(np.asarray(tokens, "<i4").tobytes())
+    return h.hexdigest()
 
 
 class FingerprintMatcher:
@@ -353,18 +426,20 @@ class FingerprintMatcher:
         self.hits = int(fp.get("hits", 0))
         self.tokens_reused = int(fp.get("tokens_reused", 0))
 
-    def match_len(self, tokens, limit=None):
+    def match_len(self, tokens, limit=None, ns=None):
         """Longest page-aligned prefix of ``tokens[:limit]`` present
         in the shipped digest set, in tokens.  Walks shortest-first
         and stops at the first miss — the trie guarantees every
         ancestor of a cached prefix is cached too, so a missing
-        k-page digest rules out every longer one."""
+        k-page digest rules out every longer one.  ``ns`` must be the
+        same (tenant namespace, adapter) key the serving cache used,
+        or the salted digests can never match."""
         if not self._digests or not self.page_size:
             return 0
         n = len(tokens) if limit is None else min(limit, len(tokens))
         matched = 0
         for k in range(self.page_size, n + 1, self.page_size):
-            if prefix_digest(tokens[:k]) not in self._digests:
+            if prefix_digest(tokens[:k], ns=ns) not in self._digests:
                 break
             matched = k
         return matched

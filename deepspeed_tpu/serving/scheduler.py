@@ -226,6 +226,13 @@ class Request:
         self.t_admit = None
         self.t_first = None
         self.t_last = None
+        # multi-tenant serving tier (serving/tenancy/): the owning
+        # tenant, the named LoRA adapter it asked for, and the dense
+        # adapter-store id (-1 = base model).  All None/-1 with
+        # tenancy off — no path reads them then.
+        self.tenant = None
+        self.adapter = None
+        self.adapter_id = -1
 
     @property
     def remaining_new(self):
@@ -260,7 +267,7 @@ class ServingScheduler:
                  shared_pool=None, pools_ref=None, on_handoff=None,
                  tracer=None, mem_telemetry=False, audit_every=None,
                  comm_telemetry=False, compile_watchdog=None,
-                 online_tuner=None, tuned_from=None):
+                 online_tuner=None, tuned_from=None, tenancy=None):
         if page_size is None:
             page_size = default_page_size()
         self.engine = engine
@@ -275,6 +282,18 @@ class ServingScheduler:
         self.num_slots = int(num_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.max_queue = int(max_queue)
+        # multi-tenant serving tier (serving/tenancy/): a TenantRegistry
+        # turns on per-tenant quotas, weighted-fair admission, adapter
+        # entitlements and prefix-cache namespaces.  tenancy=None (the
+        # default) keeps every scheduler path byte-identical to the
+        # pre-tenancy code: no extra arrays, no extra jit signatures
+        # (pinned by tests/unit/test_tenancy.py).
+        self.tenancy = tenancy if tenancy else None
+        if self.tenancy is not None and not mem_telemetry:
+            # quotas bill in page-seconds: the PR-11 meter must run
+            mem_telemetry = True
+        self._adapter_ids = None if self.tenancy is None \
+            else np.full(num_slots, -1, np.int32)
         if max_pages_per_slot is None:
             max_pages_per_slot = -(-num_pages // 2) or 1
         self.kv = PagedKVManager(num_pages, page_size, num_slots,
@@ -601,7 +620,7 @@ class ServingScheduler:
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                on_token=None, deadline_s=None, handoff=False,
                trace_ctx=None, sampling=None, seed=None, grammar=None,
-               sample_offset=0):
+               sample_offset=0, tenant=None, adapter=None):
         """Queue a request; raises :class:`QueueFull` at max_queue (the
         backpressure signal callers turn into 429/retry). ``deadline_s``
         is a relative budget: a request that cannot finish inside it is
@@ -622,10 +641,17 @@ class ServingScheduler:
         per-step allowed-token mask; ``sample_offset`` counts tokens a
         previous life of this request already emitted (failover replay
         folds them into the prompt), so the PRNG stream and grammar
-        cursor CONTINUE instead of restarting."""
+        cursor CONTINUE instead of restarting.
+
+        Tenancy (``tenancy=`` on the scheduler): ``tenant`` names the
+        owning :class:`~deepspeed_tpu.serving.tenancy.TenantConfig`
+        (required — every request must be attributable for quota and
+        billing); ``adapter`` optionally names a LoRA adapter from the
+        tenant's entitlement set (None = base model)."""
         if self.draining:
             raise QueueFull("scheduler is draining (shutdown/restart in "
                             "progress); resubmit elsewhere")
+        t_cfg, adapter_id = self._resolve_tenant(tenant, adapter)
         if len(self.waiting) >= self.max_queue:
             raise QueueFull(
                 f"waiting queue at max_queue={self.max_queue}")
@@ -639,9 +665,14 @@ class ServingScheduler:
         req = Request(prompt, max_new_tokens, eos_token_id, on_token,
                       deadline_s=deadline_s)
         req.handoff = bool(handoff)
+        if t_cfg is not None:
+            req.tenant = t_cfg.name
+            req.adapter = adapter
+            req.adapter_id = adapter_id
         if trace_ctx is not None and trace_ctx.get("trace_id") is not None:
             req.trace_rid = trace_ctx["trace_id"]
         self._apply_policy(req, sampling, seed, grammar, sample_offset)
+        self._check_adapter_policy(req)
         if req.max_new_tokens <= 0:
             # parity with generate(max_new_tokens=0): nothing to emit —
             # but it still counts as completed, so health()/summary
@@ -790,6 +821,155 @@ class ServingScheduler:
         model never emits eos."""
         return req.grammar is not None and req.grammar.done
 
+    # ----------------------------------------------------------- tenancy
+    def _resolve_tenant(self, tenant, adapter):
+        """Intake-side tenancy resolution -> (TenantConfig, adapter_id).
+        With tenancy on every request must name a registered tenant (an
+        unattributable request cannot be quota-gated or billed); with
+        tenancy off the kwargs must stay unused."""
+        if self.tenancy is None:
+            if tenant is not None or adapter is not None:
+                raise ValueError(
+                    "tenant=/adapter= need ServingScheduler(tenancy="
+                    "TenantRegistry(...)); this scheduler has no tenancy")
+            return None, -1
+        if tenant is None:
+            raise ValueError(
+                "tenancy is on: every submit()/attach_handoff() must "
+                "name its tenant= for quota accounting and billing")
+        return self.tenancy.resolve(tenant, adapter)
+
+    def _check_adapter_policy(self, req):
+        """Multi-LoRA rides the LEGACY greedy signatures only (the
+        per-slot adapter gather is threaded through prefill /
+        decode_multi / verify_multi, not the policy twins).  With
+        adapters loaded, a policy-needing request — or a sampled
+        scheduler default — would force the whole batch onto the policy
+        path and silently drop its peers' adapter deltas, so it is
+        rejected at intake instead."""
+        if self.tenancy is None or self.tenancy.store is None or \
+                not len(self.tenancy.store):
+            return
+        if self._req_needs_policy(req) or not self._default_greedy:
+            raise ValueError(
+                "multi-LoRA serving rides the greedy decode path: "
+                "per-request sampling/grammar (and a sampled scheduler "
+                "default) cannot batch with adapter slots — serve "
+                "policy traffic from a scheduler without adapters")
+
+    def _req_ns(self, req):
+        """Prefix-cache namespace for one request: ``None`` (the legacy
+        shared root) with tenancy off, else ``(tenant namespace,
+        adapter)`` — cached KV depends on the adapter weights that
+        wrote it, so the adapter is part of the key (the isolation
+        oracle in tests/unit/test_tenancy.py)."""
+        if self.tenancy is None or req.tenant is None:
+            return None
+        return self.tenancy.namespace(req.tenant, req.adapter)
+
+    def _tenant_namespaces(self, tenant):
+        """Every radix namespace a tenant's pages can live under: the
+        base-model namespace plus one per entitled adapter."""
+        t = self.tenancy.get(tenant)
+        return [self.tenancy.namespace(t, a)
+                for a in (None,) + tuple(t.adapters)]
+
+    def _tenant_pages(self, tenant):
+        """A tenant's CONCURRENT page footprint — the unit its
+        ``page_quota`` caps: live slot pages + parked handoff chains +
+        its namespaces' cached prefix pages, each physical page counted
+        once (a cache page a live slot shares is still one page)."""
+        held = set()
+        for s in range(self.num_slots):
+            r = self.slot_req[s]
+            if r is not None and r.tenant == tenant:
+                held.update(self.kv._slot_pages[s])
+        for r in self._pending_attach:
+            if r.tenant == tenant:
+                held.update(r._attach[0])
+        if self.prefix_cache is not None:
+            for ns in self._tenant_namespaces(tenant):
+                held.update(self.prefix_cache.ns_iter_pages(ns))
+        return len(held)
+
+    def _tenant_live(self, tenant):
+        """True while the tenant has pages that will free on their own
+        (running slots or parked handoff chains) — the at-quota case
+        where its queue head WAITS instead of being shed."""
+        return any(r is not None and r.tenant == tenant
+                   for r in self.slot_req) or \
+            any(r.tenant == tenant for r in self._pending_attach)
+
+    def _adapter_args(self):
+        """The (adapter_ids, device pack) side inputs one legacy
+        dispatch carries.  (None, None) — the pre-tenancy leafless
+        pytree, SAME jit signature — unless tenancy is on with a
+        non-empty adapter store; with adapters loaded every dispatch
+        carries the pack (ids are traced data, so adapter churn and
+        base-only batches share one signature per horizon bucket)."""
+        if self.tenancy is None or self.tenancy.store is None or \
+                not len(self.tenancy.store):
+            return None, None
+        return self._adapter_ids, self.tenancy.store.pack()
+
+    def _release_adapter(self, slot):
+        if self._adapter_ids is not None:
+            self._adapter_ids[slot] = -1
+
+    def _pick_waiting(self, skip=frozenset()):
+        """The next admission candidate (still IN ``self.waiting``):
+        plain FIFO head with tenancy off; with tenancy on, weighted
+        deficit round-robin over the per-tenant FIFO heads, costed in
+        pages (``skip`` holds tenants parked at quota this round), so a
+        burst tenant converges to its weight share of admissions and
+        cannot starve a lighter one (the starvation oracle)."""
+        if self.tenancy is None:
+            return self.waiting[0] if self.waiting else None
+        heads = {}
+        for r in self.waiting:
+            if r.tenant not in skip and r.tenant not in heads:
+                heads[r.tenant] = r
+        if not heads:
+            return None
+        costs = {t: max(1, self.kv.pool.pages_for_tokens(len(r.prompt)))
+                 for t, r in heads.items()}
+        return heads[self.tenancy.next_tenant(costs)]
+
+    def _check_quota(self, req, need, protect):
+        """Quota gate for one candidate admission.  Returns ``"admit"``,
+        ``"wait"`` (at quota, but the tenant's own live/parked work
+        will free pages — park its queue this round), or a shed-reason
+        string (the request can never fit the quota).  A tenant over
+        quota drains its OWN namespaces' cached pages first; it can
+        never evict another tenant's pages (capacity isolation)."""
+        if self.tenancy is None:
+            return "admit"
+        quota = self.tenancy.get(req.tenant).page_quota
+        if quota is None:
+            return "admit"
+        if need > quota:
+            return (f"tenant page quota: request needs {need} pages, "
+                    f"{req.tenant}'s quota is {quota}")
+        held = self._tenant_pages(req.tenant)
+        over = held + need - quota
+        if over > 0 and self.prefix_cache is not None:
+            drained = 0
+            for ns in self._tenant_namespaces(req.tenant):
+                drained += self.prefix_cache.evict(over - drained,
+                                                   protect, ns=ns)
+                if drained >= over:
+                    break
+            if drained:
+                self.metrics.record_cache_eviction(self.step_idx, drained)
+                over -= drained
+        if over <= 0:
+            return "admit"
+        if self._tenant_live(req.tenant):
+            return "wait"
+        return (f"tenant page quota: {req.tenant} holds {held} page(s) "
+                f"+ {need} needed > quota {quota} with nothing left "
+                "to drain")
+
     # --------------------------------------------------------- accounting
     def _emit(self, req, tok):
         # fault point: a raised exception here is attributable to THIS
@@ -816,6 +996,17 @@ class ServingScheduler:
             req.error = reason
         self.requests.pop(req.rid, None)
         self.completed.append(req)
+        if self.tenancy is not None and req.tenant is not None:
+            # chargeback at retirement: the PR-11 page-seconds integral
+            # (and the hwm/token counters) land on the tenant's ledger
+            # exactly once, whatever the terminal state
+            self.tenancy.bill(req.tenant, page_seconds=req.page_seconds,
+                              pages_hwm=req.pages_hwm,
+                              tokens=len(req.out_tokens))
+            if state in (FINISHED, HANDOFF):
+                self.tenancy.note(req.tenant, "completed")
+            elif state == SHED:
+                self.tenancy.note(req.tenant, "shed")
         if self.tracer.enabled:
             # one span per request covering its whole scheduler life —
             # the top-level row a per-request trace view groups under
@@ -841,7 +1032,8 @@ class ServingScheduler:
         n_full = max(0, len(seq) - 1) // self.kv.page_size
         pages = self.kv.take_slot_pages(slot)
         keep, tail = pages[:n_full], pages[n_full:]
-        leftover = self.prefix_cache.insert(seq, keep) if keep else []
+        leftover = self.prefix_cache.insert(
+            seq, keep, ns=self._req_ns(req)) if keep else []
         self.kv.pool.free(leftover + tail)
 
     def _spec_release(self, slot, req):
@@ -863,6 +1055,7 @@ class ServingScheduler:
             self.kv.release_slot(slot)
         self.slot_req[slot] = None
         self.lengths[slot] = 0
+        self._release_adapter(slot)
         self._finalize(req, FINISHED)
         if self._collect is not None:
             # run()'s result set stays complete even after the bounded
@@ -878,6 +1071,7 @@ class ServingScheduler:
         self.kv.release_slot(slot)
         self.slot_req[slot] = None
         self.lengths[slot] = 0
+        self._release_adapter(slot)
         self._finalize(req, state, reason)
         self.metrics.record_terminal(self.step_idx, state, req.rid, reason)
         if state == FAILED:
@@ -901,6 +1095,19 @@ class ServingScheduler:
                 self.slot_req[protect] is not None else []
         if not candidates:
             return None
+        if self.tenancy is not None and protect is not None and \
+                self.slot_req[protect] is not None:
+            # capacity isolation: a grower whose tenant is at/over its
+            # quota preempts ITS OWN youngest request when it has one —
+            # a quota-capped tenant never evicts another tenant's work
+            grower = self.slot_req[protect].tenant
+            quota = None if grower is None \
+                else self.tenancy.get(grower).page_quota
+            if quota is not None and self._tenant_pages(grower) >= quota:
+                own = [s for s in candidates
+                       if self.slot_req[s].tenant == grower]
+                if own:
+                    candidates = own
         victim = max(candidates, key=lambda s: self.slot_req[s].t_admit)
         req = self.slot_req[victim]
         if chain is not None:
@@ -910,11 +1117,14 @@ class ServingScheduler:
         self.kv.release_slot(victim)
         self.slot_req[victim] = None
         self.lengths[victim] = 0
+        self._release_adapter(victim)
         req.state = WAITING
         req.prompt = req.orig_prompt + req.out_tokens
         req.prefill_pos = 0
         self.waiting.appendleft(req)
         self.metrics.record_preemption(self.step_idx)
+        if self.tenancy is not None and req.tenant is not None:
+            self.tenancy.note(req.tenant, "preempted")
         return victim
 
     def _reclaim_cached(self, n_pages, protect=frozenset()):
@@ -1012,7 +1222,8 @@ class ServingScheduler:
         if self.prefix_cache is not None and req.prefill_pos == 0 \
                 and pending > 1:
             full, _, plen = self.prefix_cache.match(
-                req.prompt, limit=len(req.prompt) - 1)
+                req.prompt, limit=len(req.prompt) - 1,
+                ns=self._req_ns(req))
             pending = max(1, pending - len(full) * self.kv.page_size
                           - plen)
         chunk = self.prefill_chunk
@@ -1136,6 +1347,17 @@ class ServingScheduler:
             # rolling page-state attribution + per-request page-seconds
             # + sustained-pressure detection (one host sweep per step)
             self.mem.on_step(self)
+        if self.tenancy is not None and not chained:
+            # scalar tenancy gauges per barrier step; the per-tenant
+            # split rides health()["tenants"] (scalar-only sinks)
+            pages = {t: self._tenant_pages(t)
+                     for t in self.tenancy.tenants}
+            self.metrics.record_tenants(
+                self.step_idx,
+                active=sum(1 for p in pages.values() if p),
+                page_seconds=sum(u.page_seconds for u in
+                                 self.tenancy.usage.values()),
+                max_share=max(pages.values()) / self.kv.pool.num_pages)
         if self.audit_every and not chained and \
                 self.step_idx % self.audit_every == 0:
             # barrier steps only: a chained step's host view is not
@@ -1161,38 +1383,65 @@ class ServingScheduler:
 
     # ------------------------------------------------- boundary phases
     def _admit(self, now):
+        at_quota = set()   # tenants parked this round: at quota, with
+                           # their own live/parked pages still draining
         for slot in range(self.num_slots):
             if self.slot_req[slot] is not None or slot in self._zombies:
                 continue
-            # deadline-aware admission: shed what cannot finish in time
-            # instead of admitting it and wasting pool pages
-            while self.waiting and self._infeasible(self.waiting[0], now):
-                req = self.waiting.popleft()
-                self._drop_waiting(
-                    req, SHED,
-                    f"deadline infeasible at admission "
-                    f"(needs ~{self._estimated_service_steps(req)} steps "
-                    f"at {self._step_s_estimate() * 1e3:.1f} ms/step)")
-            if not self.waiting:
+            req = hit = None
+            need, protect = 0, frozenset()
+            while self.waiting:
+                req = self._pick_waiting(at_quota)
+                if req is None:
+                    break
+                # deadline-aware admission: shed what cannot finish in
+                # time instead of admitting it and wasting pool pages
+                if self._infeasible(req, now):
+                    self.waiting.remove(req)
+                    self._drop_waiting(
+                        req, SHED,
+                        f"deadline infeasible at admission "
+                        f"(needs ~{self._estimated_service_steps(req)} "
+                        f"steps at "
+                        f"{self._step_s_estimate() * 1e3:.1f} ms/step)")
+                    req = None
+                    continue
+                hit = None
+                if self.prefix_cache is not None:
+                    # longest-prefix match, capped at len(prompt)-1 so
+                    # at least one prompt token remains to prefill (the
+                    # boundary logits the first sampled token comes
+                    # from); namespaced per (tenant, adapter) — a
+                    # cross-tenant identical prompt can never hit
+                    hit = self.prefix_cache.match(
+                        req.prompt, limit=len(req.prompt) - 1,
+                        ns=self._req_ns(req))
+                # admission control: the UNIQUE part of the prompt must
+                # fit now — matched full pages are shared, not
+                # allocated, and refcount-free cached pages count as
+                # reclaimable capacity (drained on demand, with the
+                # matched chain protected)
+                need = self.kv.pool.pages_for_tokens(len(req.prompt))
+                protect = frozenset()
+                if hit is not None:
+                    need -= len(hit[0])
+                    protect = frozenset(
+                        id(n) for n in hit[0] +
+                        ([hit[1]] if hit[1] is not None else []))
+                verdict = self._check_quota(req, need, protect)
+                if verdict == "admit":
+                    break
+                if verdict == "wait":
+                    # backlogged at quota: its own retirements will
+                    # free pages — park the tenant, try the next one
+                    at_quota.add(req.tenant)
+                else:
+                    self.waiting.remove(req)
+                    self._drop_waiting(req, SHED, verdict)
+                    self.metrics.record_quota_shed(self.step_idx)
+                req = None
+            if req is None:
                 break
-            req = self.waiting[0]
-            hit = None
-            if self.prefix_cache is not None:
-                # longest-prefix match, capped at len(prompt)-1 so at
-                # least one prompt token remains to prefill (the
-                # boundary logits the first sampled token comes from)
-                hit = self.prefix_cache.match(req.prompt,
-                                              limit=len(req.prompt) - 1)
-            # admission control: the UNIQUE part of the prompt must fit
-            # now — matched full pages are shared, not allocated, and
-            # refcount-free cached pages count as reclaimable capacity
-            # (drained on demand, with the matched chain protected)
-            need = self.kv.pool.pages_for_tokens(len(req.prompt))
-            protect = frozenset()
-            if hit is not None:
-                need -= len(hit[0])
-                protect = frozenset(id(n) for n in hit[0] +
-                                    ([hit[1]] if hit[1] is not None else []))
             short = need - self.kv.pool.free_pages
             if short > 0:
                 chain = self.mem.chain(
@@ -1217,7 +1466,7 @@ class ServingScheduler:
                                 else "blocked")
                 if drained < short:
                     break
-            self.waiting.popleft()
+            self.waiting.remove(req)
             self.slot_req[slot] = req
             req.state = PREFILL
             # one timestamp per phase: admission decisions within a step
@@ -1231,6 +1480,9 @@ class ServingScheduler:
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
             self._seed_slot_policy(slot, req)
+            if self.tenancy is not None:
+                self._adapter_ids[slot] = req.adapter_id
+                self.tenancy.note(req.tenant, "admitted")
             self.lengths[slot] = 0
             req.cached_prefix_tokens = 0
             if hit is not None:
@@ -1313,6 +1565,17 @@ class ServingScheduler:
         if self.seq_parallel_threshold <= 0 \
                 or pending < self.seq_parallel_threshold:
             return
+        if req.adapter_id >= 0:
+            # the sp closure carries no adapter side input: an adapter
+            # request degrades to the chunked loop (which does) with a
+            # breadcrumb — routing is an optimization, never a
+            # correctness gate
+            self.metrics.record_seq_prefill_degrade(self.step_idx)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "seq_prefill_degrade", track=slot, rid=req.trace_rid,
+                    args={"reason": "lora adapter slot"})
+            return
         if self.seq_plan is None:
             self.metrics.record_seq_prefill_degrade(self.step_idx)
             if self.tracer.enabled:
@@ -1382,11 +1645,18 @@ class ServingScheduler:
                         args={"tokens": n_valid, "pos": req.prefill_pos,
                               "seq_parallel": sp}
                         if self.tracer.enabled else None):
-                    fn = self.engine.prefill_sequence_parallel if sp \
-                        else self.engine.prefill_into_slots
-                    logits, self.pools = fn(
-                        ids, slot, n_valid, self.kv.table, self.lengths,
-                        self.pools)
+                    if sp:
+                        logits, self.pools = \
+                            self.engine.prefill_sequence_parallel(
+                                ids, slot, n_valid, self.kv.table,
+                                self.lengths, self.pools)
+                    else:
+                        a_ids, a_pack = self._adapter_args()
+                        logits, self.pools = \
+                            self.engine.prefill_into_slots(
+                                ids, slot, n_valid, self.kv.table,
+                                self.lengths, self.pools,
+                                adapter_ids=a_ids, adapters=a_pack)
                 if sp:
                     self.metrics.record_seq_prefill_chunk(self.step_idx,
                                                           n_valid)
@@ -1458,6 +1728,7 @@ class ServingScheduler:
         plen = int(self.lengths[slot])
         self.slot_req[slot] = None
         self.lengths[slot] = 0
+        self._release_adapter(slot)
         try:
             self.on_handoff(req, pages, plen, tok)
         except Exception as e:
@@ -1477,7 +1748,8 @@ class ServingScheduler:
     def attach_handoff(self, prompt, pages, length, first_tok, *,
                        max_new_tokens, eos_token_id=None, on_token=None,
                        deadline_s=None, trace_ctx=None, sampling=None,
-                       seed=None, grammar=None, sample_offset=0):
+                       seed=None, grammar=None, sample_offset=0,
+                       tenant=None, adapter=None):
         """Decode-worker intake for a prefill worker's donated chain:
         the request joins with its prompt KV already written (``pages``
         cover ``length`` prefilled positions in the SHARED pool) and its
@@ -1489,8 +1761,15 @@ class ServingScheduler:
         can absorb)."""
         if self.draining:
             raise QueueFull("scheduler is draining; handoff refused")
+        t_cfg, adapter_id = self._resolve_tenant(tenant, adapter)
         req = Request(prompt, max_new_tokens, eos_token_id, on_token,
                       deadline_s=deadline_s)
+        if t_cfg is not None:
+            # failover/disaggregation preserves attribution: the decode
+            # side keeps billing the SAME tenant the prefill side did
+            req.tenant = t_cfg.name
+            req.adapter = adapter
+            req.adapter_id = adapter_id
         if trace_ctx is not None and trace_ctx.get("trace_id") is not None:
             req.trace_rid = trace_ctx["trace_id"]
         now = time.monotonic()
@@ -1506,6 +1785,7 @@ class ServingScheduler:
         # the SAME offset, and _apply_policy replays the grammar cursor
         # through it
         self._apply_policy(req, sampling, seed, grammar, sample_offset)
+        self._check_adapter_policy(req)
         req._attach = (list(pages), int(length), int(first_tok))
         if req.remaining_new <= 0:
             self.kv.pool.free(req._attach[0])
@@ -1552,6 +1832,9 @@ class ServingScheduler:
             self._eos_ids[slot] = -1 if req.eos_token_id is None \
                 else int(req.eos_token_id)
             self._seed_slot_policy(slot, req)
+            if self.tenancy is not None:
+                self._adapter_ids[slot] = req.adapter_id
+                self.tenancy.note(req.tenant, "admitted")
             req.t_admit = now
             req.state = RUNNING
             if self.tracer.enabled:
@@ -1913,10 +2196,12 @@ class ServingScheduler:
             self.metrics.record_policy_dispatch(self.step_idx,
                                                 len(running))
         else:
+            a_ids, a_pack = self._adapter_args()
             out = self.engine.verify_multi(
                 self.last_tok, draft_arr, active, self.kv.table,
                 self.lengths, self.pools, widths=widths, budgets=budgets,
-                eos_ids=self._eos_ids)
+                eos_ids=self._eos_ids, adapter_ids=a_ids,
+                adapters=a_pack)
             (toks, valid, tok_end, active_end, lengths_end, emitted_end,
              accepted, pools) = out
         self.pools = pools
@@ -1979,10 +2264,12 @@ class ServingScheduler:
                                                 len(running))
         else:
             pol = None
+            a_ids, a_pack = self._adapter_args()
             out = self.engine.decode_multi(
                 self.last_tok, active, self.kv.table, self.lengths,
                 self.pools, horizon=horizon, budgets=budgets,
-                eos_ids=self._eos_ids, **self.sampling)
+                eos_ids=self._eos_ids, adapter_ids=a_ids,
+                adapters=a_pack, **self.sampling)
         self._commit_dispatch(out, running, horizon,
                               {s: self.slot_req[s] for s in running},
                               policy=pol)
@@ -2148,11 +2435,15 @@ class ServingScheduler:
                           "mask")}
         else:
             chain_pol = None
+            # membership is frozen across a chain, so the slot->adapter
+            # map (and therefore the staged ids) is unchanged
+            a_ids, a_pack = self._adapter_args()
             out = self.engine.decode_multi(
                 prev["tok_end"], active, self.kv.table,
                 prev["lengths_end"], self.pools, horizon=horizon,
                 budgets=self._chain_budgets, eos_ids=self._eos_ids,
-                emitted=prev["emitted_end"], **self.sampling)
+                emitted=prev["emitted_end"], adapter_ids=a_ids,
+                adapters=a_pack, **self.sampling)
         self._commit_dispatch(out, cont, horizon,
                               {s: prev["reqs"][s] for s in cont},
                               policy=chain_pol)
@@ -2403,8 +2694,18 @@ class ServingScheduler:
                 raise memtel.AuditError(msg)
             reports.append({"label": "attribution", "errors": [msg],
                             "ok": False})
-        return {"ok": all(r.get("ok", True) for r in reports),
-                "reports": reports, "counts": counts}
+        out = {"ok": all(r.get("ok", True) for r in reports),
+               "reports": reports, "counts": counts}
+        if self.tenancy is not None:
+            # per-tenant split of the same census: every attributable
+            # page charged to exactly one tenant (a page under two
+            # tenants is a cross-tenant leak and fails the audit)
+            treport = memtel.classify_tenants(
+                self, raise_on_error=raise_on_error)
+            reports.append(treport)
+            out["ok"] = out["ok"] and treport["ok"]
+            out["tenants"] = treport["tenants"]
+        return out
 
     # ------------------------------------------------- comm ledger
     def comm_ledger(self, refresh=False):
@@ -2625,6 +2926,23 @@ class ServingScheduler:
             "last_error": self._last_error,
             "ha_epoch": self.ha_epoch,
             "ha_fenced": self.ha_fenced,
+            # multi-tenant serving tier: per-tenant usage ledgers
+            # (page-seconds billed, admissions, sheds) + live page
+            # footprints, and the loaded adapter-store shape (the
+            # rank bucket is a jit-signature input — operators watch
+            # it to understand warmup recompiles)
+            "tenancy": self.tenancy is not None,
+            "tenants": None if self.tenancy is None
+            else self.tenancy.usage_fields(),
+            "tenant_pages": None if self.tenancy is None
+            else {t: self._tenant_pages(t)
+                  for t in sorted(self.tenancy.tenants)},
+            "adapters": 0 if self.tenancy is None or
+            self.tenancy.store is None else len(self.tenancy.store),
+            "adapter_rank_bucket": 0 if self.tenancy is None or
+            self.tenancy.store is None
+            else self.tenancy.store.rank_bucket(),
+            "quota_shed": m.quota_shed,
         }
 
     def summary(self):

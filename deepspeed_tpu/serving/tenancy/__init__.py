@@ -1,0 +1,30 @@
+"""Multi-tenant serving tier: paged multi-LoRA adapters, per-tenant
+quotas billed in page-seconds, and weighted-fair admission over the one
+shared page pool.
+
+* :class:`AdapterStore` — N adapters' LoRA factors as stacked device
+  arrays (rank-padded to power-of-two buckets) gathered per slot inside
+  the fused decode scan (models/lora.py).
+* :class:`TenantConfig` / :class:`TenantRegistry` — tenant identity,
+  adapter entitlements, page quotas, fairness weights, per-tenant
+  prefix-cache namespaces, usage accounting, and the weighted
+  deficit-round-robin admission pick.
+
+``ServingScheduler(tenancy=registry)`` turns the tier on; with
+``tenancy=None`` (the default) every scheduler path is byte-identical
+to the pre-tenancy code — no extra arrays, no extra jit signatures.
+"""
+
+from deepspeed_tpu.serving.tenancy.adapters import (AdapterStore,
+                                                    random_adapter)
+from deepspeed_tpu.serving.tenancy.cli import (build_adapter_store,
+                                               build_tenancy,
+                                               load_tenants,
+                                               parse_lora_spec)
+from deepspeed_tpu.serving.tenancy.registry import (TenantConfig,
+                                                    TenantRegistry,
+                                                    TenantUsage)
+
+__all__ = ["AdapterStore", "random_adapter", "TenantConfig",
+           "TenantRegistry", "TenantUsage", "build_adapter_store",
+           "build_tenancy", "load_tenants", "parse_lora_spec"]

@@ -1,0 +1,219 @@
+"""AdapterStore: N LoRA adapters as stacked, rank-bucketed device
+arrays for the paged multi-LoRA decode path (S-LoRA / Punica shape).
+
+Layout per injected projection (models/lora.lora_targets) and layer::
+
+    a: [n_adapters, in_dim,  rank_bucket]
+    b: [n_adapters, rank_bucket, out_dim]
+
+plus one ``scale: [n_adapters]`` (``alpha / rank``).  Ranks zero-pad up
+to a power-of-two bucket, so the device pack's SHAPES — and therefore
+the jit signatures of every serving primitive that takes it — depend
+only on (adapter count, rank bucket, model dims), never on which
+adapter any slot runs: adapter churn within a bucket compiles nothing.
+Growing the adapter set or crossing a rank bucket re-stacks the pack
+(one new signature per horizon/K bucket, the documented warmup).
+
+Sharding mirrors the base matrices: the factor dimension that sits on
+the ``model`` mesh axis in the base kernel (out_dim for column-
+parallel, in_dim for row-parallel) shards over ``model`` when it
+divides, else the tiny factors replicate — either way the delta einsum
+composes with the base projection under GSPMD without reshards of x.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.models.lora import lora_targets
+
+
+def _next_pow2(n):
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
+def random_adapter(cfg, rank, seed, targets=None, stddev=0.02):
+    """A synthetic full-coverage adapter (tests / bench): every target
+    of every layer gets dense N(0, stddev) A and B factors — unlike
+    real LoRA init (B = 0) both factors are non-zero so the delta
+    actually moves logits and the token-exactness oracles bite."""
+    targets = targets or lora_targets(cfg)
+    rng = np.random.default_rng(seed)
+    layers = []
+    for _ in range(cfg.num_layers):
+        layer = {}
+        for t, (d_in, d_out, _) in targets.items():
+            layer[t] = (rng.normal(0, stddev, (d_in, rank)).astype(
+                            np.float32),
+                        rng.normal(0, stddev, (rank, d_out)).astype(
+                            np.float32))
+        layers.append(layer)
+    return layers
+
+
+class AdapterStore:
+    """Holds adapters by name, hands out dense integer ids (insertion
+    order), and lazily builds/caches the stacked device pack."""
+
+    def __init__(self, cfg, mesh=None, targets=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.targets = dict(targets or lora_targets(cfg))
+        self.num_layers = int(cfg.num_layers)
+        self._adapters = {}      # name -> {"layers": [...], "alpha", "rank"}
+        self._order = []         # name by id
+        self._pack = None        # cached device pack
+        self._pack_bucket = None
+
+    def __len__(self):
+        return len(self._order)
+
+    def names(self):
+        return list(self._order)
+
+    def has(self, name):
+        return name in self._adapters
+
+    def id_of(self, name):
+        return self._order.index(name)
+
+    def rank_of(self, name):
+        return self._adapters[name]["rank"]
+
+    def add(self, name, layers, alpha=None):
+        """Register adapter ``name``: ``layers`` is one dict per model
+        layer mapping target -> (A [in, r], B [r, out]).  Targets may
+        cover any subset; dims are validated against the model's target
+        table.  ``alpha`` defaults to the adapter's rank (scale 1.0).
+        Re-adding a name replaces its weights in place (same id)."""
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"adapter {name!r}: {len(layers)} layers, model has "
+                f"{self.num_layers}")
+        rank = 0
+        for i, layer in enumerate(layers):
+            for t, (a, b) in layer.items():
+                if t not in self.targets:
+                    raise ValueError(
+                        f"adapter {name!r} layer {i}: unknown target "
+                        f"{t!r} (have {sorted(self.targets)})")
+                d_in, d_out, _ = self.targets[t]
+                a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+                if a.shape[0] != d_in or b.shape[1] != d_out or \
+                        a.shape[1] != b.shape[0]:
+                    raise ValueError(
+                        f"adapter {name!r} layer {i} target {t!r}: "
+                        f"A{a.shape} @ B{b.shape} does not fit "
+                        f"[{d_in} -> {d_out}]")
+                rank = max(rank, a.shape[1])
+        if rank == 0:
+            raise ValueError(f"adapter {name!r} has no factors")
+        if name not in self._adapters:
+            self._order.append(name)
+        self._adapters[name] = {
+            "layers": [{t: (np.asarray(a, np.float32),
+                            np.asarray(b, np.float32))
+                        for t, (a, b) in layer.items()}
+                       for layer in layers],
+            "alpha": float(rank if alpha is None else alpha),
+            "rank": int(rank),
+        }
+        self._pack = None
+        return self.id_of(name)
+
+    def load_npz(self, name, path, alpha=None):
+        """Load an adapter checkpoint: an ``.npz`` with keys
+        ``layers.{i}.{target}.a`` / ``....b`` (float arrays)."""
+        with np.load(path) as z:
+            layers = [dict() for _ in range(self.num_layers)]
+            for key in z.files:
+                parts = key.split(".")
+                if len(parts) != 4 or parts[0] != "layers" or \
+                        parts[3] not in ("a", "b"):
+                    raise ValueError(
+                        f"{path}: unexpected key {key!r} (want "
+                        "layers.<i>.<target>.<a|b>)")
+                i, t = int(parts[1]), parts[2]
+                layers[i].setdefault(t, [None, None])
+                layers[i][t][parts[3] == "b"] = np.asarray(z[key])
+            for i, layer in enumerate(layers):
+                for t, ab in layer.items():
+                    if ab[0] is None or ab[1] is None:
+                        raise ValueError(
+                            f"{path}: layer {i} target {t!r} is missing "
+                            "its a or b factor")
+                    layer[t] = (ab[0], ab[1])
+        return self.add(name, layers, alpha=alpha)
+
+    def rank_bucket(self):
+        """Current power-of-two rank bucket (the shape every factor
+        stack pads to — a jit-signature input)."""
+        if not self._adapters:
+            return 0
+        return _next_pow2(max(a["rank"] for a in self._adapters.values()))
+
+    def pack(self):
+        """The stacked device pack ``{"scale": [n], "layers": [{target:
+        {"a", "b"}} ...]}`` — cached until the adapter set changes.
+        Adapters that skip a target contribute zero factors there
+        (exact-zero delta)."""
+        if not self._adapters:
+            raise ValueError("AdapterStore is empty")
+        if self._pack is not None:
+            return self._pack
+        import jax
+        import jax.numpy as jnp
+
+        n, rb = len(self._order), self.rank_bucket()
+        covered = set()
+        for ad in self._adapters.values():
+            for layer in ad["layers"]:
+                covered.update(layer)
+        scale = np.zeros(n, np.float32)
+        for i, name in enumerate(self._order):
+            ad = self._adapters[name]
+            scale[i] = ad["alpha"] / ad["rank"]
+        layers = []
+        for li in range(self.num_layers):
+            layer = {}
+            for t in sorted(covered):
+                d_in, d_out, shard_dim = self.targets[t]
+                a = np.zeros((n, d_in, rb), np.float32)
+                b = np.zeros((n, rb, d_out), np.float32)
+                for i, name in enumerate(self._order):
+                    fac = self._adapters[name]["layers"][li].get(t)
+                    if fac is None:
+                        continue
+                    r = fac[0].shape[1]
+                    a[i, :, :r] = fac[0]
+                    b[i, :r, :] = fac[1]
+                layer[t] = {"a": self._put(a, shard_dim == "in"),
+                            "b": self._put(b, False,
+                                           out=shard_dim == "out")}
+            layers.append(layer)
+        self._pack = {"scale": jnp.asarray(scale) if self.mesh is None
+                      else jax.device_put(scale, self._replicated()),
+                      "layers": layers}
+        self._pack_bucket = rb
+        return self._pack
+
+    def _replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def _put(self, arr, shard_in, out=False):
+        """Commit one factor stack: shard the base matrix's model-
+        parallel dimension over ``model`` when it divides, else
+        replicate (the factors are tiny; correctness never depends on
+        the placement)."""
+        import jax
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        msize = self.mesh.shape.get("model", 1)
+        spec = P()
+        if msize > 1:
+            if shard_in and arr.shape[1] % msize == 0:
+                spec = P(None, "model", None)      # a: [n, in, r]
+            elif out and arr.shape[2] % msize == 0:
+                spec = P(None, None, "model")      # b: [n, r, out]
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))

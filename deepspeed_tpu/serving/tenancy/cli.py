@@ -1,0 +1,85 @@
+"""CLI-facing tenancy construction, shared by ``ds_serve`` and the
+process-replica worker so both sides of a cluster build the IDENTICAL
+registry from the same ``--tenants``/``--lora`` strings.
+
+* ``--tenants tenants.json`` — a JSON list of tenant dicts (the
+  :meth:`TenantConfig.from_dict` schema: name, weight, page_quota,
+  adapters, prefix_namespace).
+* ``--lora name=path.npz,name2=random:4:7`` — the adapter roster.  A
+  ``.npz`` path loads a checkpoint (``layers.{i}.{target}.{a|b}``
+  keys); the ``random:<rank>[:<seed>]`` form builds a synthetic
+  full-coverage adapter (bench/tests — every worker with the same spec
+  and model seed holds bitwise-identical factors, so failover replays
+  stay token-exact exactly like base params do).
+"""
+
+import json
+
+from deepspeed_tpu.serving.tenancy.adapters import (AdapterStore,
+                                                    random_adapter)
+from deepspeed_tpu.serving.tenancy.registry import TenantRegistry
+
+
+def parse_lora_spec(spec):
+    """``name=source,...`` -> ordered ``[(name, source), ...]``."""
+    out = []
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" not in item:
+            raise ValueError(
+                f"--lora entry {item!r}: want name=path.npz or "
+                "name=random:<rank>[:<seed>]")
+        name, src = item.split("=", 1)
+        out.append((name.strip(), src.strip()))
+    return out
+
+
+def build_adapter_store(cfg, lora_spec, mesh=None):
+    """An :class:`AdapterStore` from a ``--lora`` spec string (or an
+    already-parsed list of (name, source) pairs).  Returns None for an
+    empty spec — base-only serving keeps the leafless-pytree dispatch
+    signature."""
+    pairs = parse_lora_spec(lora_spec) if isinstance(lora_spec, str) \
+        else list(lora_spec or ())
+    if not pairs:
+        return None
+    store = AdapterStore(cfg, mesh=mesh)
+    for name, src in pairs:
+        if src.startswith("random"):
+            parts = src.split(":")
+            rank = int(parts[1]) if len(parts) > 1 else 4
+            seed = int(parts[2]) if len(parts) > 2 else 0
+            store.add(name, random_adapter(cfg, rank, seed=seed))
+        else:
+            store.load_npz(name, src)
+    return store
+
+
+def load_tenants(path_or_list):
+    """Tenant dicts from a JSON file path (a list, or ``{"tenants":
+    [...]}``) or an already-parsed list."""
+    if isinstance(path_or_list, str):
+        with open(path_or_list) as f:
+            data = json.load(f)
+    else:
+        data = path_or_list
+    if isinstance(data, dict):
+        data = data.get("tenants", [])
+    return list(data)
+
+
+def build_tenancy(cfg, tenants=None, lora=None, mesh=None,
+                  quantum_pages=8):
+    """The one-call CLI entry: ``(tenants json path/list, --lora
+    spec) -> TenantRegistry`` (or None when no tenants are given —
+    tenancy off).  An adapter roster without tenants is rejected:
+    adapters only dispatch through a tenant entitlement."""
+    store = build_adapter_store(cfg, lora, mesh=mesh)
+    if tenants is None:
+        if store is not None:
+            raise ValueError(
+                "--lora without --tenants: adapters serve only through "
+                "tenant entitlements (give each tenant an 'adapters' "
+                "list in tenants.json)")
+        return None
+    return TenantRegistry(load_tenants(tenants), adapter_store=store,
+                          quantum_pages=quantum_pages)

@@ -1,0 +1,211 @@
+"""Tenant registry: identity, adapter entitlements, page quotas,
+fairness weights, per-tenant prefix namespaces, usage accounting and
+the weighted deficit-round-robin (WDRR) admission pick.
+
+Semantics the scheduler builds on:
+
+* **Quota** (``page_quota``) caps a tenant's CONCURRENT page footprint
+  — live slot pages + parked handoff chains + its namespace's cached
+  prefix pages.  A tenant at quota drains/evicts only its own pages;
+  it can never force another tenant's pages out (capacity isolation).
+* **Billing** is the PR-11 page-seconds meter: every finished request
+  adds its integrated ``pages x seconds`` to the tenant's ledger (the
+  chargeback unit ``health()['tenants']`` and the journal expose).
+* **Fairness**: admission serves tenants by deficit round-robin with
+  per-tenant weights, costed in pages.  Each visit a tenant earns
+  ``quantum_pages x weight`` credit; a request admits when its page
+  cost fits the tenant's accumulated deficit.  An idle tenant's
+  deficit resets (no hoarding), so a burst tenant converges to its
+  weight share and cannot starve a lighter one (the starvation
+  oracle).
+* **Prefix namespace** is ``(tenant namespace, adapter)``: cached KV
+  depends on the adapter that produced it, so adapter identity MUST be
+  part of the radix key — two tenants (or two adapters of one tenant)
+  never share cached KV even for identical prompts.
+"""
+
+import collections
+
+
+class TenantConfig:
+    """One tenant: adapter entitlements, capacity quota, fairness
+    weight, prefix-cache namespace (defaults to the tenant name)."""
+
+    def __init__(self, name, *, weight=1.0, page_quota=None, adapters=(),
+                 prefix_namespace=None):
+        if not name or not isinstance(name, str):
+            raise ValueError("tenant name must be a non-empty string")
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        if page_quota is not None and page_quota <= 0:
+            raise ValueError(f"tenant {name!r}: page_quota must be > 0")
+        self.name = name
+        self.weight = float(weight)
+        self.page_quota = None if page_quota is None else int(page_quota)
+        self.adapters = tuple(adapters)
+        self.prefix_namespace = prefix_namespace or name
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        name = d.pop("name")
+        known = {k: d.pop(k) for k in ("weight", "page_quota", "adapters",
+                                       "prefix_namespace") if k in d}
+        if d:
+            raise ValueError(
+                f"tenant {name!r}: unknown config keys {sorted(d)}")
+        return cls(name, **known)
+
+
+class TenantUsage:
+    """Per-tenant running ledger (host-side counters only)."""
+
+    __slots__ = ("page_seconds", "pages_hwm", "admitted", "completed",
+                 "shed", "preempted", "tokens_emitted")
+
+    def __init__(self):
+        self.page_seconds = 0.0
+        self.pages_hwm = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.preempted = 0
+        self.tokens_emitted = 0
+
+    def fields(self):
+        return {"page_seconds": round(self.page_seconds, 6),
+                "pages_hwm": self.pages_hwm, "admitted": self.admitted,
+                "completed": self.completed, "shed": self.shed,
+                "preempted": self.preempted,
+                "tokens_emitted": self.tokens_emitted}
+
+
+class TenantRegistry:
+    """The scheduler's tenancy root: tenants by name, the shared
+    :class:`AdapterStore`, usage ledgers, and WDRR admission state."""
+
+    def __init__(self, tenants, adapter_store=None, quantum_pages=8):
+        self.store = adapter_store
+        self.tenants = {}
+        for t in tenants:
+            if isinstance(t, dict):
+                t = TenantConfig.from_dict(t)
+            if t.name in self.tenants:
+                raise ValueError(f"duplicate tenant {t.name!r}")
+            for a in t.adapters:
+                if adapter_store is None or not adapter_store.has(a):
+                    raise ValueError(
+                        f"tenant {t.name!r}: adapter {a!r} not in the "
+                        "adapter store")
+            self.tenants[t.name] = t
+        if not self.tenants:
+            raise ValueError("TenantRegistry needs at least one tenant")
+        seen_ns = {}
+        for t in self.tenants.values():
+            other = seen_ns.setdefault(t.prefix_namespace, t.name)
+            if other != t.name:
+                raise ValueError(
+                    f"tenants {other!r} and {t.name!r} share prefix "
+                    f"namespace {t.prefix_namespace!r} — cached KV "
+                    "would cross the tenant boundary")
+        self.usage = {n: TenantUsage() for n in self.tenants}
+        self.quantum_pages = int(quantum_pages)
+        self._deficit = {n: 0.0 for n in self.tenants}
+        self._rr = list(self.tenants)
+        self._ptr = 0
+        self._visit = None       # tenant mid-burst (serves from deficit)
+
+    def __contains__(self, name):
+        return name in self.tenants
+
+    def get(self, name):
+        t = self.tenants.get(name)
+        if t is None:
+            raise KeyError(f"unknown tenant {name!r} "
+                           f"(have {sorted(self.tenants)})")
+        return t
+
+    def resolve(self, tenant, adapter):
+        """Validate a (tenant, adapter) submit pair -> (TenantConfig,
+        adapter_id).  ``adapter=None`` serves the base model
+        (adapter_id -1); a named adapter must be in the tenant's
+        entitlement set AND the store."""
+        t = self.get(tenant)
+        if adapter is None:
+            return t, -1
+        if adapter not in t.adapters:
+            raise ValueError(
+                f"tenant {tenant!r} is not entitled to adapter "
+                f"{adapter!r} (entitled: {sorted(t.adapters)})")
+        return t, self.store.id_of(adapter)
+
+    def namespace(self, tenant, adapter=None):
+        """The prefix-cache radix namespace for (tenant, adapter):
+        cached KV depends on the adapter weights that wrote it, so the
+        adapter is part of the key, not just the tenant."""
+        t = self.get(tenant) if isinstance(tenant, str) else tenant
+        return (t.prefix_namespace, adapter)
+
+    # -- WDRR admission -------------------------------------------------
+
+    def next_tenant(self, heads):
+        """Pick the tenant whose queue head admits next.  ``heads`` maps
+        tenant name -> page cost of its oldest waiting request.  Classic
+        deficit round-robin: visit tenants in fixed rotation; a visited
+        tenant with work earns ``quantum_pages * weight`` credit ONCE
+        per rotation visit and admits while its head cost fits the
+        accumulated deficit (a burst continues across calls via
+        ``_visit`` WITHOUT re-earning — topping up on every revisit
+        would let the rotation's first tenant serve forever, the exact
+        starvation the oracle in tests/unit/test_tenancy.py pins).
+        Idle tenants' deficits reset (no hoarding).  Returns None iff
+        ``heads`` is empty."""
+        if not heads:
+            return None
+        for t in self._deficit:
+            if t not in heads:
+                self._deficit[t] = 0.0
+                if self._visit == t:
+                    self._visit = None
+        # continue the current visit's burst from REMAINING deficit
+        v = self._visit
+        if v is not None and v in heads and self._deficit[v] >= heads[v]:
+            self._deficit[v] -= heads[v]
+            return v
+        self._visit = None
+        n = len(self._rr)
+        # bounded: each full rotation tops up every backlogged tenant
+        # once, so max(cost)/(quantum*min weight) rotations suffice
+        max_cost = max(heads.values())
+        min_gain = self.quantum_pages * min(
+            self.tenants[t].weight for t in heads)
+        rotations = int(max_cost / max(min_gain, 1e-9)) + 2
+        for _ in range(rotations * n):
+            t = self._rr[self._ptr % n]
+            self._ptr += 1
+            if t not in heads:
+                continue
+            self._deficit[t] += self.quantum_pages * \
+                self.tenants[t].weight
+            if self._deficit[t] >= heads[t]:
+                self._deficit[t] -= heads[t]
+                self._visit = t
+                return t
+        # numerically impossible unless weights/quantum are degenerate;
+        # serve the largest-deficit backlogged tenant rather than stall
+        return max(heads, key=lambda t: self._deficit[t])
+
+    # -- ledgers --------------------------------------------------------
+
+    def bill(self, tenant, *, page_seconds=0.0, pages_hwm=0, tokens=0):
+        u = self.usage[tenant]
+        u.page_seconds += float(page_seconds)
+        u.pages_hwm = max(u.pages_hwm, int(pages_hwm))
+        u.tokens_emitted += int(tokens)
+
+    def note(self, tenant, event):
+        u = self.usage[tenant]
+        setattr(u, event, getattr(u, event) + 1)
+
+    def usage_fields(self):
+        return {n: u.fields() for n, u in sorted(self.usage.items())}

@@ -240,6 +240,15 @@ EVENT_TAXONOMY = {
         "a long prompt stayed on the chunked path (no usable axis)",
     "serving/seq_prefill/shed_reserve_cap":
         "a prompt shed on the reserve cap (value = pages it needed)",
+    # ----------------------- multi-tenant serving (quotas + fairness)
+    "serving/tenant/active":
+        "tenants holding at least one pool page this step",
+    "serving/tenant/page_seconds":
+        "summed page-seconds billed across all tenant ledgers",
+    "serving/tenant/max_share":
+        "largest single tenant's fraction of the page pool",
+    "serving/tenant/quota_shed":
+        "a request shed on its tenant's page quota (after self-drain)",
 }
 
 # the eager comms logger's periodic report (comm.log_summary) routes

@@ -509,6 +509,16 @@ HEALTH_SCHEMA = {
     "seq_prefill_chunks": (int,),
     "seq_prefill_degraded": (int,),
     "seq_prefill_shed": (int,),
+    # multi-tenant serving (PR 20): tenancy presence, the per-tenant
+    # usage ledgers + live page footprints (None with tenancy off),
+    # adapter-store shape (count + rank bucket — the jit-signature
+    # inputs) and the quota-shed counter
+    "tenancy": (bool,),
+    "tenants": (dict, type(None)),
+    "tenant_pages": (dict, type(None)),
+    "adapters": (int,),
+    "adapter_rank_bucket": (int,),
+    "quota_shed": (int,),
 }
 
 
